@@ -1,0 +1,28 @@
+"""spark_scheduler_tpu — a TPU-native gang-scheduling framework.
+
+A ground-up rebuild of the capabilities of Palantir's `k8s-spark-scheduler`
+(reference: /root/reference, a Go kube-scheduler extender) as a TPU-first
+framework: the combinatorial core — gang fit-checking and driver/executor
+bin-packing over the cluster free-resource matrix — is a batched, vectorized
+placement solver built on JAX/XLA, holding cluster state as device-resident
+tensors and scoring many pending applications per kernel invocation.
+
+Package layout:
+  models/    domain state: resource algebra, cluster-state tensors, Spark app
+             shapes, ResourceReservation / Demand records (CRD equivalents).
+  ops/       XLA compute kernels: node-capacity, the five bin-packing
+             strategies, node-priority sorting, packing efficiency, batched
+             FIFO gang admission.
+  parallel/  multi-chip sharding: mesh construction and the shard_map'd
+             node-sharded solver (ICI/DCN collectives via XLA).
+  core/      the gang-admission engine (the reference's `internal/extender`):
+             predicate entry, reservation manager, soft reservations,
+             overhead, demands, failover reconciliation.
+  store/     object store, sharded dedup queue, async write-back client,
+             write-through caches (the reference's `internal/cache`).
+  server/    extender-protocol HTTP front-end, config, wiring.
+  metrics/   metric registry + reporters (foundry.spark.scheduler.* parity).
+  utils/     pod/demand helpers, sets, instance-group extraction.
+"""
+
+__version__ = "0.1.0"

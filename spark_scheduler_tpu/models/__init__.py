@@ -1,0 +1,10 @@
+"""Domain state models: resource algebra, cluster tensors, apps, reservations, demands."""
+
+from spark_scheduler_tpu.models.resources import (  # noqa: F401
+    Resources,
+    parse_quantity,
+    CPU_DIM,
+    MEM_DIM,
+    GPU_DIM,
+    NUM_DIMS,
+)

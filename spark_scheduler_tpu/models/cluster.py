@@ -1,0 +1,246 @@
+"""Cluster state as device tensors.
+
+The reference's scheduling view of the cluster is a pair of string-keyed maps
+(`NodeGroupResources`, `NodeGroupSchedulingMetadata`, resources.go:102-106)
+rebuilt per request from informer caches. The TPU-native design replaces them
+with dense `[N, 3]` int32 tensors over a stable node-index space so that the
+whole fit/pack computation is one XLA program:
+
+  available[N,3]    = allocatable - reservation usage - overhead
+  schedulable[N,3]  = allocatable - overhead
+  zone_id[N]        int32 zone of each node (registry-interned)
+  name_rank[N]      lexicographic rank of the node name (sort tie-break,
+                    sort/nodesorting.go:86-95)
+  label_rank_*[N]   configured label-priority rank (lower = higher priority,
+                    INT32_INF when the label/value is absent;
+                    sort/nodesorting.go:160-185)
+  unschedulable[N] / ready[N] / valid[N] bool masks
+
+`NodeRegistry` owns the name <-> index interning host-side. Indices are stable
+across node churn (freed slots are recycled and masked out via `valid`), so
+incremental scatter updates to device-resident state stay cheap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Mapping
+
+import numpy as np
+
+from spark_scheduler_tpu.models.kube import Node
+from spark_scheduler_tpu.models.resources import INT32_INF, NUM_DIMS, Resources
+
+
+@dataclasses.dataclass
+class ClusterTensors:
+    """The dense scheduling view consumed by ops/ kernels.
+
+    A plain pytree of numpy/jax arrays; every ops/ kernel takes it as the
+    first argument. Replaces NodeGroupSchedulingMetadata (resources.go:61-100).
+    """
+
+    available: np.ndarray  # [N,3] i32
+    schedulable: np.ndarray  # [N,3] i32
+    zone_id: np.ndarray  # [N] i32
+    name_rank: np.ndarray  # [N] i32
+    label_rank_driver: np.ndarray  # [N] i32
+    label_rank_executor: np.ndarray  # [N] i32
+    unschedulable: np.ndarray  # [N] bool
+    ready: np.ndarray  # [N] bool
+    valid: np.ndarray  # [N] bool
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.available.shape[0])
+
+    def tree_flatten(self):
+        return (
+            (
+                self.available,
+                self.schedulable,
+                self.zone_id,
+                self.name_rank,
+                self.label_rank_driver,
+                self.label_rank_executor,
+                self.unschedulable,
+                self.ready,
+                self.valid,
+            ),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+# Register as a JAX pytree so kernels can close over / be jitted with it.
+import jax.tree_util as _jtu  # noqa: E402
+
+_jtu.register_pytree_node(
+    ClusterTensors, ClusterTensors.tree_flatten, lambda aux, ch: ClusterTensors(*ch)
+)
+
+
+class NodeRegistry:
+    """Host-side interning of node names and zone labels to stable indices."""
+
+    def __init__(self):
+        self._index: dict[str, int] = {}
+        self._names: list[str | None] = []
+        self._free: list[int] = []
+        self._zone_ids: dict[str, int] = {}
+        self._zone_names: list[str] = []
+
+    def intern(self, name: str) -> int:
+        idx = self._index.get(name)
+        if idx is None:
+            if self._free:
+                idx = self._free.pop()
+                self._names[idx] = name
+            else:
+                idx = len(self._names)
+                self._names.append(name)
+            self._index[name] = idx
+        return idx
+
+    def remove(self, name: str) -> None:
+        idx = self._index.pop(name, None)
+        if idx is not None:
+            self._names[idx] = None
+            self._free.append(idx)
+
+    def index_of(self, name: str) -> int | None:
+        return self._index.get(name)
+
+    def name_of(self, idx: int) -> str | None:
+        if 0 <= idx < len(self._names):
+            return self._names[idx]
+        return None
+
+    def zone_id(self, zone: str) -> int:
+        zid = self._zone_ids.get(zone)
+        if zid is None:
+            zid = len(self._zone_names)
+            self._zone_ids[zone] = zid
+            self._zone_names.append(zone)
+        return zid
+
+    @property
+    def capacity(self) -> int:
+        return len(self._names)
+
+    def names(self) -> list[str | None]:
+        return list(self._names)
+
+
+def usage_for_nodes(
+    reservations: Iterable, registry: NodeRegistry, num_nodes: int
+) -> np.ndarray:
+    """[N,3] reservation usage tensor from ResourceReservation records
+    (resources.go:31-44 UsageForNodes). `reservations` yields objects with a
+    `.spec.reservations: dict[str, Reservation{node, resources}]`."""
+    usage = np.zeros((num_nodes, NUM_DIMS), dtype=np.int64)
+    for rr in reservations:
+        for res in rr.spec.reservations.values():
+            idx = registry.index_of(res.node)
+            if idx is not None and idx < num_nodes:
+                usage[idx] += res.resources.as_array()
+    return np.clip(usage, -INT32_INF, INT32_INF).astype(np.int32)
+
+
+def resources_map_to_tensor(
+    usage: Mapping[str, Resources], registry: NodeRegistry, num_nodes: int
+) -> np.ndarray:
+    """[N,3] tensor from a {node name: Resources} map (overhead, soft usage)."""
+    out = np.zeros((num_nodes, NUM_DIMS), dtype=np.int64)
+    for name, res in usage.items():
+        idx = registry.index_of(name)
+        if idx is not None and idx < num_nodes:
+            out[idx] += res.as_array()
+    return np.clip(out, -INT32_INF, INT32_INF).astype(np.int32)
+
+
+def build_cluster_tensors(
+    nodes: list[Node],
+    usage: np.ndarray | Mapping[str, Resources],
+    overhead: np.ndarray | Mapping[str, Resources],
+    registry: NodeRegistry,
+    *,
+    driver_label_priority: tuple[str, list[str]] | None = None,
+    executor_label_priority: tuple[str, list[str]] | None = None,
+    pad_to: int | None = None,
+) -> ClusterTensors:
+    """Build the dense scheduling view for a set of live nodes.
+
+    Mirrors `NodeSchedulingMetadataForNodes` (resources.go:61-100):
+      available   = allocatable - usage - overhead
+      schedulable = allocatable - overhead
+    plus the priority inputs of sort/nodesorting.go. `pad_to` rounds N up
+    (bucketing) so XLA compile caches stay warm across node-count jitter.
+    """
+    for n in nodes:
+        registry.intern(n.name)
+    n_slots = registry.capacity
+    if pad_to is not None:
+        n_slots = max(n_slots, pad_to)
+
+    if not isinstance(usage, np.ndarray):
+        usage = resources_map_to_tensor(usage, registry, n_slots)
+    if not isinstance(overhead, np.ndarray):
+        overhead = resources_map_to_tensor(overhead, registry, n_slots)
+
+    alloc = np.zeros((n_slots, NUM_DIMS), dtype=np.int64)
+    zone_id = np.zeros(n_slots, dtype=np.int32)
+    unschedulable = np.zeros(n_slots, dtype=bool)
+    ready = np.zeros(n_slots, dtype=bool)
+    valid = np.zeros(n_slots, dtype=bool)
+    name_rank = np.full(n_slots, INT32_INF, dtype=np.int32)
+    lr_driver = np.full(n_slots, INT32_INF, dtype=np.int32)
+    lr_executor = np.full(n_slots, INT32_INF, dtype=np.int32)
+
+    live = sorted(nodes, key=lambda n: n.name)
+    for rank, node in enumerate(live):
+        idx = registry.intern(node.name)
+        alloc[idx] = node.allocatable.as_array()
+        zone_id[idx] = registry.zone_id(node.zone)
+        unschedulable[idx] = node.unschedulable
+        ready[idx] = node.ready
+        valid[idx] = True
+        name_rank[idx] = rank
+        for target, prio in (
+            (lr_driver, driver_label_priority),
+            (lr_executor, executor_label_priority),
+        ):
+            if prio is not None:
+                label, values = prio
+                val = node.labels.get(label)
+                if val is not None and val in values:
+                    target[idx] = values.index(val)
+
+    if usage.shape[0] < n_slots:
+        usage = np.pad(usage, ((0, n_slots - usage.shape[0]), (0, 0)))
+    if overhead.shape[0] < n_slots:
+        overhead = np.pad(overhead, ((0, n_slots - overhead.shape[0]), (0, 0)))
+
+    available = np.clip(
+        alloc - usage.astype(np.int64) - overhead.astype(np.int64),
+        -INT32_INF,
+        INT32_INF,
+    ).astype(np.int32)
+    schedulable = np.clip(
+        alloc - overhead.astype(np.int64), -INT32_INF, INT32_INF
+    ).astype(np.int32)
+
+    return ClusterTensors(
+        available=available,
+        schedulable=schedulable,
+        zone_id=zone_id,
+        name_rank=name_rank,
+        label_rank_driver=lr_driver,
+        label_rank_executor=lr_executor,
+        unschedulable=unschedulable,
+        ready=ready,
+        valid=valid,
+    )

@@ -1,0 +1,48 @@
+"""Per-node executor-capacity kernel.
+
+The scalar loop the reference runs per node
+(binpack/minimal_fragmentation.go:113-151 `getNodeCapacity` /
+`getCapacityAgainstSingleDimension`) becomes one vectorized expression over
+the whole `[N, 3]` availability tensor. Exact integer semantics:
+
+  per dim: 0                       if reserved > available
+           INF                     if required == 0
+           floor((avail-res)/req)  otherwise
+  node capacity = min over dims, never negative.
+
+This kernel is THE hot op of the framework: every packing strategy, the gang
+fit check, and the FIFO admission scan all reduce to it plus prefix sums.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_scheduler_tpu.models.cluster import INT32_INF
+
+CAP_INF = INT32_INF
+
+
+def node_capacities(
+    available: jnp.ndarray,  # [N, 3] i32
+    reserved: jnp.ndarray,  # [N, 3] i32 (already-tentatively-reserved, e.g. driver)
+    request: jnp.ndarray,  # [3] i32 (one executor)
+) -> jnp.ndarray:  # [N] i32
+    """How many `request`-shaped items fit on each node."""
+    diff = available - reserved
+    req = request[None, :]
+    safe = jnp.maximum(req, 1)
+    per_dim = jnp.where(
+        reserved > available,
+        0,
+        jnp.where(req == 0, CAP_INF, jnp.floor_divide(diff, safe)),
+    )
+    return jnp.maximum(jnp.min(per_dim, axis=-1), 0).astype(jnp.int32)
+
+
+def fits(
+    available: jnp.ndarray,  # [N, 3] i32
+    request: jnp.ndarray,  # [3] i32
+) -> jnp.ndarray:  # [N] bool
+    """Per-node `not request.greater_than(available)` (resources.go:242-245)."""
+    return jnp.all(request[None, :] <= available, axis=-1)

@@ -1,0 +1,98 @@
+"""Packing-efficiency kernel (binpack/efficiency.go:23-156).
+
+Per-node efficiency = (already-reserved + newly-reserved) / schedulable per
+dim; GPU only counts on nodes with schedulable GPU. The average over a
+packing's entries (driver + one entry PER executor — duplicate nodes count
+once per occurrence, matching chooseBestResult, single_az.go:84-97) scores
+zones in the single-AZ packers and feeds the binpack metrics.
+
+Deviation from the reference, recorded deliberately: the Go code divides
+`resource.Quantity.Value()`s, which ROUNDS sub-unit quantities (500m CPU ->
+1); we divide exact fixed-point units in float32, which is strictly more
+accurate. Tie behavior between zones can differ only when the reference's
+rounding itself changed the winner.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from spark_scheduler_tpu.models.cluster import ClusterTensors
+from spark_scheduler_tpu.models.resources import CPU_DIM, GPU_DIM, MEM_DIM
+
+
+class AvgEfficiency(NamedTuple):
+    cpu: jnp.ndarray
+    memory: jnp.ndarray
+    gpu: jnp.ndarray
+    max: jnp.ndarray  # the field zone selection compares (efficiency.go:36-39)
+
+
+def new_reservation_tensor(
+    num_nodes: int,
+    driver_node: jnp.ndarray,
+    executor_nodes: jnp.ndarray,
+    driver_req: jnp.ndarray,
+    exec_req: jnp.ndarray,
+) -> jnp.ndarray:
+    """[N,3] scatter-add of a packing's tentative reservations."""
+    out = jnp.zeros((num_nodes, 3), jnp.int32)
+    d_ok = driver_node >= 0
+    out = out.at[jnp.clip(driver_node, 0)].add(
+        jnp.where(d_ok, driver_req, 0).astype(jnp.int32)
+    )
+    e_ok = executor_nodes >= 0
+    out = out.at[jnp.clip(executor_nodes, 0)].add(
+        jnp.where(e_ok[:, None], exec_req[None, :], 0).astype(jnp.int32)
+    )
+    return out
+
+
+def avg_packing_efficiency(
+    cluster: ClusterTensors,
+    driver_node: jnp.ndarray,
+    executor_nodes: jnp.ndarray,
+    driver_req: jnp.ndarray,
+    exec_req: jnp.ndarray,
+) -> AvgEfficiency:
+    n = cluster.available.shape[0]
+    new_res = new_reservation_tensor(
+        n, driver_node, executor_nodes, driver_req, exec_req
+    )
+    # schedulable - available = current reservation usage (efficiency.go:85-92).
+    reserved_total = (cluster.schedulable - cluster.available) + new_res
+    denom = jnp.where(cluster.schedulable == 0, 1, cluster.schedulable).astype(
+        jnp.float32
+    )
+    eff = reserved_total.astype(jnp.float32) / denom  # [N,3]
+    gpu_node = cluster.schedulable[:, GPU_DIM] != 0
+    eff_gpu = jnp.where(gpu_node, eff[:, GPU_DIM], 0.0)
+    node_max = jnp.maximum(eff_gpu, jnp.maximum(eff[:, CPU_DIM], eff[:, MEM_DIM]))
+
+    entries = jnp.concatenate([driver_node[None], executor_nodes])
+    valid = entries >= 0
+    idx = jnp.clip(entries, 0)
+    cnt = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+
+    cpu_mean = jnp.sum(jnp.where(valid, eff[idx, CPU_DIM], 0.0)) / cnt
+    mem_mean = jnp.sum(jnp.where(valid, eff[idx, MEM_DIM], 0.0)) / cnt
+    gpu_valid = valid & gpu_node[idx]
+    gpu_cnt = jnp.sum(gpu_valid)
+    gpu_mean = jnp.where(
+        gpu_cnt == 0,
+        1.0,  # no GPU nodes among entries => 1 (efficiency.go:139-144)
+        jnp.sum(jnp.where(gpu_valid, eff_gpu[idx], 0.0))
+        / jnp.maximum(gpu_cnt, 1).astype(jnp.float32),
+    )
+    max_mean = jnp.sum(jnp.where(valid, node_max[idx], 0.0)) / cnt
+    # Empty packing => worst efficiency (efficiency.go:44-52).
+    none = jnp.sum(valid) == 0
+    zero = jnp.float32(0.0)
+    return AvgEfficiency(
+        cpu=jnp.where(none, zero, cpu_mean),
+        memory=jnp.where(none, zero, mem_mean),
+        gpu=jnp.where(none, zero, gpu_mean),
+        max=jnp.where(none, zero, max_mean),
+    )

@@ -1,0 +1,76 @@
+"""Node-priority ordering as a lexicographic sort kernel.
+
+Rebuilds internal/sort/nodesorting.go as one XLA sort over composite keys:
+
+  1. AZ priority: zones ranked ascending by total available (memory first,
+     then CPU) over the metadata domain (nodesorting.go:97-121,
+     `resourcesLessThan` :74-81).
+  2. Within a zone: available memory asc, then CPU asc, then node name
+     (nodesorting.go:84-95; the reference's `sort.Slice` is unstable when
+     mem+cpu tie but GPU differs — any order is reference-compatible there,
+     we pin it with the name rank).
+  3. Optional configured label priority as a FINAL stable re-sort
+     (nodesorting.go:62-64,160-185), i.e. the label rank becomes the most
+     significant key, missing labels rank last.
+
+Ineligible nodes sort to the end; callers get `(order, count)`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from spark_scheduler_tpu.models.cluster import ClusterTensors, INT32_INF
+from spark_scheduler_tpu.models.resources import CPU_DIM, MEM_DIM
+
+
+def zone_ranks(
+    cluster: ClusterTensors,
+    domain_mask: jnp.ndarray,  # [N] bool — nodes in the metadata domain
+    num_zones: int,  # static upper bound on zone-id space
+) -> jnp.ndarray:  # [num_zones] i32: rank of each zone (0 = highest priority)
+    """Zones ordered ascending by (total available memory, total CPU)
+    (nodesorting.go:101-104, 124-134). Zones with no domain nodes rank last."""
+    mask = domain_mask & cluster.valid
+
+    def _zone_sum_hi_lo(vals: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        # Exact int32-safe aggregation: split each value into (hi, lo) 16-bit
+        # halves, segment-sum each, then carry lo into hi. Exact for up to
+        # 32k nodes per zone without needing x64 (TPU int64 emulation).
+        v = jnp.where(mask, vals, 0)
+        hi = jnp.zeros(num_zones, jnp.int32).at[cluster.zone_id].add(v >> 16)
+        lo = jnp.zeros(num_zones, jnp.int32).at[cluster.zone_id].add(v & 0xFFFF)
+        return hi + (lo >> 16), lo & 0xFFFF
+
+    mem_hi, mem_lo = _zone_sum_hi_lo(cluster.available[:, MEM_DIM])
+    cpu_hi, cpu_lo = _zone_sum_hi_lo(cluster.available[:, CPU_DIM])
+    present = jnp.zeros(num_zones, jnp.bool_).at[cluster.zone_id].max(mask)
+    # Absent zones last; ties between zones are unordered in the reference
+    # (map iteration); pin with zone id.
+    order = jnp.lexsort(
+        (jnp.arange(num_zones), cpu_lo, cpu_hi, mem_lo, mem_hi, jnp.where(present, 0, 1))
+    )
+    ranks = jnp.zeros(num_zones, jnp.int32).at[order].set(
+        jnp.arange(num_zones, dtype=jnp.int32)
+    )
+    return ranks
+
+
+def priority_order(
+    cluster: ClusterTensors,
+    eligible: jnp.ndarray,  # [N] bool
+    zrank: jnp.ndarray,  # [num_zones] i32 from zone_ranks
+    label_rank: jnp.ndarray,  # [N] i32 (INT32_INF = unranked)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(order[N] node indices, count) — eligible nodes in priority order,
+    ineligible pushed to the end."""
+    elig = eligible & cluster.valid
+    az = zrank[cluster.zone_id]
+    mem = cluster.available[:, MEM_DIM]
+    cpu = cluster.available[:, CPU_DIM]
+    # lexsort: last key is primary.
+    order = jnp.lexsort(
+        (cluster.name_rank, cpu, mem, az, label_rank, jnp.where(elig, 0, 1))
+    )
+    count = jnp.sum(elig).astype(jnp.int32)
+    return order.astype(jnp.int32), count

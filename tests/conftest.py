@@ -1,0 +1,21 @@
+"""Test env: force CPU with an 8-device virtual mesh.
+
+Multi-chip sharding paths are validated on the host platform
+(`xla_force_host_platform_device_count=8`), per the driver's dryrun contract.
+Note: this environment's TPU site hook overrides JAX_PLATFORMS via
+`jax.config`, so we must update the config AFTER importing jax — env vars
+alone are not enough.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,75 @@
+"""Resource algebra + quantity parsing unit tests (reference:
+resources.go:150-245, annotation quantities in sparkpods.go:79-138)."""
+
+import numpy as np
+
+from spark_scheduler_tpu.models.resources import (
+    CPU_DIM,
+    GPU_DIM,
+    MEM_DIM,
+    Resources,
+    parse_quantity,
+)
+
+
+def test_parse_cpu_quantities():
+    assert parse_quantity("1", CPU_DIM) == 1000
+    assert parse_quantity("500m", CPU_DIM) == 500
+    assert parse_quantity("2.5", CPU_DIM) == 2500
+    assert parse_quantity("0.1", CPU_DIM) == 100
+    assert parse_quantity(3, CPU_DIM) == 3000
+
+
+def test_parse_memory_quantities():
+    assert parse_quantity("1Ki", MEM_DIM) == 1
+    assert parse_quantity("8Gi", MEM_DIM) == 8 * 1024 * 1024
+    assert parse_quantity("512Mi", MEM_DIM) == 512 * 1024
+    assert parse_quantity("1M", MEM_DIM) == -(-(10**6) // 1024)  # ceil
+    assert parse_quantity("1M", MEM_DIM, round_up=False) == 10**6 // 1024
+    assert parse_quantity("1.5Gi", MEM_DIM) == 3 * 512 * 1024
+
+
+def test_parse_rounding_is_conservative():
+    # Requests round up, allocatable rounds down.
+    assert parse_quantity("100n", CPU_DIM) == 1
+    assert parse_quantity("100n", CPU_DIM, round_up=False) == 0
+    assert parse_quantity("1023", MEM_DIM) == 1
+    assert parse_quantity("1023", MEM_DIM, round_up=False) == 0
+
+
+def test_parse_gpu():
+    assert parse_quantity("1", GPU_DIM) == 1000
+    assert parse_quantity("2", GPU_DIM) == 2000
+
+
+def test_parse_exponents_and_exa():
+    # k8s decimalExponent grammar admits both e and E (quantity.go:49).
+    assert parse_quantity("1e3", CPU_DIM) == 10**6
+    assert parse_quantity("1E3", CPU_DIM) == 10**6
+    assert parse_quantity("2e-1", CPU_DIM) == 200
+    # Bare E is the exa suffix; value saturates at the int32 bound.
+    assert parse_quantity("1E", CPU_DIM) == 2**31 - 2
+
+
+def test_resources_ops():
+    a = Resources.from_quantities("1", "1Gi", "1")
+    b = Resources.from_quantities("500m", "512Mi", "0")
+    a.add(b)
+    assert a.as_tuple() == (1500, 1024 * 1024 + 512 * 1024, 1000)
+    a.sub(b)
+    assert a.as_tuple() == (1000, 1024 * 1024, 1000)
+    assert a.greater_than(b)
+    assert not b.greater_than(a)
+    # greater_than is ANY-dim (resources.go:242-245)
+    c = Resources(1, 0, 0)
+    d = Resources(0, 5, 5)
+    assert c.greater_than(d)
+    assert d.greater_than(c)
+    e = b.copy().set_max(Resources(200, 10**9, 500))
+    assert e.as_tuple() == (500, 10**9, 500)
+
+
+def test_array_round_trip():
+    r = Resources(5, 7, 9)
+    assert Resources.from_array(r.as_array()).as_tuple() == (5, 7, 9)
+    assert r.as_array().dtype == np.int32
