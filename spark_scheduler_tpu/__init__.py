@@ -7,22 +7,18 @@ bin-packing over the cluster free-resource matrix — is a batched, vectorized
 placement solver built on JAX/XLA, holding cluster state as device-resident
 tensors and scoring many pending applications per kernel invocation.
 
-Package layout:
+Package layout (see each subpackage's docstring for its reference mapping):
   models/    domain state: resource algebra, cluster-state tensors, Spark app
              shapes, ResourceReservation / Demand records (CRD equivalents).
   ops/       XLA compute kernels: node-capacity, the five bin-packing
-             strategies, node-priority sorting, packing efficiency, batched
-             FIFO gang admission.
-  parallel/  multi-chip sharding: mesh construction and the shard_map'd
-             node-sharded solver (ICI/DCN collectives via XLA).
+             strategies, node-priority sorting, packing efficiency.
   core/      the gang-admission engine (the reference's `internal/extender`):
              predicate entry, reservation manager, soft reservations,
              overhead, demands, failover reconciliation.
   store/     object store, sharded dedup queue, async write-back client,
              write-through caches (the reference's `internal/cache`).
-  server/    extender-protocol HTTP front-end, config, wiring.
-  metrics/   metric registry + reporters (foundry.spark.scheduler.* parity).
-  utils/     pod/demand helpers, sets, instance-group extraction.
+  server/    install config + dependency wiring + serving layer.
+  testing/   the component-test harness (the reference's extendertest).
 """
 
 __version__ = "0.1.0"

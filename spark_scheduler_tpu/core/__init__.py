@@ -1,0 +1,12 @@
+"""The gang-admission engine — rebuild of the reference's internal/extender.
+
+Components: SparkPodLister-equivalent app-shape parsing (sparkpods),
+SoftReservationStore, OverheadComputer, ResourceReservationManager, demand
+lifecycle + GC, the PlacementSolver (host<->device glue around ops/ kernels),
+the SparkSchedulerExtender predicate, failover reconciliation, and the
+unschedulable-pod marker.
+"""
+
+from spark_scheduler_tpu.core.extender import SparkSchedulerExtender, ExtenderConfig  # noqa: F401
+from spark_scheduler_tpu.core.solver import PlacementSolver, HostPacking  # noqa: F401
+from spark_scheduler_tpu.core.binpacker import Binpacker, select_binpacker  # noqa: F401

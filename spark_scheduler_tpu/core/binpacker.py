@@ -1,0 +1,30 @@
+"""Binpacker registry (internal/extender/binpack.go:21-54): maps the
+configured algorithm name to a packing kernel and flags single-AZ packers
+(which gate zone-scoped demands + same-AZ dynamic allocation)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from spark_scheduler_tpu.ops.packing import SINGLE_AZ_PACKERS, BINPACK_FUNCTIONS
+
+AZ_AWARE_TIGHTLY_PACK = "az-aware-tightly-pack"
+SINGLE_AZ_TIGHTLY_PACK = "single-az-tightly-pack"
+SINGLE_AZ_MINIMAL_FRAGMENTATION = "single-az-minimal-fragmentation"
+TIGHTLY_PACK = "tightly-pack"
+DISTRIBUTE_EVENLY = "distribute-evenly"
+MINIMAL_FRAGMENTATION = "minimal-fragmentation"
+
+
+@dataclasses.dataclass(frozen=True)
+class Binpacker:
+    name: str
+    is_single_az: bool
+
+
+def select_binpacker(name: str) -> Binpacker:
+    """Unknown names fall back to tightly-pack, matching SelectBinpacker
+    (binpack.go:47-54)."""
+    if name not in BINPACK_FUNCTIONS:
+        name = TIGHTLY_PACK
+    return Binpacker(name=name, is_single_az=name in SINGLE_AZ_PACKERS)

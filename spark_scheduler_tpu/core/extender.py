@@ -1,0 +1,432 @@
+"""SparkSchedulerExtender — the gang-admission predicate.
+
+Rebuilds internal/extender/resource.go:59-639. The Predicate contract is the
+kube-scheduler extender protocol: given a pod + candidate node names, return
+the one node the pod should land on, or a per-node failure map. Driver
+requests perform gang admission (FIFO-aware fit of the whole application
+through the placement kernels, durable reservation creation on success);
+executor requests walk the binding ladder (already-bound / unbound /
+reschedule / soft reservation).
+
+Outcome strings match the reference exactly (resource.go:43-57) so
+dashboards keyed on them carry over.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import NamedTuple, Optional, Sequence
+
+from spark_scheduler_tpu.models.kube import Pod
+from spark_scheduler_tpu.core.binpacker import Binpacker
+from spark_scheduler_tpu.core.demands import DemandManager
+from spark_scheduler_tpu.core.overhead import OverheadComputer
+from spark_scheduler_tpu.core.reservation_manager import (
+    ReservationError,
+    ResourceReservationManager,
+)
+from spark_scheduler_tpu.core.solver import PlacementSolver
+from spark_scheduler_tpu.core.sparkpods import (
+    DRIVER_RESERVATION,
+    ROLE_DRIVER,
+    ROLE_EXECUTOR,
+    SPARK_APP_ID_LABEL,
+    SPARK_ROLE_LABEL,
+    SparkPodError,
+    SparkPodLister,
+    pod_matches_node,
+    spark_resources,
+)
+
+# Outcomes (resource.go:43-57)
+FAILURE_UNBOUND = "failure-unbound"
+FAILURE_INTERNAL = "failure-internal"
+FAILURE_FIT = "failure-fit"
+FAILURE_EARLIER_DRIVER = "failure-earlier-driver"
+FAILURE_NON_SPARK_POD = "failure-non-spark-pod"
+SUCCESS = "success"
+SUCCESS_RESCHEDULED = "success-rescheduled"
+SUCCESS_ALREADY_BOUND = "success-already-bound"
+SUCCESS_SCHEDULED_EXTRA_EXECUTOR = "success-scheduled-extra-executor"
+
+SUCCESS_OUTCOMES = frozenset(
+    {SUCCESS, SUCCESS_RESCHEDULED, SUCCESS_ALREADY_BOUND, SUCCESS_SCHEDULED_EXTRA_EXECUTOR}
+)
+
+LEADER_ELECTION_INTERVAL_S = 15.0  # resource.go:54-57
+
+# `DRIVER_RESERVATION` lives in models.reservations; re-exported through
+# sparkpods for core-layer convenience.
+
+
+class ExtenderArgs(NamedTuple):
+    """schedulerapi.ExtenderArgs: the pod + kube-scheduler's candidates."""
+
+    pod: Pod
+    node_names: list[str]
+
+
+class ExtenderFilterResult(NamedTuple):
+    """schedulerapi.ExtenderFilterResult."""
+
+    node_names: list[str]
+    failed_nodes: dict[str, str]
+    outcome: str
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.node_names)
+
+
+@dataclasses.dataclass
+class FifoConfig:
+    """config.FifoConfig (config/config.go:57-64): age gate before an
+    unschedulable earlier driver BLOCKS later drivers."""
+
+    enforce_after_pod_age_s: float = 0.0
+    enforce_after_pod_age_by_instance_group: dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class ExtenderConfig:
+    fifo: bool = False
+    fifo_config: FifoConfig = dataclasses.field(default_factory=FifoConfig)
+    instance_group_label: str = "instance-group"
+    schedule_dynamically_allocated_executors_in_same_az: bool = False
+
+
+class SparkSchedulerExtender:
+    def __init__(
+        self,
+        backend,
+        pod_lister: SparkPodLister,
+        reservation_manager: ResourceReservationManager,
+        demand_manager: DemandManager,
+        overhead_computer: OverheadComputer,
+        binpacker: Binpacker,
+        solver: PlacementSolver,
+        config: ExtenderConfig,
+        reconciler=None,
+        metrics=None,
+        events=None,
+        clock=time.time,
+    ):
+        self._backend = backend
+        self._pod_lister = pod_lister
+        self._rrm = reservation_manager
+        self._demands = demand_manager
+        self._overhead = overhead_computer
+        self.binpacker = binpacker
+        self._solver = solver
+        self._config = config
+        self._reconciler = reconciler
+        self._metrics = metrics
+        self._events = events
+        self._clock = clock
+        self._last_request: float = 0.0
+
+    # ------------------------------------------------------------------ API
+
+    def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
+        pod = args.pod
+        role = pod.labels.get(SPARK_ROLE_LABEL, "")
+        timer_start = self._clock()
+
+        try:
+            self._reconcile_if_needed()
+        except Exception as exc:  # failure to rebuild state is internal
+            return self._fail(args, FAILURE_INTERNAL, f"failed to reconcile: {exc}")
+        self._rrm.compact_dynamic_allocation_applications()
+
+        node, outcome, message = self._select_node(role, pod, args.node_names)
+
+        if self._metrics is not None:
+            self._metrics.mark_schedule_outcome(
+                pod, role, outcome, self._clock() - timer_start
+            )
+        if node is None:
+            return self._fail(args, outcome, message or outcome)
+        if role == ROLE_DRIVER and self._events is not None:
+            try:
+                app_resources = spark_resources(pod)
+                self._events.emit_application_scheduled(pod, app_resources)
+            except SparkPodError:
+                pass
+        return ExtenderFilterResult(node_names=[node], failed_nodes={}, outcome=outcome)
+
+    # ------------------------------------------------------------- plumbing
+
+    def _fail(self, args: ExtenderArgs, outcome: str, message: str) -> ExtenderFilterResult:
+        if self._metrics is not None:
+            self._metrics.mark_failed_scheduling_attempt(args.pod, outcome)
+        return ExtenderFilterResult(
+            node_names=[],
+            failed_nodes={name: message for name in args.node_names},
+            outcome=outcome,
+        )
+
+    def _reconcile_if_needed(self) -> None:
+        """>15s request gap => leader probably changed => resync durable
+        state from observed pods (resource.go:191-202)."""
+        now = self._clock()
+        if now > self._last_request + LEADER_ELECTION_INTERVAL_S:
+            if self._reconciler is not None:
+                self._reconciler.sync_resource_reservations_and_demands()
+        self._last_request = now
+
+    def _select_node(
+        self, role: str, pod: Pod, node_names: list[str]
+    ) -> tuple[Optional[str], str, str]:
+        if role == ROLE_DRIVER:
+            return self._select_driver_node(pod, node_names)
+        if role == ROLE_EXECUTOR:
+            node, outcome, msg = self._select_executor_node(pod, node_names)
+            if outcome in SUCCESS_OUTCOMES:
+                self._demands.delete_demand_if_exists(pod)
+            return node, outcome, msg
+        return None, FAILURE_NON_SPARK_POD, "can not schedule non spark pod"
+
+    # --------------------------------------------------------------- driver
+
+    def _select_driver_node(
+        self, driver: Pod, node_names: list[str]
+    ) -> tuple[Optional[str], str, str]:
+        app_id = driver.labels.get(SPARK_APP_ID_LABEL, "")
+        rr = self._rrm.get_resource_reservation(app_id, driver.namespace)
+        if rr is not None:
+            # Idempotent retry: return the previously reserved node even if
+            # absent from the candidate list (resource.go:273-286).
+            return rr.spec.reservations[DRIVER_RESERVATION].node, SUCCESS, ""
+
+        available_nodes = [
+            n for n in self._backend.list_nodes() if pod_matches_node(driver, n)
+        ]
+        usage = self._rrm.get_reserved_resources()
+        overhead = self._overhead.get_overhead(available_nodes)
+        tensors = self._solver.build_tensors(available_nodes, usage, overhead)
+
+        try:
+            app_resources = spark_resources(driver)
+        except SparkPodError as exc:
+            return None, FAILURE_INTERNAL, f"failed to get spark resources: {exc}"
+
+        if self._config.fifo:
+            earlier = self._pod_lister.list_earlier_drivers(driver)
+            tensors, ok = self._fit_earlier_drivers(earlier, tensors, node_names)
+            if not ok:
+                self._demands.create_demand_for_application(driver, app_resources)
+                return None, FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
+
+        packing = self._solver.pack(
+            self.binpacker.name,
+            tensors,
+            app_resources.driver_resources,
+            app_resources.executor_resources,
+            app_resources.min_executor_count,
+            node_names,
+        )
+        if not packing.has_capacity:
+            self._demands.create_demand_for_application(driver, app_resources)
+            return None, FAILURE_FIT, "application does not fit to the cluster"
+
+        if self._metrics is not None:
+            self._metrics.report_packing_efficiency(self.binpacker.name, packing)
+            self._metrics.report_cross_zone(
+                packing.driver_node, packing.executor_nodes, available_nodes
+            )
+        self._demands.delete_demand_if_exists(driver)
+        try:
+            self._rrm.create_reservations(
+                driver,
+                app_resources,
+                packing.driver_node,
+                packing.executor_nodes,
+            )
+        except ReservationError as exc:
+            return None, FAILURE_INTERNAL, str(exc)
+        return packing.driver_node, SUCCESS, ""
+
+    def _fit_earlier_drivers(
+        self, drivers: Sequence[Pod], tensors, node_names: list[str]
+    ):
+        """FIFO prefix admission (resource.go:221-258): every earlier driver
+        must hypothetically fit (or be young enough to skip); each fit
+        subtracts its placements from availability.
+
+        Deviation from the reference, deliberate: the reference's
+        `sparkResourceUsage` (sparkpods.go:141-149) OVERWRITES per-node usage
+        (one executor's worth per distinct node, driver slot clobbered by
+        executors on the same node), under-reserving for earlier drivers. We
+        scatter-ADD the true usage of every placement.
+        """
+        for driver in drivers:
+            try:
+                app_resources = spark_resources(driver)
+            except SparkPodError:
+                continue  # unparseable driver is skipped (resource.go:228-233)
+            packing = self._solver.pack(
+                self.binpacker.name,
+                tensors,
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+                node_names,
+            )
+            if not packing.has_capacity:
+                if self._should_skip_driver_fifo(driver):
+                    continue
+                return tensors, False
+            usage: dict = {}
+            from spark_scheduler_tpu.models.resources import Resources as _R
+
+            usage[packing.driver_node] = app_resources.driver_resources.copy()
+            for node in packing.executor_nodes:
+                usage.setdefault(node, _R.zero()).add(app_resources.executor_resources)
+            tensors = self._solver.subtract_usage(tensors, usage)
+        return tensors, True
+
+    def _should_skip_driver_fifo(self, pod: Pod) -> bool:
+        """Age-gated FIFO enforcement (resource.go:260-270)."""
+        from spark_scheduler_tpu.core.sparkpods import find_instance_group
+
+        group = find_instance_group(pod, self._config.instance_group_label) or ""
+        age_gate = self._config.fifo_config.enforce_after_pod_age_by_instance_group.get(
+            group, self._config.fifo_config.enforce_after_pod_age_s
+        )
+        return pod.creation_timestamp + age_gate > self._clock()
+
+    # ------------------------------------------------------------- executor
+
+    def _select_executor_node(
+        self, executor: Pod, node_names: list[str]
+    ) -> tuple[Optional[str], str, str]:
+        try:
+            bound_node, found = self._rrm.find_already_bound_reservation_node(executor)
+        except ReservationError as exc:
+            return None, FAILURE_INTERNAL, f"error when looking for already bound reservations: {exc}"
+        if found:
+            if bound_node in node_names:
+                return bound_node, SUCCESS_ALREADY_BOUND, ""
+            # bound node not offered; fall through (resource.go:377-388)
+
+        try:
+            unbound_nodes, found_unbound = self._rrm.find_unbound_reservation_nodes(executor)
+        except ReservationError as exc:
+            return None, FAILURE_INTERNAL, f"error when looking for unbound reservations: {exc}"
+        if found_unbound:
+            chosen = next((n for n in node_names if n in set(unbound_nodes)), None)
+            if chosen is not None:
+                try:
+                    self._rrm.reserve_for_executor_on_unbound_reservation(executor, chosen)
+                except ReservationError as exc:
+                    return None, FAILURE_INTERNAL, f"failed to reserve node for executor: {exc}"
+                return chosen, SUCCESS, ""
+
+        try:
+            free_spots = self._rrm.get_remaining_allowed_executor_count(
+                executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
+            )
+        except ReservationError as exc:
+            return None, FAILURE_INTERNAL, f"error when checking for remaining allowed executor count: {exc}"
+        if free_spots > 0:
+            is_extra = not found_unbound
+            node, outcome, msg = self._reschedule_executor(executor, node_names, is_extra)
+            if node is None:
+                return None, outcome, msg
+            try:
+                self._rrm.reserve_for_executor_on_rescheduled_node(executor, node)
+            except ReservationError as exc:
+                return None, FAILURE_INTERNAL, f"failed to reserve node for rescheduled executor: {exc}"
+            return node, outcome, msg
+
+        return None, FAILURE_UNBOUND, "application has no free executor spots to schedule this one"
+
+    def _reschedule_executor(
+        self, executor: Pod, node_names: list[str], is_extra: bool
+    ) -> tuple[Optional[str], str, str]:
+        """First executor-priority-ordered node with room (resource.go:565-639),
+        optionally restricted to the app's common AZ for single-AZ dynamic
+        allocation."""
+        driver = self._pod_lister.get_driver_for_executor(executor)
+        if driver is None:
+            return None, FAILURE_INTERNAL, "failed to get driver pod for executor"
+        try:
+            app_resources = spark_resources(driver)
+        except SparkPodError as exc:
+            return None, FAILURE_INTERNAL, str(exc)
+        exec_res = app_resources.executor_resources
+
+        nodes = [
+            n
+            for name in node_names
+            if (n := self._backend.get_node(name)) is not None
+        ]
+        single_az_zone: Optional[str] = None
+        if (
+            self.binpacker.is_single_az
+            and self._config.schedule_dynamically_allocated_executors_in_same_az
+        ):
+            try:
+                zone, all_same_az = self._common_zone_for_app(executor)
+            except ReservationError as exc:
+                # Reference errors the whole request here (resource.go:583-586)
+                # rather than falling back to any-AZ, preserving the
+                # single-AZ invariant; we surface it as failure-internal.
+                return None, FAILURE_INTERNAL, str(exc)
+            if all_same_az:
+                nodes = [n for n in nodes if n.zone == zone]
+                single_az_zone = zone
+
+        usage = self._rrm.get_reserved_resources()
+        overhead = self._overhead.get_overhead(nodes)
+        tensors = self._solver.build_tensors(nodes, usage, overhead)
+        # A 1-executor gang with no driver = "first sorted node with room".
+        packing = self._solver.pack(
+            "tightly-pack",
+            tensors,
+            type(exec_res).zero(),
+            exec_res,
+            1,
+            [n.name for n in nodes],
+        )
+        if packing.has_capacity and packing.executor_nodes:
+            outcome = SUCCESS_SCHEDULED_EXTRA_EXECUTOR if is_extra else SUCCESS_RESCHEDULED
+            return packing.executor_nodes[0], outcome, ""
+
+        if single_az_zone is not None:
+            self._demands.create_demand_for_executor(executor, exec_res, zone=single_az_zone)
+        else:
+            self._demands.create_demand_for_executor(executor, exec_res)
+        return None, FAILURE_FIT, "not enough capacity to reschedule the executor"
+
+    def _common_zone_for_app(self, executor: Pod) -> tuple[Optional[str], bool]:
+        """(zone, running pods all in one AZ?) (resource.go:472-506). Raises
+        ReservationError for the reference's error cases: no app-id label, no
+        running pods, or an unresolvable node — callers must fail the request
+        rather than fall back to any-AZ scheduling."""
+        app_id = executor.labels.get(SPARK_APP_ID_LABEL)
+        if app_id is None:
+            raise ReservationError(
+                "executor does not have a Spark app id label, could not create label selector"
+            )
+        pods = self._pod_lister.list_app_pods(app_id, executor.namespace)
+        zones = set()
+        for pod in pods:
+            if pod.phase != "Running" or not pod.node_name:
+                continue
+            node = self._backend.get_node(pod.node_name)
+            if node is None:
+                raise ReservationError(
+                    f"could not read zone label from node {pod.node_name}"
+                )
+            zones.add(node.zone)
+        if len(zones) > 1:
+            return None, False
+        if not zones:
+            raise ReservationError(
+                "application has no scheduled pods, can't make scheduling decisions based on AZ"
+            )
+        return next(iter(zones)), True

@@ -1,0 +1,85 @@
+"""Overhead accounting: resource requests of pods outside our reservations.
+
+Rebuilds internal/extender/overhead.go:32-209. The computer tracks pod
+requests per node via backend add/delete events (only pods bound to a node),
+and at query time counts a pod as overhead iff it has no hard or soft
+reservation. Non-schedulable overhead additionally excludes pods that belong
+to this scheduler (pods of OTHER schedulers only).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from spark_scheduler_tpu.models.kube import Pod
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.core.sparkpods import SPARK_SCHEDULER_NAME
+
+
+class OverheadComputer:
+    def __init__(self, backend, reservation_manager):
+        self._backend = backend
+        self._rrm = reservation_manager
+        self._lock = threading.RLock()
+        # node -> {pod uid: (namespace, name, requests)}
+        self._requests: dict[str, dict[str, tuple[str, str, Resources]]] = {}
+        backend.subscribe(
+            "pods",
+            on_add=self._on_pod_add,
+            on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete,
+        )
+        for pod in backend.list_pods():
+            self._on_pod_add(pod)
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if not pod.node_name:
+            return
+        with self._lock:
+            self._requests.setdefault(pod.node_name, {})[pod.uid] = (
+                pod.namespace,
+                pod.name,
+                pod.request(),
+            )
+
+    def _on_pod_update(self, old: Pod, new: Pod) -> None:
+        # The reference only watches add/delete (informers re-sync adds);
+        # we also catch the unbound->bound transition explicitly. On a node
+        # change, drop the stale entry first so the pod isn't double-counted.
+        if new.node_name and (not old.node_name or old.node_name != new.node_name):
+            if old.node_name:
+                self._on_pod_delete(old)
+            self._on_pod_add(new)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        if not pod.node_name:
+            return
+        with self._lock:
+            node = self._requests.get(pod.node_name)
+            if node is not None:
+                node.pop(pod.uid, None)
+                if not node:
+                    self._requests.pop(pod.node_name, None)
+
+    def _compute_node_overhead(self, node_name: str) -> tuple[Resources, Resources]:
+        """(overhead, non-schedulable overhead) for one node
+        (overhead.go:120-168)."""
+        with self._lock:
+            entries = list(self._requests.get(node_name, {}).values())
+        overhead = Resources.zero()
+        non_schedulable = Resources.zero()
+        for namespace, name, requests in entries:
+            pod = self._backend.get("pods", namespace, name)
+            if pod is None:
+                continue
+            if not self._rrm.pod_has_reservation(pod):
+                overhead.add(requests)
+                if pod.scheduler_name != SPARK_SCHEDULER_NAME:
+                    non_schedulable.add(requests)
+        return overhead, non_schedulable
+
+    def get_overhead(self, nodes) -> dict[str, Resources]:
+        return {n.name: self._compute_node_overhead(n.name)[0] for n in nodes}
+
+    def get_non_schedulable_overhead(self, nodes) -> dict[str, Resources]:
+        return {n.name: self._compute_node_overhead(n.name)[1] for n in nodes}

@@ -1,0 +1,289 @@
+"""ResourceReservationManager — hard + soft reservation lifecycle.
+
+Rebuilds internal/extender/resourcereservations.go:42-484: reservation
+creation for admitted gangs, the executor binding ladder (already-bound /
+unbound / rescheduled / soft), unbound-reservation discovery (slots whose
+executor is missing, dead, or moved), free soft spots, reserved-usage
+aggregation, and dynamic-allocation compaction (soft reservations migrate
+into freed hard slots when executors die).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_scheduler_tpu.models.kube import Pod
+from spark_scheduler_tpu.models.reservations import (
+    Reservation,
+    ResourceReservation,
+    new_resource_reservation,
+)
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.core.soft_reservations import SoftReservationStore
+from spark_scheduler_tpu.core.sparkpods import (
+    SPARK_APP_ID_LABEL,
+    SparkApplicationResources,
+    SparkPodLister,
+    is_spark_scheduler_executor_pod,
+    spark_resources,
+)
+
+
+class ReservationError(Exception):
+    """Maps to failure-internal outcomes."""
+
+
+class ResourceReservationManager:
+    def __init__(
+        self,
+        backend,
+        rr_cache,
+        soft_reservation_store: SoftReservationStore,
+        pod_lister: SparkPodLister,
+    ):
+        self._backend = backend
+        self.rr_cache = rr_cache
+        self.soft_store = soft_reservation_store
+        self.pod_lister = pod_lister
+        self._mutex = threading.RLock()
+        self._compaction_lock = threading.Lock()
+        self._compaction_apps: dict[str, str] = {}  # appID -> namespace
+        backend.subscribe("pods", on_delete=self._on_executor_pod_deletion)
+
+    # -- queries ------------------------------------------------------------
+
+    def get_resource_reservation(
+        self, app_id: str, namespace: str
+    ) -> Optional[ResourceReservation]:
+        return self.rr_cache.get(namespace, app_id)
+
+    def pod_has_reservation(self, pod: Pod) -> bool:
+        """Hard (Status.Pods) or soft reservation membership
+        (resourcereservations.go:88-104)."""
+        app_id = pod.labels.get(SPARK_APP_ID_LABEL)
+        if app_id is None:
+            return False
+        rr = self.get_resource_reservation(app_id, pod.namespace)
+        if rr is not None and pod.name in rr.status.pods.values():
+            return True
+        return is_spark_scheduler_executor_pod(
+            pod
+        ) and self.soft_store.executor_has_soft_reservation(pod)
+
+    def get_reserved_resources(self) -> dict[str, Resources]:
+        """Per-node hard+soft reservation usage (resourcereservations.go:228-233)."""
+        usage: dict[str, Resources] = {}
+        for rr in self.rr_cache.list():
+            for res in rr.spec.reservations.values():
+                usage.setdefault(res.node, Resources.zero()).add(res.resources)
+        for node, res in self.soft_store.used_soft_reservation_resources().items():
+            usage.setdefault(node, Resources.zero()).add(res)
+        return usage
+
+    # -- gang admission -----------------------------------------------------
+
+    def create_reservations(
+        self,
+        driver: Pod,
+        app_resources: SparkApplicationResources,
+        driver_node: str,
+        executor_nodes: list[str],
+    ) -> ResourceReservation:
+        app_id = driver.labels.get(SPARK_APP_ID_LABEL, driver.name)
+        rr = self.get_resource_reservation(app_id, driver.namespace)
+        if rr is None:
+            rr = new_resource_reservation(
+                driver_node,
+                executor_nodes,
+                driver,
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+            )
+            if not self.rr_cache.create(rr):
+                raise ReservationError(f"failed to create resource reservation {rr.name}")
+        if app_resources.max_executor_count > app_resources.min_executor_count:
+            # only dynamic-allocation apps get a soft-reservation shell
+            self.soft_store.create_soft_reservation_if_not_exists(app_id)
+        return rr
+
+    # -- executor binding ladder -------------------------------------------
+
+    def find_already_bound_reservation_node(
+        self, executor: Pod
+    ) -> tuple[Optional[str], bool]:
+        """Idempotent retry path (resourcereservations.go:133-149)."""
+        rr = self.get_resource_reservation(
+            executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
+        )
+        if rr is None:
+            raise ReservationError("failed to get resource reservations")
+        for name, res in rr.spec.reservations.items():
+            if rr.status.pods.get(name) == executor.name:
+                return res.node, True
+        sr = self.soft_store.get_executor_soft_reservation(executor)
+        if sr is not None:
+            return sr.node, True
+        return None, False
+
+    def find_unbound_reservation_nodes(self, executor: Pod) -> tuple[list[str], bool]:
+        unbound = self._get_unbound_reservations(
+            executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
+        )
+        nodes = sorted(set(unbound.values()))
+        return nodes, bool(nodes)
+
+    def get_remaining_allowed_executor_count(self, app_id: str, namespace: str) -> int:
+        unbound = self._get_unbound_reservations(app_id, namespace)
+        return len(unbound) + self._get_free_soft_reservation_spots(app_id, namespace)
+
+    def reserve_for_executor_on_unbound_reservation(
+        self, executor: Pod, node: str
+    ) -> None:
+        with self._mutex:
+            unbound = self._get_unbound_reservations(
+                executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
+            )
+            for res_name, res_node in unbound.items():
+                if res_node == node:
+                    self._bind_executor_to_resource_reservation(
+                        executor, res_name, node
+                    )
+                    return
+        raise ReservationError(
+            "failed to find free reservation on requested node for executor"
+        )
+
+    def reserve_for_executor_on_rescheduled_node(
+        self, executor: Pod, node: str
+    ) -> None:
+        """Bind to ANY unbound hard slot (moving it to `node`), else to a
+        soft reservation (resourcereservations.go:202-225)."""
+        with self._mutex:
+            app_id = executor.labels.get(SPARK_APP_ID_LABEL, "")
+            unbound = self._get_unbound_reservations(app_id, executor.namespace)
+            if unbound:
+                res_name = next(iter(unbound))
+                self._bind_executor_to_resource_reservation(executor, res_name, node)
+                return
+            if self._get_free_soft_reservation_spots(app_id, executor.namespace) > 0:
+                self._bind_executor_to_soft_reservation(executor, node)
+                return
+        raise ReservationError("failed to find free reservation for executor")
+
+    # -- compaction ---------------------------------------------------------
+
+    def compact_dynamic_allocation_applications(self) -> None:
+        """Migrate soft reservations of live executors into freed hard slots
+        (resourcereservations.go:238-268). Apps are queued by the executor
+        pod-deletion handler and drained here, on the request path."""
+        with self._compaction_lock:
+            drained, self._compaction_apps = self._compaction_apps, {}
+        with self._mutex:
+            for app_id, namespace in drained.items():
+                sr, ok = self.soft_store.get_soft_reservation(app_id)
+                if not ok:
+                    continue
+                pods = self._get_active_pods(app_id, namespace)
+                for pod_name in list(sr.reservations):
+                    pod = pods.get(pod_name)
+                    if pod is None:
+                        continue  # no longer active
+                    self._compact_soft_reservation_pod(pod)
+
+    def _compact_soft_reservation_pod(self, pod: Pod) -> None:
+        app_id = pod.labels.get(SPARK_APP_ID_LABEL, "")
+        unbound = self._get_unbound_reservations(app_id, pod.namespace)
+        if not unbound:
+            return
+        # Prefer a slot already on the pod's node (resourcereservations.go:283-301)
+        for res_name, res_node in unbound.items():
+            if res_node == pod.node_name:
+                self._bind_executor_to_resource_reservation(pod, res_name, res_node)
+                self.soft_store.remove_executor_reservation(app_id, pod.name)
+                return
+        res_name = next(iter(unbound))
+        self._bind_executor_to_resource_reservation(pod, res_name, unbound[res_name])
+        self.soft_store.remove_executor_reservation(app_id, pod.name)
+
+    # -- internals ----------------------------------------------------------
+
+    def _bind_executor_to_resource_reservation(
+        self, executor: Pod, reservation_name: str, node: str
+    ) -> None:
+        rr = self.get_resource_reservation(
+            executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
+        )
+        if rr is None:
+            raise ReservationError(
+                f"failed to get resource reservation {reservation_name}"
+            )
+        updated = rr.copy()
+        res = updated.spec.reservations[reservation_name]
+        res.node = node
+        updated.status.pods[reservation_name] = executor.name
+        if not self.rr_cache.update(updated):
+            raise ReservationError(
+                f"failed to update resource reservation {reservation_name}"
+            )
+
+    def _bind_executor_to_soft_reservation(self, executor: Pod, node: str) -> None:
+        driver = self.pod_lister.get_driver_for_executor(executor)
+        if driver is None:
+            raise ReservationError("failed to get driver pod for executor")
+        app_resources = spark_resources(driver)
+        self.soft_store.add_reservation_for_pod(
+            driver.labels.get(SPARK_APP_ID_LABEL, ""),
+            executor.name,
+            Reservation(node, app_resources.executor_resources.copy()),
+        )
+
+    def _get_unbound_reservations(self, app_id: str, namespace: str) -> dict[str, str]:
+        """Slots not bound to an active pod, bound to a dead pod, or bound to
+        a pod that landed on a different node (resourcereservations.go:358-380)."""
+        rr = self.get_resource_reservation(app_id, namespace)
+        if rr is None:
+            raise ReservationError("failed to get resource reservation")
+        active = self._get_active_pods(app_id, namespace)
+        unbound: dict[str, str] = {}
+        for res_name, res in rr.spec.reservations.items():
+            pod_name = rr.status.pods.get(res_name)
+            pod = active.get(pod_name) if pod_name is not None else None
+            if (
+                pod_name is None
+                or pod is None
+                or (pod.node_name and pod.node_name != res.node)
+            ):
+                unbound[res_name] = res.node
+        return unbound
+
+    def _get_free_soft_reservation_spots(self, app_id: str, namespace: str) -> int:
+        sr, ok = self.soft_store.get_soft_reservation(app_id)
+        if not ok:
+            return 0
+        used = len(sr.reservations)
+        driver = self.pod_lister.get_driver_pod(app_id, namespace)
+        if driver is None:
+            return 0
+        app_resources = spark_resources(driver)
+        allowed = app_resources.max_executor_count - app_resources.min_executor_count
+        return max(allowed - used, 0)
+
+    def _get_active_pods(self, app_id: str, namespace: str) -> dict[str, Pod]:
+        return {
+            p.name: p
+            for p in self.pod_lister.list_app_pods(app_id, namespace)
+            if not p.is_terminated()
+        }
+
+    def _on_executor_pod_deletion(self, pod: Pod) -> None:
+        if not is_spark_scheduler_executor_pod(pod):
+            return
+        _, has_app = self.soft_store.get_soft_reservation(
+            pod.labels.get(SPARK_APP_ID_LABEL, "")
+        )
+        if has_app and not self.soft_store.executor_has_soft_reservation(pod):
+            with self._compaction_lock:
+                self._compaction_apps[pod.labels.get(SPARK_APP_ID_LABEL, "")] = (
+                    pod.namespace
+                )

@@ -1,0 +1,135 @@
+"""In-memory soft reservations for dynamic-allocation extra executors.
+
+Rebuilds internal/cache/softreservations.go:32-254, including the tombstone
+`status` map that defeats the race between an executor's death event and a
+late scheduling request for the same executor: once an executor name is
+marked dead (status[name]=False), AddReservationForPod is a no-op for it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from spark_scheduler_tpu.models.kube import Pod
+from spark_scheduler_tpu.models.reservations import Reservation
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.core.sparkpods import (
+    ROLE_DRIVER,
+    ROLE_EXECUTOR,
+    SPARK_APP_ID_LABEL,
+    SPARK_ROLE_LABEL,
+    is_spark_scheduler_pod,
+)
+
+
+@dataclasses.dataclass
+class SoftReservation:
+    reservations: dict[str, Reservation] = dataclasses.field(default_factory=dict)
+    status: dict[str, bool] = dataclasses.field(default_factory=dict)
+
+    def copy(self) -> "SoftReservation":
+        return SoftReservation(
+            reservations={k: v.copy() for k, v in self.reservations.items()},
+            status=dict(self.status),
+        )
+
+
+class SoftReservationStore:
+    def __init__(self, backend=None):
+        self._store: dict[str, SoftReservation] = {}
+        self._lock = threading.RLock()
+        if backend is not None:
+            backend.subscribe("pods", on_delete=self._on_pod_deletion)
+
+    # -- queries ------------------------------------------------------------
+
+    def get_soft_reservation(self, app_id: str) -> tuple[SoftReservation, bool]:
+        with self._lock:
+            sr = self._store.get(app_id)
+            if sr is None:
+                return SoftReservation(), False
+            return sr.copy(), True
+
+    def get_all_copy(self) -> dict[str, SoftReservation]:
+        with self._lock:
+            return {k: v.copy() for k, v in self._store.items()}
+
+    def executor_has_soft_reservation(self, executor: Pod) -> bool:
+        return self.get_executor_soft_reservation(executor) is not None
+
+    def get_executor_soft_reservation(self, executor: Pod) -> Reservation | None:
+        app_id = executor.labels.get(SPARK_APP_ID_LABEL)
+        if app_id is None:
+            return None
+        with self._lock:
+            sr = self._store.get(app_id)
+            if sr is not None and executor.name in sr.reservations:
+                return sr.reservations[executor.name].copy()
+        return None
+
+    def used_soft_reservation_resources(self) -> dict[str, Resources]:
+        """Per-node usage of all live soft reservations
+        (softreservations.go:155-172)."""
+        with self._lock:
+            out: dict[str, Resources] = {}
+            for sr in self._store.values():
+                for r in sr.reservations.values():
+                    out.setdefault(r.node, Resources.zero()).add(r.resources)
+            return out
+
+    # -- mutations ----------------------------------------------------------
+
+    def create_soft_reservation_if_not_exists(self, app_id: str) -> None:
+        with self._lock:
+            self._store.setdefault(app_id, SoftReservation())
+
+    def add_reservation_for_pod(
+        self, app_id: str, pod_name: str, reservation: Reservation
+    ) -> None:
+        with self._lock:
+            sr = self._store.get(app_id)
+            if sr is None:
+                raise KeyError(
+                    f"cannot add soft reservation: app {app_id} not in store"
+                )
+            if pod_name in sr.status:
+                # tombstoned (dead) or already reserved: no-op
+                # (softreservations.go:119-127)
+                return
+            sr.reservations[pod_name] = reservation
+            sr.status[pod_name] = True
+
+    def remove_executor_reservation(self, app_id: str, executor_name: str) -> None:
+        with self._lock:
+            sr = self._store.get(app_id)
+            if sr is None:
+                return
+            sr.reservations.pop(executor_name, None)
+            # Always tombstone: remember the death to beat the
+            # death-event/schedule-request race (softreservations.go:197-210).
+            sr.status[executor_name] = False
+
+    def remove_driver_reservation(self, app_id: str) -> None:
+        with self._lock:
+            self._store.pop(app_id, None)
+
+    def _on_pod_deletion(self, pod: Pod) -> None:
+        if not is_spark_scheduler_pod(pod):
+            return
+        app_id = pod.labels.get(SPARK_APP_ID_LABEL, "")
+        role = pod.labels.get(SPARK_ROLE_LABEL)
+        if role == ROLE_DRIVER:
+            self.remove_driver_reservation(app_id)
+        elif role == ROLE_EXECUTOR:
+            self.remove_executor_reservation(app_id, pod.name)
+
+    # -- metrics ------------------------------------------------------------
+
+    def application_count(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    def active_extra_executor_count(self) -> int:
+        with self._lock:
+            return sum(len(sr.reservations) for sr in self._store.values())

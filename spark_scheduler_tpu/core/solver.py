@@ -1,0 +1,148 @@
+"""PlacementSolver — the host <-> device boundary of the scheduler.
+
+Everything above this module speaks names and Resources; everything below it
+(ops/) speaks int32 tensors over a stable node-index space. The solver:
+
+  - interns nodes into the NodeRegistry and builds ClusterTensors with
+    padded (bucketed) shapes so XLA compile caches stay warm across node
+    count / executor count jitter (SURVEY.md §7 "Dynamic shapes");
+  - dispatches to the jitted packing kernels;
+  - maps Packing index results back to node names.
+
+This replaces the reference's per-request map-building + sort + greedy loops
+(resource.go:287-323) with one device program per request.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from spark_scheduler_tpu.models.cluster import (
+    NodeRegistry,
+    build_cluster_tensors,
+)
+from spark_scheduler_tpu.models.kube import Node
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.ops import BINPACK_FUNCTIONS
+from spark_scheduler_tpu.ops.efficiency import avg_packing_efficiency
+
+
+def _bucket(n: int, minimum: int) -> int:
+    out = minimum
+    while out < n:
+        out *= 2
+    return out
+
+
+class HostPacking(NamedTuple):
+    driver_node: Optional[str]
+    executor_nodes: list[str]
+    has_capacity: bool
+    efficiency_max: float
+    efficiency_cpu: float
+    efficiency_memory: float
+    efficiency_gpu: float
+
+
+class PlacementSolver:
+    def __init__(
+        self,
+        driver_label_priority: tuple[str, list[str]] | None = None,
+        executor_label_priority: tuple[str, list[str]] | None = None,
+    ):
+        self.registry = NodeRegistry()
+        self._driver_label_priority = driver_label_priority
+        self._executor_label_priority = executor_label_priority
+
+    def build_tensors(
+        self,
+        nodes: Sequence[Node],
+        usage: dict[str, Resources],
+        overhead: dict[str, Resources],
+    ):
+        for n in nodes:
+            self.registry.intern(n.name)
+        pad = _bucket(self.registry.capacity, 8)
+        return build_cluster_tensors(
+            list(nodes),
+            usage,
+            overhead,
+            self.registry,
+            driver_label_priority=self._driver_label_priority,
+            executor_label_priority=self._executor_label_priority,
+            pad_to=pad,
+        )
+
+    def candidate_mask(self, tensors, node_names: Sequence[str]) -> np.ndarray:
+        n = tensors.available.shape[0]
+        mask = np.zeros(n, dtype=bool)
+        for name in node_names:
+            idx = self.registry.index_of(name)
+            if idx is not None and idx < n:
+                mask[idx] = True
+        return mask
+
+    def _num_zones_bucket(self) -> int:
+        return _bucket(max(len(self.registry._zone_names), 1), 2)
+
+    def pack(
+        self,
+        strategy: str,
+        tensors,
+        driver_resources: Resources,
+        executor_resources: Resources,
+        executor_count: int,
+        driver_candidate_names: Sequence[str],
+        domain_mask: np.ndarray | None = None,
+    ) -> HostPacking:
+        fn = BINPACK_FUNCTIONS[strategy]
+        n = tensors.available.shape[0]
+        driver_mask = self.candidate_mask(tensors, driver_candidate_names)
+        if domain_mask is None:
+            domain_mask = np.asarray(tensors.valid)
+        emax = _bucket(max(executor_count, 1), 8)
+        packing = fn(
+            tensors,
+            jnp.asarray(driver_resources.as_array()),
+            jnp.asarray(executor_resources.as_array()),
+            jnp.int32(executor_count),
+            jnp.asarray(driver_mask),
+            jnp.asarray(domain_mask),
+            emax=emax,
+            num_zones=self._num_zones_bucket(),
+        )
+        eff = avg_packing_efficiency(
+            tensors,
+            packing.driver_node,
+            packing.executor_nodes,
+            jnp.asarray(driver_resources.as_array()),
+            jnp.asarray(executor_resources.as_array()),
+        )
+        has_cap = bool(packing.has_capacity)
+        driver_idx = int(packing.driver_node)
+        exec_idx = [int(x) for x in np.asarray(packing.executor_nodes) if int(x) >= 0]
+        return HostPacking(
+            driver_node=self.registry.name_of(driver_idx) if driver_idx >= 0 else None,
+            executor_nodes=[self.registry.name_of(i) for i in exec_idx],
+            has_capacity=has_cap,
+            efficiency_max=float(eff.max),
+            efficiency_cpu=float(eff.cpu),
+            efficiency_memory=float(eff.memory),
+            efficiency_gpu=float(eff.gpu),
+        )
+
+    def subtract_usage(self, tensors, usage: dict[str, Resources]):
+        """Subtract per-node usage from availability in-place-equivalent
+        (NodeGroupSchedulingMetadata.SubtractUsageIfExists,
+        resources.go:128-135); returns new tensors."""
+        avail = np.array(tensors.available)
+        for name, res in usage.items():
+            idx = self.registry.index_of(name)
+            if idx is not None and idx < avail.shape[0]:
+                avail[idx] = avail[idx] - res.as_array()
+        import dataclasses as _dc
+
+        return _dc.replace(tensors, available=avail)

@@ -24,7 +24,7 @@ incremental scatter updates to device-resident state stay cheap.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterable, Mapping
+from typing import Mapping
 
 import numpy as np
 
@@ -133,21 +133,6 @@ class NodeRegistry:
 
     def names(self) -> list[str | None]:
         return list(self._names)
-
-
-def usage_for_nodes(
-    reservations: Iterable, registry: NodeRegistry, num_nodes: int
-) -> np.ndarray:
-    """[N,3] reservation usage tensor from ResourceReservation records
-    (resources.go:31-44 UsageForNodes). `reservations` yields objects with a
-    `.spec.reservations: dict[str, Reservation{node, resources}]`."""
-    usage = np.zeros((num_nodes, NUM_DIMS), dtype=np.int64)
-    for rr in reservations:
-        for res in rr.spec.reservations.values():
-            idx = registry.index_of(res.node)
-            if idx is not None and idx < num_nodes:
-                usage[idx] += res.resources.as_array()
-    return np.clip(usage, -INT32_INF, INT32_INF).astype(np.int32)
 
 
 def resources_map_to_tensor(

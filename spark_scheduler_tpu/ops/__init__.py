@@ -1,4 +1,4 @@
-"""XLA compute kernels: capacity, node sorting, bin-packing strategies, FIFO.
+"""XLA compute kernels: capacity, node sorting, bin-packing strategies.
 
 Every kernel is a pure jittable function over `ClusterTensors` + app-shape
 arrays. The five packing strategies of the reference

@@ -56,10 +56,23 @@ def avg_packing_efficiency(
     executor_nodes: jnp.ndarray,
     driver_req: jnp.ndarray,
     exec_req: jnp.ndarray,
+    *,
+    include_executors_in_reserved: bool = True,
 ) -> AvgEfficiency:
+    """`include_executors_in_reserved=False` reproduces a reference quirk:
+    `minimalFragmentation` never writes executors into reservedResources
+    (minimal_fragmentation.go:68-98, unlike pack_tightly.go:45-49 and
+    distribute_evenly.go:58-60), so packing efficiencies — and therefore
+    single-AZ zone selection — only see the driver's tentative reservation
+    for that strategy. The ENTRIES averaged over are still driver + one per
+    executor occurrence (single_az.go:84-97) in both modes."""
     n = cluster.available.shape[0]
     new_res = new_reservation_tensor(
-        n, driver_node, executor_nodes, driver_req, exec_req
+        n,
+        driver_node,
+        jnp.where(include_executors_in_reserved, executor_nodes, -1),
+        driver_req,
+        exec_req,
     )
     # schedulable - available = current reservation usage (efficiency.go:85-92).
     reserved_total = (cluster.schedulable - cluster.available) + new_res

@@ -90,7 +90,8 @@ def _check_cumsum_bound(n: int, emax: int) -> None:
     if n * emax >= 2**31:
         raise ValueError(
             f"n_nodes*emax = {n}*{emax} >= 2^31: int32 prefix sums would "
-            "overflow; shard the node axis (see spark_scheduler_tpu.parallel)"
+            "overflow; shard the node axis across devices instead of packing "
+            "a single flat tensor"
         )
 
 
@@ -299,7 +300,15 @@ def _single_az_pack(
 
     effs = jax.vmap(
         lambda p: eff_ops.avg_packing_efficiency(
-            cluster, p.driver_node, p.executor_nodes, driver_req, exec_req
+            cluster,
+            p.driver_node,
+            p.executor_nodes,
+            driver_req,
+            exec_req,
+            # minimalFragmentation never adds executors to reservedResources
+            # in the reference, so its zone scores are driver-only (see
+            # efficiency.avg_packing_efficiency docstring).
+            include_executors_in_reserved=(fill != "minimal-fragmentation"),
         ).max
     )(packs)
     valid_zone = packs.has_capacity & (zone_first < INT32_INF) & zone_has_exec

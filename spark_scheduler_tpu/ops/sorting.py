@@ -33,23 +33,42 @@ def zone_ranks(
     (nodesorting.go:101-104, 124-134). Zones with no domain nodes rank last."""
     mask = domain_mask & cluster.valid
 
-    def _zone_sum_hi_lo(vals: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-        # Exact int32-safe aggregation: split each value into (hi, lo) 16-bit
-        # halves, segment-sum each, then carry lo into hi. Exact for up to
-        # 32k nodes per zone without needing x64 (TPU int64 emulation).
+    def _zone_sum_chunks(vals: jnp.ndarray) -> list[jnp.ndarray]:
+        # Exact int32-safe aggregation without x64: split each value into
+        # four 8-bit chunks (top chunk keeps the sign via arithmetic shift),
+        # segment-sum each, then normalize carries upward. Each low-chunk
+        # sum is <= n*255, exact for n < 2^23 nodes; the top-chunk sum is
+        # bounded by n*2^7 after the shift. Chunks returned most-significant
+        # first, comparable lexicographically.
         v = jnp.where(mask, vals, 0)
-        hi = jnp.zeros(num_zones, jnp.int32).at[cluster.zone_id].add(v >> 16)
-        lo = jnp.zeros(num_zones, jnp.int32).at[cluster.zone_id].add(v & 0xFFFF)
-        return hi + (lo >> 16), lo & 0xFFFF
 
-    mem_hi, mem_lo = _zone_sum_hi_lo(cluster.available[:, MEM_DIM])
-    cpu_hi, cpu_lo = _zone_sum_hi_lo(cluster.available[:, CPU_DIM])
+        def seg(x):
+            return jnp.zeros(num_zones, jnp.int32).at[cluster.zone_id].add(x)
+
+        s3 = seg(v >> 24)
+        s2 = seg((v >> 16) & 0xFF)
+        s1 = seg((v >> 8) & 0xFF)
+        s0 = seg(v & 0xFF)
+        s1 = s1 + (s0 >> 8)
+        s0 = s0 & 0xFF
+        s2 = s2 + (s1 >> 8)
+        s1 = s1 & 0xFF
+        s3 = s3 + (s2 >> 8)
+        s2 = s2 & 0xFF
+        return [s3, s2, s1, s0]
+
+    mem_k = _zone_sum_chunks(cluster.available[:, MEM_DIM])
+    cpu_k = _zone_sum_chunks(cluster.available[:, CPU_DIM])
     present = jnp.zeros(num_zones, jnp.bool_).at[cluster.zone_id].max(mask)
     # Absent zones last; ties between zones are unordered in the reference
-    # (map iteration); pin with zone id.
-    order = jnp.lexsort(
-        (jnp.arange(num_zones), cpu_lo, cpu_hi, mem_lo, mem_hi, jnp.where(present, 0, 1))
+    # (map iteration); pin with zone id. lexsort: last key is primary.
+    keys = (
+        [jnp.arange(num_zones)]
+        + list(reversed(cpu_k))
+        + list(reversed(mem_k))
+        + [jnp.where(present, 0, 1)]
     )
+    order = jnp.lexsort(keys)
     ranks = jnp.zeros(num_zones, jnp.int32).at[order].set(
         jnp.arange(num_zones, dtype=jnp.int32)
     )

@@ -1,0 +1,160 @@
+"""Dependency wiring — the initServer DI graph (cmd/server.go:56-266).
+
+`build_scheduler_app` assembles every component of the scheduler around a
+ClusterBackend: caches with async write-back, soft-reservation store,
+reservation manager, overhead computer, demand manager + GC, failover
+reconciler, placement solver, the extender, and the unschedulable-pod
+marker. The same builder serves tests (sync writes, in-memory backend) and
+the HTTP server (async write-back, background loops).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from spark_scheduler_tpu.core.binpacker import select_binpacker
+from spark_scheduler_tpu.core.demands import DemandManager, start_demand_gc
+from spark_scheduler_tpu.core.extender import ExtenderConfig, SparkSchedulerExtender
+from spark_scheduler_tpu.core.failover import FailoverReconciler
+from spark_scheduler_tpu.core.overhead import OverheadComputer
+from spark_scheduler_tpu.core.reservation_manager import ResourceReservationManager
+from spark_scheduler_tpu.core.solver import PlacementSolver
+from spark_scheduler_tpu.core.soft_reservations import SoftReservationStore
+from spark_scheduler_tpu.core.sparkpods import SparkPodLister
+from spark_scheduler_tpu.core.unschedulable import UnschedulablePodMarker
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.store.backend import ClusterBackend
+from spark_scheduler_tpu.store.cache import ResourceReservationCache, SafeDemandCache
+
+
+@dataclasses.dataclass
+class SchedulerApp:
+    backend: ClusterBackend
+    config: InstallConfig
+    rr_cache: ResourceReservationCache
+    demand_cache: SafeDemandCache
+    soft_store: SoftReservationStore
+    pod_lister: SparkPodLister
+    reservation_manager: ResourceReservationManager
+    overhead_computer: OverheadComputer
+    demand_manager: DemandManager
+    reconciler: FailoverReconciler
+    solver: PlacementSolver
+    extender: SparkSchedulerExtender
+    unschedulable_marker: UnschedulablePodMarker
+
+    def start_background(self) -> None:
+        """Async write-back workers + background loops (cmd/server.go:239-247)."""
+        self.rr_cache.start()
+        self.unschedulable_marker.start()
+
+    def stop(self) -> None:
+        self.unschedulable_marker.stop()
+        self.rr_cache.flush()
+        self.rr_cache.stop()
+        self.demand_cache.flush()
+        self.demand_cache.stop()
+
+
+def build_scheduler_app(
+    backend: ClusterBackend,
+    config: InstallConfig | None = None,
+    metrics=None,
+    events=None,
+    clock=None,
+) -> SchedulerApp:
+    import time as _time
+
+    config = config or InstallConfig()
+    clock = clock or _time.time
+
+    rr_cache = ResourceReservationCache(
+        backend,
+        max_retries=config.async_client_retry_count,
+        sync_writes=config.sync_writes,
+    )
+    demand_cache = SafeDemandCache(
+        backend,
+        max_retries=config.async_client_retry_count,
+        sync_writes=config.sync_writes,
+    )
+    soft_store = SoftReservationStore(backend)
+    pod_lister = SparkPodLister(backend, config.instance_group_label)
+    reservation_manager = ResourceReservationManager(
+        backend, rr_cache, soft_store, pod_lister
+    )
+    overhead_computer = OverheadComputer(backend, reservation_manager)
+    binpacker = select_binpacker(config.binpack_algo)
+    demand_manager = DemandManager(
+        backend,
+        demand_cache,
+        config.instance_group_label,
+        is_single_az_binpacker=binpacker.is_single_az,
+        events=events,
+    )
+    start_demand_gc(backend, demand_manager)
+    solver = PlacementSolver(
+        driver_label_priority=(
+            config.driver_prioritized_node_label.as_tuple()
+            if config.driver_prioritized_node_label
+            else None
+        ),
+        executor_label_priority=(
+            config.executor_prioritized_node_label.as_tuple()
+            if config.executor_prioritized_node_label
+            else None
+        ),
+    )
+    reconciler = FailoverReconciler(
+        backend,
+        pod_lister,
+        rr_cache,
+        soft_store,
+        demand_manager,
+        overhead_computer,
+        config.instance_group_label,
+    )
+    extender = SparkSchedulerExtender(
+        backend,
+        pod_lister,
+        reservation_manager,
+        demand_manager,
+        overhead_computer,
+        binpacker,
+        solver,
+        ExtenderConfig(
+            fifo=config.fifo,
+            fifo_config=config.fifo_config,
+            instance_group_label=config.instance_group_label,
+            schedule_dynamically_allocated_executors_in_same_az=(
+                config.should_schedule_dynamically_allocated_executors_in_same_az
+            ),
+        ),
+        reconciler=reconciler,
+        metrics=metrics,
+        events=events,
+        clock=clock,
+    )
+    marker = UnschedulablePodMarker(
+        backend,
+        overhead_computer,
+        binpacker,
+        solver,
+        timeout_s=config.unschedulable_pod_timeout_s,
+        clock=clock,
+    )
+    return SchedulerApp(
+        backend=backend,
+        config=config,
+        rr_cache=rr_cache,
+        demand_cache=demand_cache,
+        soft_store=soft_store,
+        pod_lister=pod_lister,
+        reservation_manager=reservation_manager,
+        overhead_computer=overhead_computer,
+        demand_manager=demand_manager,
+        reconciler=reconciler,
+        solver=solver,
+        extender=extender,
+        unschedulable_marker=marker,
+    )

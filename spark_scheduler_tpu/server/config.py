@@ -1,0 +1,98 @@
+"""Install-time configuration (config/config.go:24-84).
+
+YAML-loadable install config with the reference's option surface: FIFO mode
++ age-based enforcement per instance group, binpack algorithm selection,
+async write-back retry budget, unschedulable-pod timeout, prioritized node
+labels for driver/executor sorting, single-AZ dynamic-allocation flag, and
+the serving port. `from_yaml` accepts the reference's field names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from spark_scheduler_tpu.core.extender import FifoConfig
+
+
+@dataclasses.dataclass
+class LabelPriorityOrder:
+    """config.LabelPriorityOrder (config/config.go:66-70)."""
+
+    name: str
+    descending_priority_values: list[str]
+
+    def as_tuple(self) -> tuple[str, list[str]]:
+        return (self.name, self.descending_priority_values)
+
+
+@dataclasses.dataclass
+class InstallConfig:
+    fifo: bool = False
+    fifo_config: FifoConfig = dataclasses.field(default_factory=FifoConfig)
+    binpack_algo: str = "tightly-pack"
+    instance_group_label: str = "instance-group"
+    async_client_retry_count: int = 5
+    unschedulable_pod_timeout_s: float = 600.0
+    should_schedule_dynamically_allocated_executors_in_same_az: bool = False
+    driver_prioritized_node_label: Optional[LabelPriorityOrder] = None
+    executor_prioritized_node_label: Optional[LabelPriorityOrder] = None
+    port: int = 8484
+    sync_writes: bool = False  # drain write-back inline (tests/single-thread)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "InstallConfig":
+        fifo_cfg = FifoConfig()
+        if "fifo-config" in raw:
+            fc = raw["fifo-config"]
+            fifo_cfg = FifoConfig(
+                enforce_after_pod_age_s=_parse_duration(
+                    fc.get("default-enforce-after-pod-age", 0)
+                ),
+                enforce_after_pod_age_by_instance_group={
+                    k: _parse_duration(v)
+                    for k, v in fc.get("enforce-after-pod-age-by-instance-group", {}).items()
+                },
+            )
+
+        def label_prio(key):
+            if key not in raw:
+                return None
+            return LabelPriorityOrder(
+                name=raw[key]["name"],
+                descending_priority_values=list(
+                    raw[key]["descending-priority-values"]
+                ),
+            )
+
+        return cls(
+            fifo=bool(raw.get("fifo", False)),
+            fifo_config=fifo_cfg,
+            binpack_algo=raw.get("binpack-algo", "tightly-pack"),
+            instance_group_label=raw.get("instance-group-label", "instance-group"),
+            async_client_retry_count=int(raw.get("async-client-retry-count", 5)),
+            unschedulable_pod_timeout_s=_parse_duration(
+                raw.get("unschedulable-pod-timeout", 600.0)
+            ),
+            should_schedule_dynamically_allocated_executors_in_same_az=bool(
+                raw.get(
+                    "should-schedule-dynamically-allocated-executors-in-same-az",
+                    False,
+                )
+            ),
+            driver_prioritized_node_label=label_prio("driver-prioritized-node-label"),
+            executor_prioritized_node_label=label_prio("executor-prioritized-node-label"),
+            port=int(raw.get("port", 8484)),
+        )
+
+
+def _parse_duration(val) -> float:
+    """'10m' / '30s' / '1h' / numeric seconds -> seconds."""
+    if isinstance(val, (int, float)):
+        return float(val)
+    s = str(val).strip()
+    units = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    for suffix in ("ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    return float(s)

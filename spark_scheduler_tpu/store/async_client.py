@@ -1,0 +1,177 @@
+"""Async write-back workers.
+
+Rebuilds internal/cache/async.go:44-224: N worker threads (one per queue
+shard) drain write requests and replay them against the backend. Create and
+update read the CURRENT object from the local store at drain time (so
+compacted consecutive writes collapse into one request carrying the latest
+state); conflicts re-read the backend object, fast-forward the stored
+resourceVersion and retry; failures retry up to `max_retries` then drop with
+a metric. Creates into terminating namespaces are dropped (async.go:88-96);
+deletes of already-gone objects succeed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from spark_scheduler_tpu.store.backend import (
+    AlreadyExistsError,
+    ClusterBackend,
+    ConflictError,
+    NamespaceTerminatingError,
+    NotFoundError,
+)
+from spark_scheduler_tpu.store.object_store import ObjectStore
+from spark_scheduler_tpu.store.queue import Request, RequestType, ShardedUniqueQueue, drain_one
+
+DEFAULT_MAX_RETRIES = 5  # config.go:72-77
+
+
+class AsyncClientMetrics:
+    """Counters mirroring AsyncClientMetrics (async.go:180-224)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.applied: dict[str, int] = {}
+        self.retries = 0
+        self.dropped = 0
+        self.conflicts = 0
+
+    def mark_applied(self, verb: str) -> None:
+        with self.lock:
+            self.applied[verb] = self.applied.get(verb, 0) + 1
+
+    def mark_retry(self) -> None:
+        with self.lock:
+            self.retries += 1
+
+    def mark_dropped(self) -> None:
+        with self.lock:
+            self.dropped += 1
+
+    def mark_conflict(self) -> None:
+        with self.lock:
+            self.conflicts += 1
+
+
+class AsyncClient:
+    """Write-back pump between an ObjectStore and a backend kind."""
+
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        kind: str,
+        store: ObjectStore,
+        queue: ShardedUniqueQueue,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+        metrics: Optional[AsyncClientMetrics] = None,
+        on_error: Optional[Callable[[Request, Exception], None]] = None,
+    ):
+        self._backend = backend
+        self._kind = kind
+        self._store = store
+        self._queue = queue
+        self._max_retries = max_retries
+        self.metrics = metrics or AsyncClientMetrics()
+        self._on_error = on_error
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        for i, q in enumerate(self._queue.consumers()):
+            t = threading.Thread(
+                target=self._run_worker, args=(q,), daemon=True,
+                name=f"async-{self._kind}-{i}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run_worker(self, q) -> None:
+        while not self._stop.is_set():
+            req = drain_one(q, timeout=0.05)
+            if req is not None:
+                self.process(req)
+
+    def drain_sync(self) -> None:
+        """Synchronously drain every shard — deterministic test mode and
+        graceful-shutdown flush."""
+        for q in self._queue.consumers():
+            while True:
+                req = drain_one(q, timeout=0)
+                if req is None:
+                    break
+                self.process(req)
+
+    # -- request processing -------------------------------------------------
+
+    def process(self, req: Request) -> None:
+        try:
+            if req.type == RequestType.CREATE:
+                self._do_create(req)
+            elif req.type == RequestType.UPDATE:
+                self._do_update(req)
+            else:
+                self._do_delete(req)
+        except NamespaceTerminatingError:
+            self.metrics.mark_dropped()  # not retryable (async.go:88-96)
+        except Exception as exc:  # bounded retry (async.go:139-154)
+            self._maybe_retry(req, exc)
+
+    def _do_create(self, req: Request) -> None:
+        obj = self._store.get(*req.key)
+        if obj is None:
+            return  # deleted since enqueue
+        try:
+            created = self._backend.create(self._kind, obj)
+        except AlreadyExistsError:
+            latest = self._backend.get(self._kind, *req.key)
+            if latest is not None:
+                self._store.override_resource_version_if_newer(latest)
+            self.metrics.mark_applied("create")
+            return
+        self._store.override_resource_version_if_newer(created)
+        self.metrics.mark_applied("create")
+
+    def _do_update(self, req: Request) -> None:
+        obj = self._store.get(*req.key)
+        if obj is None:
+            return
+        try:
+            updated = self._backend.update(self._kind, obj)
+        except ConflictError:
+            self.metrics.mark_conflict()
+            latest = self._backend.get(self._kind, *req.key)
+            if latest is not None:
+                # fast-forward and retry with the new resourceVersion
+                self._store.override_resource_version_if_newer(latest)
+            raise
+        except NotFoundError:
+            # object vanished server-side; recreate it (lost-write recovery)
+            created = self._backend.create(self._kind, obj)
+            self._store.override_resource_version_if_newer(created)
+            self.metrics.mark_applied("update")
+            return
+        self._store.override_resource_version_if_newer(updated)
+        self.metrics.mark_applied("update")
+
+    def _do_delete(self, req: Request) -> None:
+        try:
+            self._backend.delete(self._kind, *req.key)
+        except NotFoundError:
+            pass  # already gone — success
+        self.metrics.mark_applied("delete")
+
+    def _maybe_retry(self, req: Request, exc: Exception) -> None:
+        if req.retry_count < self._max_retries:
+            self.metrics.mark_retry()
+            self._queue.add_if_absent(req.with_increased_retry())
+        else:
+            self.metrics.mark_dropped()
+            if self._on_error is not None:
+                self._on_error(req, exc)

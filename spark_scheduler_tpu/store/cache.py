@@ -1,0 +1,158 @@
+"""Write-through caches with async write-back.
+
+Rebuilds internal/cache/{cache.go,resourcereservations.go,demands.go,
+safedemands.go}: the cache owner is the SOLE writer for its objects —
+Create/Update/Delete mutate the local store synchronously and enqueue a
+write; watch events may only fast-forward resourceVersions (external
+creates/updates are ignored to avoid conflicts) and apply deletions. Each
+CRD kind gets 5 write workers over a sharded dedup queue
+(resourceReservationClients=5, resourcereservations.go:29-34).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from spark_scheduler_tpu.store.async_client import (
+    DEFAULT_MAX_RETRIES,
+    AsyncClient,
+    AsyncClientMetrics,
+)
+from spark_scheduler_tpu.store.backend import DEMAND_CRD, ClusterBackend
+from spark_scheduler_tpu.store.object_store import ObjectStore
+from spark_scheduler_tpu.store.queue import Request, RequestType, ShardedUniqueQueue
+
+NUM_WRITE_CLIENTS = 5
+
+
+class WriteThroughCache:
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        kind: str,
+        *,
+        num_clients: int = NUM_WRITE_CLIENTS,
+        max_retries: int = DEFAULT_MAX_RETRIES,
+        sync_writes: bool = False,
+    ):
+        """sync_writes=True drains the queue inline after every mutation —
+        deterministic mode for tests and single-threaded deployments."""
+        self._store = ObjectStore()
+        self._queue = ShardedUniqueQueue(num_clients)
+        self._sync = sync_writes
+        self.client = AsyncClient(
+            backend, kind, self._store, self._queue,
+            max_retries=max_retries, metrics=AsyncClientMetrics(),
+        )
+        # Initial fill from the backend (cache/resourcereservations.go:53-60).
+        for obj in backend.list(kind):
+            self._store.put(obj)
+        backend.subscribe(
+            kind,
+            on_add=self._store.override_resource_version_if_newer,
+            on_update=lambda old, new: self._store.override_resource_version_if_newer(new),
+            on_delete=lambda obj: None,  # see note below
+        )
+        # NOTE on deletes: the reference removes watched deletions from the
+        # store (cache.go:127-133). With the in-memory backend the only
+        # deleter is this cache itself (delete already removed it); a k8s
+        # adapter should call `apply_external_delete` from its watch stream.
+
+    def apply_external_delete(self, namespace: str, name: str) -> None:
+        self._store.delete(namespace, name)
+
+    def start(self) -> None:
+        if not self._sync:
+            self.client.start()
+
+    def stop(self) -> None:
+        self.client.stop()
+
+    def flush(self) -> None:
+        self.client.drain_sync()
+
+    def _after_write(self) -> None:
+        if self._sync:
+            self.client.drain_sync()
+
+    def create(self, obj: Any) -> bool:
+        if not self._store.put_if_absent(obj):
+            return False
+        self._queue.add_if_absent(Request(key=(obj.namespace, obj.name), type=RequestType.CREATE))
+        self._after_write()
+        return True
+
+    def update(self, obj: Any) -> bool:
+        if self._store.get(obj.namespace, obj.name) is None:
+            return False
+        self._store.put(obj)
+        self._queue.add_if_absent(Request(key=(obj.namespace, obj.name), type=RequestType.UPDATE))
+        self._after_write()
+        return True
+
+    def delete(self, namespace: str, name: str) -> None:
+        self._store.delete(namespace, name)
+        self._queue.add_if_absent(Request(key=(namespace, name), type=RequestType.DELETE))
+        self._after_write()
+
+    def get(self, namespace: str, name: str) -> Optional[Any]:
+        return self._store.get(namespace, name)
+
+    def list(self) -> list[Any]:
+        return self._store.list()
+
+    def queue_lengths(self) -> list[int]:
+        return self._queue.queue_lengths()
+
+
+class ResourceReservationCache(WriteThroughCache):
+    def __init__(self, backend: ClusterBackend, **kw):
+        super().__init__(backend, "resourcereservations", **kw)
+
+
+class DemandCache(WriteThroughCache):
+    def __init__(self, backend: ClusterBackend, **kw):
+        super().__init__(backend, "demands", **kw)
+
+
+class SafeDemandCache:
+    """Demand cache gated on Demand-CRD existence (safedemands.go:40-127 +
+    crd/demand_informer.go): lazily initializes the real cache the first
+    time the CRD is observed; all operations no-op before that."""
+
+    def __init__(self, backend: ClusterBackend, **kw):
+        self._backend = backend
+        self._kw = kw
+        self._cache: DemandCache | None = None
+
+    def crd_exists(self) -> bool:
+        if self._cache is not None:
+            return True
+        if self._backend.crd_exists(DEMAND_CRD):
+            self._cache = DemandCache(self._backend, **self._kw)
+            self._cache.start()
+            return True
+        return False
+
+    def get(self, namespace: str, name: str):
+        return self._cache.get(namespace, name) if self.crd_exists() else None
+
+    def create(self, obj) -> bool:
+        if not self.crd_exists():
+            return False
+        return self._cache.create(obj)
+
+    def delete(self, namespace: str, name: str) -> None:
+        if self.crd_exists():
+            self._cache.delete(namespace, name)
+
+    def list(self) -> list[Any]:
+        return self._cache.list() if self.crd_exists() else []
+
+    def flush(self) -> None:
+        if self._cache is not None:
+            self._cache.flush()
+
+    def stop(self) -> None:
+        if self._cache is not None:
+            self._cache.stop()

@@ -1,11 +1,8 @@
-"""Test env: force CPU with an 8-device virtual mesh.
-
-Multi-chip sharding paths are validated on the host platform
-(`xla_force_host_platform_device_count=8`), per the driver's dryrun contract.
-Note: this environment's TPU site hook overrides JAX_PLATFORMS via
-`jax.config`, so we must update the config AFTER importing jax — env vars
-alone are not enough.
-"""
+"""Test env: force CPU with an 8-device virtual mesh
+(`xla_force_host_platform_device_count=8`) so device-sharding tests can run
+without TPU hardware. Note: this environment's TPU site hook overrides
+JAX_PLATFORMS via `jax.config`, so we must update the config AFTER importing
+jax — env vars alone are not enough."""
 
 import os
 

@@ -155,9 +155,13 @@ def greedy_priority_order(avail, zone_of, names, eligible, domain=None, label_ra
     return out
 
 
-def greedy_avg_efficiency(avail, schedulable, driver, exec_nodes, driver_req, exec_req):
+def greedy_avg_efficiency(
+    avail, schedulable, driver, exec_nodes, driver_req, exec_req,
+    include_executors_in_reserved=True,
+):
     """efficiency.go:107-156 over the packing's entries (duplicates kept),
-    with exact (unrounded) ratios."""
+    with exact (unrounded) ratios. `include_executors_in_reserved=False`
+    mirrors minimalFragmentation never mutating reservedResources."""
     entries = ([driver] if driver >= 0 else []) + list(exec_nodes)
     if not entries:
         return 0.0
@@ -165,8 +169,9 @@ def greedy_avg_efficiency(avail, schedulable, driver, exec_nodes, driver_req, ex
     for n in entries:
         new_res.setdefault(n, np.zeros(3, np.int64))
     new_res[driver] = new_res[driver] + driver_req
-    for n in exec_nodes:
-        new_res[n] = new_res[n] + exec_req
+    if include_executors_in_reserved:
+        for n in exec_nodes:
+            new_res[n] = new_res[n] + exec_req
     max_sum = 0.0
     for n in entries:
         reserved = (schedulable[n] - avail[n]) + new_res[n]

@@ -1,0 +1,299 @@
+"""Component scenarios through the full scheduler (reference:
+internal/extender/resource_test.go, unschedulablepods_test.go) — real
+caches, real reservation manager, real packing kernels, in-memory backend.
+"""
+
+import pytest
+
+from spark_scheduler_tpu.core.extender import (
+    FAILURE_EARLIER_DRIVER,
+    FAILURE_FIT,
+    FAILURE_UNBOUND,
+    SUCCESS,
+    SUCCESS_ALREADY_BOUND,
+    SUCCESS_SCHEDULED_EXTRA_EXECUTOR,
+)
+from spark_scheduler_tpu.models.kube import Container, Pod
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    dynamic_allocation_spark_pods,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+def test_gang_schedule_then_reject_extra_executor():
+    """resource_test.go:26-47: schedule driver+2 executors, a third executor
+    of the same app is rejected with failure-unbound."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    pods = static_allocation_spark_pods("app-1", 2)
+    results = h.schedule_app(pods, ["n1"])
+    assert all(r.ok for r in results), [r.outcome for r in results]
+
+    rr = h.get_reservation("namespace", "app-1")
+    assert rr is not None
+    assert set(rr.spec.reservations) == {"driver", "executor-1", "executor-2"}
+    assert rr.status.pods == {
+        "driver": "app-1-driver",
+        "executor-1": "app-1-exec-1",
+        "executor-2": "app-1-exec-2",
+    }
+    # persisted through async write-back to the backend
+    assert h.backend.get("resourcereservations", "namespace", "app-1") is not None
+
+    extra = Pod(
+        name="app-1-exec-extra",
+        namespace="namespace",
+        labels=dict(pods[1].labels),
+        scheduler_name=pods[1].scheduler_name,
+        node_selector=dict(pods[1].node_selector),
+        containers=[Container(requests=Resources.from_quantities("1", "1Gi"))],
+    )
+    result = h.schedule(extra, ["n1"])
+    assert not result.ok
+    assert result.outcome == FAILURE_UNBOUND
+
+
+def test_replace_reservation_after_termination():
+    """resource_test.go:49-69: a replacement executor takes over the dead
+    executor's reservation slot."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    pods = static_allocation_spark_pods("app-2", 2)
+    results = h.schedule_app(pods, ["n1"])
+    assert all(r.ok for r in results)
+
+    h.terminate_pod(pods[2])  # exec-2 dies
+    replacement = Pod(
+        name="app-2-exec-replacement",
+        namespace="namespace",
+        labels=dict(pods[2].labels),
+        scheduler_name=pods[2].scheduler_name,
+        node_selector=dict(pods[2].node_selector),
+        containers=[Container(requests=Resources.from_quantities("1", "1Gi"))],
+    )
+    result = h.schedule(replacement, ["n1"])
+    assert result.ok and result.outcome == SUCCESS
+    rr = h.get_reservation("namespace", "app-2")
+    assert "app-2-exec-replacement" in rr.status.pods.values()
+    assert "app-2-exec-2" not in rr.status.pods.values()
+
+
+def test_executor_retry_is_idempotent():
+    """Scheduling the same executor twice returns the already-bound node
+    (success-already-bound, resource.go:377-388)."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    pods = static_allocation_spark_pods("app-3", 1)
+    assert all(r.ok for r in h.schedule_app(pods, ["n1"]))
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+    again = h.extender.predicate(ExtenderArgs(pod=pods[1], node_names=["n1"]))
+    assert again.ok and again.outcome == SUCCESS_ALREADY_BOUND
+
+
+def test_driver_retry_returns_reserved_node():
+    h = Harness()
+    h.add_nodes(new_node("n1"), new_node("n2"))
+    pods = static_allocation_spark_pods("app-4", 1)
+    first = h.schedule(pods[0], ["n1", "n2"])
+    assert first.ok
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+    again = h.extender.predicate(ExtenderArgs(pod=pods[0], node_names=["n1", "n2"]))
+    assert again.ok and again.node_names == first.node_names
+
+
+def test_gang_does_not_fit_creates_demand():
+    """failure-fit on too-large gang + Demand CR creation (resource.go:342-345,
+    demand.go:82-108): driver unit + min-executor unit."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    pods = static_allocation_spark_pods("app-5", 100)
+    result = h.schedule(pods[0], ["n1"])
+    assert not result.ok and result.outcome == FAILURE_FIT
+    demands = h.demands()
+    assert len(demands) == 1
+    d = demands[0]
+    assert d.name == "demand-app-5-driver"
+    assert [u.count for u in d.spec.units] == [1, 100]
+    # demand deleted when the driver later fits (cluster grows)
+    for i in range(2, 15):
+        h.add_nodes(new_node(f"n{i}"))
+    result = h.schedule(pods[0], [f"n{i}" for i in range(1, 15)])
+    assert result.ok
+    assert h.demands() == []
+
+
+def test_fifo_earlier_driver_blocks_later_driver():
+    """resource.go:304-314: an older driver that can't fit blocks newer ones
+    (failure-earlier-driver) when FIFO is on."""
+    h = Harness(fifo=True)
+    h.add_nodes(new_node("n1"))
+    big = static_allocation_spark_pods("app-old", 20)  # will never fit
+    small = static_allocation_spark_pods("app-new", 1)
+    h.add_pods(*big)
+    r = h.schedule(big[0], ["n1"])
+    assert not r.ok and r.outcome == FAILURE_FIT
+    r = h.schedule(small[0], ["n1"])
+    assert not r.ok and r.outcome == FAILURE_EARLIER_DRIVER
+    # the blocked driver also creates a demand for itself
+    names = {d.name for d in h.demands()}
+    assert "demand-app-new-driver" in names
+
+
+def test_fifo_age_gate_skips_young_drivers():
+    """fifoConfig age gate (resource.go:260-270): young unfitting drivers are
+    skipped from FIFO consideration."""
+    import time
+
+    h = Harness(fifo=True)
+    h.app.config.fifo_config.enforce_after_pod_age_s = 3600.0
+    h.extender._config.fifo_config.enforce_after_pod_age_s = 3600.0
+    h.add_nodes(new_node("n1"))
+    big = static_allocation_spark_pods("app-old2", 20)
+    big[0].creation_timestamp = time.time() - 10  # young
+    small = static_allocation_spark_pods("app-new2", 1)
+    small[0].creation_timestamp = time.time()
+    h.add_pods(*big)
+    assert not h.schedule(big[0], ["n1"]).ok
+    r = h.schedule(small[0], ["n1"])
+    assert r.ok, r.outcome
+
+
+def test_dynamic_allocation_soft_reservation_over_min():
+    """Dynamic allocation min=1 max=2 (resource_test.go:71-271): executor
+    over min gets a soft reservation; over max is rejected."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    pods = dynamic_allocation_spark_pods("app-da", 1, 2)
+    driver, exec1, exec2 = pods
+    assert h.schedule(driver, ["n1"]).ok
+    rr = h.get_reservation("namespace", "app-da")
+    assert set(rr.spec.reservations) == {"driver", "executor-1"}
+
+    r1 = h.schedule(exec1, ["n1"])
+    assert r1.ok and r1.outcome == SUCCESS
+
+    r2 = h.schedule(exec2, ["n1"])
+    assert r2.ok and r2.outcome == SUCCESS_SCHEDULED_EXTRA_EXECUTOR
+    sr = h.soft_reservations()["app-da"]
+    assert set(sr.reservations) == {"app-da-exec-2"}
+    assert sr.reservations["app-da-exec-2"].node == "n1"
+
+    extra = Pod(
+        name="app-da-exec-3",
+        namespace="namespace",
+        labels=dict(exec1.labels),
+        scheduler_name=exec1.scheduler_name,
+        node_selector=dict(exec1.node_selector),
+        containers=[Container(requests=Resources.from_quantities("1", "1Gi"))],
+    )
+    r3 = h.schedule(extra, ["n1"])
+    assert not r3.ok and r3.outcome == FAILURE_UNBOUND
+
+
+def test_dynamic_allocation_compaction_takes_over_dead_hard_slot():
+    """When the hard-reserved executor dies, the soft-reserved one compacts
+    into the freed hard slot (resourcereservations.go:238-316)."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    driver, exec1, exec2 = dynamic_allocation_spark_pods("app-da2", 1, 2)
+    assert h.schedule(driver, ["n1"]).ok
+    assert h.schedule(exec1, ["n1"]).ok
+    assert h.schedule(exec2, ["n1"]).ok
+
+    h.delete_pod(exec1)  # hard-slot executor dies -> queues compaction
+    # next predicate call triggers compaction (resource.go:148)
+    probe = static_allocation_spark_pods("probe", 0)
+    h.schedule(probe[0], ["n1"])
+
+    rr = h.get_reservation("namespace", "app-da2")
+    assert rr.status.pods["executor-1"] == "app-da2-exec-2"
+    sr = h.soft_reservations()["app-da2"]
+    assert sr.reservations == {}
+    assert sr.status.get("app-da2-exec-2") is False or "app-da2-exec-2" not in sr.reservations
+
+
+def test_unschedulable_marker_capacity_check():
+    """unschedulablepods_test.go:23-77: 2-exec app fits an empty cluster,
+    100-exec app doesn't."""
+    h = Harness()
+    h.add_nodes(new_node("n1"), new_node("n2"))
+    small = static_allocation_spark_pods("app-small", 2)[0]
+    big = static_allocation_spark_pods("app-big", 100)[0]
+    h.add_pods(small, big)
+    marker = h.app.unschedulable_marker
+    assert marker.does_pod_exceed_cluster_capacity(small) is False
+    assert marker.does_pod_exceed_cluster_capacity(big) is True
+
+
+def test_unschedulable_marker_gpu_shortage():
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    pods = static_allocation_spark_pods("app-gpu", 2)
+    pods[0].annotations["spark-executor-nvidia.com/gpu"] = "2"  # > 1 GPU/node
+    h.add_pods(pods[0])
+    assert h.app.unschedulable_marker.does_pod_exceed_cluster_capacity(pods[0]) is True
+
+
+def test_failover_reconciliation_rebuilds_reservations():
+    """failover.go:41-155: after losing the RR (simulating lost async
+    writes), reconciliation rebuilds it from bound pods."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    pods = static_allocation_spark_pods("app-fo", 2)
+    assert all(r.ok for r in h.schedule_app(pods, ["n1"]))
+
+    # simulate lost write: nuke the RR from cache AND backend
+    h.app.rr_cache.delete("namespace", "app-fo")
+    h.app.rr_cache.flush()
+    assert h.get_reservation("namespace", "app-fo") is None
+
+    h.app.reconciler.sync_resource_reservations_and_demands()
+    rr = h.get_reservation("namespace", "app-fo")
+    assert rr is not None
+    assert rr.spec.reservations["driver"].node == "n1"
+    assert set(rr.status.pods.values()) == {
+        "app-fo-driver",
+        "app-fo-exec-1",
+        "app-fo-exec-2",
+    }
+
+
+def test_failover_rebuilds_soft_reservations():
+    """failover.go:164-231: extra executors (beyond min) are re-registered
+    as soft reservations after state loss."""
+    h = Harness()
+    h.add_nodes(new_node("n1"))
+    driver, exec1, exec2 = dynamic_allocation_spark_pods("app-fo2", 1, 2)
+    assert h.schedule(driver, ["n1"]).ok
+    assert h.schedule(exec1, ["n1"]).ok
+    assert h.schedule(exec2, ["n1"]).ok
+
+    # wipe the soft store (in-memory state lost on leader change)
+    h.app.soft_store.remove_driver_reservation("app-fo2")
+    h.app.reconciler.sync_resource_reservations_and_demands()
+    sr = h.soft_reservations()["app-fo2"]
+    assert set(sr.reservations) == {"app-fo2-exec-2"}
+
+
+@pytest.mark.parametrize(
+    "algo",
+    [
+        "tightly-pack",
+        "distribute-evenly",
+        "minimal-fragmentation",
+        "single-az-tightly-pack",
+        "single-az-minimal-fragmentation",
+        "az-aware-tightly-pack",
+    ],
+)
+def test_all_binpack_algos_schedule_end_to_end(algo):
+    h = Harness(binpack_algo=algo)
+    h.add_nodes(new_node("n1", zone="zone1"), new_node("n2", zone="zone2"))
+    pods = static_allocation_spark_pods(f"app-{algo}", 3)
+    results = h.schedule_app(pods, ["n1", "n2"])
+    assert all(r.ok for r in results), [r.outcome for r in results]

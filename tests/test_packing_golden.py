@@ -157,7 +157,10 @@ def test_single_az_matches_oracle(fill):
             )
             if not ok:
                 continue
-            eff = G.greedy_avg_efficiency(avail, sched, d, ex, driver_req, exec_req)
+            eff = G.greedy_avg_efficiency(
+                avail, sched, d, ex, driver_req, exec_req,
+                include_executors_in_reserved=(fill != "minimal-fragmentation"),
+            )
             # chooseBestResult starts at Max=0.0 and replaces on strictly
             # greater, so zero-efficiency zones are rejected outright.
             if eff > (best[0] if best is not None else 0.0):
@@ -183,7 +186,8 @@ def test_single_az_matches_oracle(fill):
             # float32-vs-float64 efficiency tie: accept iff the kernel's pick
             # scores within 1e-5 of the oracle's best.
             got_eff = G.greedy_avg_efficiency(
-                avail, sched, got_driver, got_execs, driver_req, exec_req
+                avail, sched, got_driver, got_execs, driver_req, exec_req,
+                include_executors_in_reserved=(fill != "minimal-fragmentation"),
             )
             assert abs(got_eff - best[0]) < 1e-5, (
                 fill, best, got_driver, got_execs, got_eff,
