@@ -1,0 +1,184 @@
+"""Batched FIFO gang admission — the whole scheduling queue as ONE XLA program.
+
+The reference admits drivers one HTTP request at a time, re-running a Go
+greedy loop per app (resource.go:125-189) and, for FIFO, re-packing every
+earlier driver inside the request (`fitEarlierDrivers`, resource.go:221-258).
+This module is the TPU-native replacement: the FIFO-sorted queue of B apps is
+a tensor batch, and admission is a `lax.scan` over the app axis threading the
+cluster availability tensor — each step is a fully vectorized O(N) gang pack
+(driver selection via the feasibility identity + executor fill via prefix
+sums, see ops/packing.py), and the scatter-subtract of an admitted app's
+usage replaces the reference's `metadata.SubtractUsageIfExists`
+(resource.go:251-255).
+
+Reference-faithful FIFO semantics:
+  - apps are processed in FIFO order (creation time; host sorts before the
+    call, sparkpods.go:60-77);
+  - an admitted app's usage is subtracted before the next app packs
+    (resource.go:251-255);
+  - a *non-skippable* app that fails blocks everything behind it — strict
+    FIFO (resource.go:241-249); `skippable[i]` marks apps the age-based
+    enforcement lets later apps jump over (resource.go:260-270,
+    config/config.go:57-64);
+  - node priority orders are computed ONCE from the starting availability
+    and reused for every app, exactly as `fitEarlierDrivers` reuses the
+    orders computed at resource.go:299 while only availability mutates.
+
+Cost: B scan steps, each O(N) vector work + an O(Emax) fill — ~B*N total,
+laid out as dense int32 vector ops XLA maps onto the VPU. The 10k-node x
+1k-app north star (BASELINE.md) is one invocation of `batched_fifo_pack`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_scheduler_tpu.models.cluster import ClusterTensors
+from spark_scheduler_tpu.ops.packing import (
+    _FILLS,
+    _check_cumsum_bound,
+    _rank_of_position,
+    pack_one_app,
+)
+from spark_scheduler_tpu.ops.sorting import priority_order, zone_ranks
+
+
+class AppBatch(NamedTuple):
+    """FIFO-ordered queue of gang requests (one row per Spark application).
+
+    The tensor form of `sparkApplicationResources` (sparkpods.go:29-35) x B,
+    already sorted by creation time host-side (`filterToEarliestAndSort`,
+    sparkpods.go:60-77). Rows past the real queue length are padding with
+    `app_valid=False`.
+    """
+
+    driver_req: jnp.ndarray  # [B, 3] i32
+    exec_req: jnp.ndarray  # [B, 3] i32
+    exec_count: jnp.ndarray  # [B] i32 — gang size (min executors)
+    app_valid: jnp.ndarray  # [B] bool — padding mask
+    skippable: jnp.ndarray  # [B] bool — FIFO age-based skip (resource.go:260-270)
+
+
+class BatchedPacking(NamedTuple):
+    """Per-app gang placement for the whole queue."""
+
+    driver_node: jnp.ndarray  # [B] i32, -1 = not admitted
+    executor_nodes: jnp.ndarray  # [B, Emax] i32, -1 = padding / not admitted
+    admitted: jnp.ndarray  # [B] bool — packed AND not FIFO-blocked
+    packed: jnp.ndarray  # [B] bool — would fit, ignoring FIFO blocking
+    available_after: jnp.ndarray  # [N, 3] i32 — availability after all admits
+
+
+@partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
+def batched_fifo_pack(
+    cluster: ClusterTensors,
+    apps: AppBatch,
+    *,
+    fill: str = "tightly-pack",
+    emax: int,
+    num_zones: int,
+) -> BatchedPacking:
+    """Admit a FIFO queue of gang requests in one compiled program.
+
+    `emax` is the static executor-slot padding (>= max(exec_count));
+    `num_zones` the static zone-id bound. Strict-FIFO blocking: once a
+    non-skippable valid app fails to pack, every later app is rejected
+    (`failure-earlier-driver`, resource.go:241-249) but its hypothetical
+    packing is still reported in `packed` for demand creation.
+    """
+    fill_fn = _FILLS[fill]
+    n = cluster.available.shape[0]
+    _check_cumsum_bound(n, emax)
+
+    domain = cluster.valid
+    exec_elig = domain & ~cluster.unschedulable & cluster.ready
+    driver_elig = exec_elig  # queue-mode drivers have no kube candidate filter
+
+    zrank = zone_ranks(cluster, domain, num_zones)
+    d_order, _ = priority_order(cluster, driver_elig, zrank, cluster.label_rank_driver)
+    e_order, _ = priority_order(cluster, exec_elig, zrank, cluster.label_rank_executor)
+    d_rank = _rank_of_position(d_order)
+
+    def step(carry, app):
+        avail, blocked = carry
+        driver_req, exec_req, count, valid, skippable = app
+        # A gang larger than the static slot padding cannot be represented —
+        # reject it outright rather than silently truncating it. Callers
+        # size emax to the queue's max gang (make_app_batch knows it).
+        too_big = count > emax
+        count = jnp.minimum(count, emax)
+
+        driver_node, one_hot, exec_nodes, ok = pack_one_app(
+            avail, exec_elig, driver_elig, d_order, d_rank, e_order,
+            driver_req, exec_req, count, fill_fn, emax,
+        )
+
+        packed = ok & valid & ~too_big
+        admitted = packed & ~blocked
+
+        # Scatter-subtract the admitted gang's usage (resource.go:251-255).
+        exec_counts = (
+            jnp.zeros(n, jnp.int32)
+            .at[jnp.clip(exec_nodes, 0, n - 1)]
+            .add(jnp.where(exec_nodes >= 0, 1, 0))
+        )
+        delta = exec_counts[:, None] * exec_req[None, :] + jnp.where(
+            one_hot, driver_req[None, :], 0
+        )
+        avail = jnp.where(admitted, avail - delta.astype(avail.dtype), avail)
+
+        # Strict FIFO: a non-skippable valid failure blocks the rest
+        # (resource.go:241-249).
+        blocked = blocked | (valid & ~packed & ~skippable)
+
+        out_driver = jnp.where(admitted, driver_node, -1).astype(jnp.int32)
+        out_execs = jnp.where(admitted, exec_nodes, -1).astype(jnp.int32)
+        return (avail, blocked), (out_driver, out_execs, admitted, packed)
+
+    (avail_after, _), (drivers, execs, admitted, packed) = jax.lax.scan(
+        step,
+        (cluster.available, jnp.bool_(False)),
+        (apps.driver_req, apps.exec_req, apps.exec_count, apps.app_valid, apps.skippable),
+    )
+    return BatchedPacking(
+        driver_node=drivers,
+        executor_nodes=execs,
+        admitted=admitted,
+        packed=packed,
+        available_after=avail_after,
+    )
+
+
+def make_app_batch(
+    driver_reqs,  # [B,3] array-like
+    exec_reqs,  # [B,3] array-like
+    exec_counts,  # [B] array-like
+    *,
+    pad_to: int | None = None,
+    skippable=None,
+) -> AppBatch:
+    """Host helper: pad a queue to a bucketed batch size."""
+    import numpy as np
+
+    driver_reqs = np.asarray(driver_reqs, np.int32)
+    exec_reqs = np.asarray(exec_reqs, np.int32)
+    exec_counts = np.asarray(exec_counts, np.int32)
+    b = driver_reqs.shape[0]
+    if skippable is None:
+        skippable = np.zeros(b, bool)
+    else:
+        skippable = np.asarray(skippable, bool)
+    pad = max(pad_to or b, b)
+    valid = np.zeros(pad, bool)
+    valid[:b] = True
+    return AppBatch(
+        driver_req=np.pad(driver_reqs, ((0, pad - b), (0, 0))),
+        exec_req=np.pad(exec_reqs, ((0, pad - b), (0, 0))),
+        exec_count=np.pad(exec_counts, (0, pad - b)),
+        app_valid=valid,
+        skippable=np.pad(skippable, (0, pad - b)),
+    )

@@ -181,6 +181,55 @@ _FILLS = {
 from functools import partial
 
 
+def pack_one_app(
+    avail: jnp.ndarray,  # [N,3] i32 — current availability
+    exec_elig: jnp.ndarray,  # [N] bool
+    driver_elig: jnp.ndarray,  # [N] bool
+    d_order: jnp.ndarray,  # [N] i32 driver priority order
+    d_rank: jnp.ndarray,  # [N] i32 rank of each node in d_order
+    e_order: jnp.ndarray,  # [N] i32 executor priority order
+    driver_req: jnp.ndarray,  # [3] i32
+    exec_req: jnp.ndarray,  # [3] i32
+    count: jnp.ndarray,  # i32 scalar
+    fill_fn,
+    emax: int,
+):
+    """Core gang pack against a given availability (binpack.go:60-87):
+    driver selection via the feasibility identity (module docstring) + one
+    executor fill with the chosen driver tentatively reserved. Shared by the
+    single-app path (`spark_bin_pack`) and the batched FIFO scan body
+    (ops/batched.py) so their semantics cannot diverge.
+
+    Returns (driver_node, driver_one_hot[N,1], exec_nodes[Emax], ok).
+    """
+    n = avail.shape[0]
+    zero = jnp.zeros_like(avail)
+    cap_base = jnp.where(exec_elig, node_capacities(avail, zero, exec_req), 0)
+    cap_base_c = jnp.minimum(cap_base, count)
+    total_base = jnp.sum(cap_base_c)
+
+    # Capacity of node i for executors if the driver were reserved on i.
+    driver_reserved = jnp.broadcast_to(driver_req[None, :], avail.shape)
+    cap_with_driver = jnp.where(
+        exec_elig, node_capacities(avail, driver_reserved, exec_req), 0
+    )
+    total_if_driver = total_base - cap_base_c + jnp.minimum(cap_with_driver, count)
+
+    driver_fit = driver_elig & fits(avail, driver_req)
+    feasible = driver_fit & (total_if_driver >= count)
+    best_rank = jnp.min(jnp.where(feasible, d_rank, INT32_INF))
+    found = best_rank < INT32_INF
+    driver_node = jnp.where(found, d_order[jnp.clip(best_rank, 0, n - 1)], -1).astype(
+        jnp.int32
+    )
+
+    one_hot = (jnp.arange(n) == driver_node)[:, None]
+    reserved = jnp.where(one_hot, driver_req[None, :], 0).astype(avail.dtype)
+    caps = jnp.where(exec_elig, node_capacities(avail, reserved, exec_req), 0)
+    exec_nodes, fill_ok = fill_fn(caps[e_order], e_order, count, emax)
+    return driver_node, one_hot, exec_nodes, found & fill_ok
+
+
 @partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
 def spark_bin_pack(
     cluster: ClusterTensors,
@@ -215,36 +264,12 @@ def spark_bin_pack(
         zrank = zone_ranks(cluster, domain, num_zones)
     d_order, _ = priority_order(cluster, driver_elig, zrank, cluster.label_rank_driver)
     e_order, _ = priority_order(cluster, exec_elig, zrank, cluster.label_rank_executor)
-
-    zero = jnp.zeros_like(avail)
-    cap_base = jnp.where(exec_elig, node_capacities(avail, zero, exec_req), 0)
-    cap_base_c = jnp.minimum(cap_base, count)
-    total_base = jnp.sum(cap_base_c)
-
-    # Capacity of node i for executors if the driver were reserved on i.
-    driver_reserved = jnp.broadcast_to(driver_req[None, :], avail.shape)
-    cap_with_driver = jnp.where(
-        exec_elig, node_capacities(avail, driver_reserved, exec_req), 0
-    )
-    total_if_driver = total_base - cap_base_c + jnp.minimum(cap_with_driver, count)
-
-    driver_fit = driver_elig & fits(avail, driver_req)
-    feasible = driver_fit & (total_if_driver >= count)
     d_rank = _rank_of_position(d_order)
-    best_rank = jnp.min(jnp.where(feasible, d_rank, INT32_INF))
-    found = best_rank < INT32_INF
-    driver_node = jnp.where(found, d_order[jnp.clip(best_rank, 0, n - 1)], -1).astype(
-        jnp.int32
+
+    driver_node, _, exec_nodes, has_cap = pack_one_app(
+        avail, exec_elig, driver_elig, d_order, d_rank, e_order,
+        driver_req, exec_req, count, fill_fn, emax,
     )
-
-    # Executor fill with the chosen driver tentatively reserved.
-    one_hot = (jnp.arange(n) == driver_node)[:, None]
-    reserved = jnp.where(one_hot, driver_req[None, :], 0).astype(avail.dtype)
-    caps = jnp.where(exec_elig, node_capacities(avail, reserved, exec_req), 0)
-    caps_pos = caps[e_order]
-    exec_nodes, fill_ok = fill_fn(caps_pos, e_order, count, emax)
-
-    has_cap = found & fill_ok
     return Packing(
         driver_node=jnp.where(has_cap, driver_node, -1).astype(jnp.int32),
         executor_nodes=jnp.where(has_cap, exec_nodes, -1).astype(jnp.int32),

@@ -1,0 +1,49 @@
+"""Device-mesh construction for the solver.
+
+Axes:
+  "groups" — data parallelism over independent instance-group subproblems
+             (apps in different instance groups contend for disjoint node
+             sets: failover.go:276-313 groups nodes by the instance-group
+             label, so each group's admission scan is independent).
+  "nodes"  — model/sequence-style sharding of the node axis of one large
+             subproblem (capacity kernels are elementwise over nodes; sorts,
+             prefix sums and the feasibility psum become XLA collectives
+             over ICI).
+
+On a multi-host slice the same mesh spans hosts and XLA routes "nodes"
+collectives over ICI and "groups" over DCN when
+`jax.distributed.initialize()` has formed a multi-process runtime — the
+NCCL/MPI slot of SURVEY.md §5.8, filled by XLA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_solver_mesh(
+    n_groups: int | None = None,
+    n_nodes_shards: int | None = None,
+    devices=None,
+) -> Mesh:
+    """Build a ("groups", "nodes") mesh over the available devices.
+
+    With neither axis size given, all devices go to "nodes" (single large
+    cluster). Axis sizes must multiply to the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    d = len(devices)
+    if n_groups is None and n_nodes_shards is None:
+        n_groups, n_nodes_shards = 1, d
+    elif n_groups is None:
+        n_groups = d // n_nodes_shards
+    elif n_nodes_shards is None:
+        n_nodes_shards = d // n_groups
+    if n_groups * n_nodes_shards != d:
+        raise ValueError(
+            f"mesh {n_groups}x{n_nodes_shards} != {d} devices"
+        )
+    arr = np.asarray(devices).reshape(n_groups, n_nodes_shards)
+    return Mesh(arr, ("groups", "nodes"))

@@ -1,0 +1,118 @@
+"""Sharded batched FIFO admission.
+
+Two composition levels over `ops.batched.batched_fifo_pack`:
+
+  sharded_fifo_pack — one large cluster, node axis sharded over the mesh's
+      "nodes" axis. The scan body's elementwise capacity math stays local to
+      each shard; the total-capacity reduction, node sorts, and prefix sums
+      become XLA collectives. This is the sequence-parallel analog for the
+      10k-node axis (SURVEY.md §5.7).
+
+  grouped_fifo_pack — G independent instance-group subproblems stacked on a
+      leading axis, vmapped and sharded over "groups" (data parallel), each
+      subproblem's node axis sharded over "nodes": full 2D parallelism.
+
+Shardings are declared; collectives are XLA's to choose (no hand-written
+ppermute/psum — scaling-book style).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_scheduler_tpu.models.cluster import ClusterTensors
+from spark_scheduler_tpu.ops.batched import AppBatch, BatchedPacking, batched_fifo_pack
+
+
+def _shard_cluster(cluster: ClusterTensors, mesh: Mesh, leading=()) -> ClusterTensors:
+    """Place cluster tensors with the node axis sharded over "nodes"."""
+
+    def put(x):
+        x = jnp.asarray(x)
+        spec = P(*leading, "nodes", *([None] * (x.ndim - 1 - len(leading))))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(put, cluster)
+
+
+def _shard_apps(apps: AppBatch, mesh: Mesh, leading=()) -> AppBatch:
+    """App batch: replicated across "nodes" (the scan walks it sequentially),
+    optionally sharded on a leading "groups" axis."""
+
+    def put(x):
+        x = jnp.asarray(x)
+        spec = P(*leading, *([None] * (x.ndim - len(leading))))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return AppBatch(*[put(x) for x in apps])
+
+
+def sharded_fifo_pack(
+    mesh: Mesh,
+    cluster: ClusterTensors,
+    apps: AppBatch,
+    *,
+    fill: str = "tightly-pack",
+    emax: int,
+    num_zones: int,
+) -> BatchedPacking:
+    """Batched FIFO admission with the node axis sharded across the mesh.
+
+    Node count must divide evenly by the "nodes" axis size (pad the cluster
+    tensors with invalid slots — build_cluster_tensors' `pad_to`)."""
+    n_shards = mesh.shape["nodes"]
+    if cluster.available.shape[0] % n_shards:
+        raise ValueError(
+            f"node count {cluster.available.shape[0]} not divisible by "
+            f'mesh "nodes" axis {n_shards}; pad with invalid slots'
+        )
+    cluster = _shard_cluster(cluster, mesh)
+    apps = _shard_apps(apps, mesh)
+    # Computation follows the input shardings (GSPMD); no explicit mesh
+    # context needed — XLA partitions the scan body and inserts collectives.
+    return batched_fifo_pack(cluster, apps, fill=fill, emax=emax, num_zones=num_zones)
+
+
+def stack_groups(
+    clusters: list[ClusterTensors], app_batches: list[AppBatch]
+) -> tuple[ClusterTensors, AppBatch]:
+    """Stack per-instance-group subproblems on a leading axis. All groups
+    must be padded to identical (N, B, Emax) shapes (bucketing keeps the
+    compile cache warm anyway)."""
+    cluster = jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *clusters
+    )
+    apps = AppBatch(
+        *[np.stack([np.asarray(x) for x in cols]) for cols in zip(*app_batches)]
+    )
+    return cluster, apps
+
+
+def grouped_fifo_pack(
+    mesh: Mesh,
+    clusters: ClusterTensors,  # leaves stacked [G, N, ...]
+    apps: AppBatch,  # leaves stacked [G, B, ...]
+    *,
+    fill: str = "tightly-pack",
+    emax: int,
+    num_zones: int,
+) -> BatchedPacking:
+    """2D-parallel admission: vmap over the instance-group axis (sharded
+    over "groups"), node axis of each subproblem sharded over "nodes"."""
+    g = clusters.available.shape[0]
+    if g % mesh.shape["groups"]:
+        raise ValueError(
+            f'group count {g} not divisible by mesh "groups" axis '
+            f"{mesh.shape['groups']}; pad with empty groups"
+        )
+    clusters = _shard_cluster(clusters, mesh, leading=("groups",))
+    apps = _shard_apps(apps, mesh, leading=("groups",))
+    fn = jax.vmap(
+        partial(batched_fifo_pack, fill=fill, emax=emax, num_zones=num_zones)
+    )
+    return fn(clusters, apps)

@@ -1,0 +1,151 @@
+"""Batched FIFO gang admission: parity with a sequential oracle loop, strict
+FIFO blocking semantics, and sharded == unsharded on the virtual device mesh.
+"""
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.models.cluster import ClusterTensors
+from spark_scheduler_tpu.ops.batched import AppBatch, batched_fifo_pack, make_app_batch
+from spark_scheduler_tpu.parallel import (
+    grouped_fifo_pack,
+    make_solver_mesh,
+    sharded_fifo_pack,
+    stack_groups,
+)
+
+from tests import greedy_oracle as G
+from tests.test_packing_golden import random_cluster, oracle_orders
+
+EMAX = 16
+NUM_ZONES = 4
+
+
+def random_apps(rng, b, pad_to=None):
+    driver = rng.integers(1, 6, size=(b, 3)).astype(np.int32)
+    driver[:, 2] = rng.integers(0, 2, size=b)
+    execs = rng.integers(1, 8, size=(b, 3)).astype(np.int32)
+    execs[:, 2] = rng.integers(0, 2, size=b)
+    # Occasionally exceed EMAX: oversized gangs must be rejected, not truncated.
+    counts = rng.integers(0, EMAX + 4, size=b).astype(np.int32)
+    skip = rng.random(b) < 0.3
+    return make_app_batch(driver, execs, counts, pad_to=pad_to, skippable=skip)
+
+
+def oracle_batched(c: ClusterTensors, apps: AppBatch, fill):
+    """Sequential reference loop: pack each app in FIFO order against the
+    mutating availability, orders fixed from the starting availability
+    (fitEarlierDrivers semantics, resource.go:221-258)."""
+    avail = np.asarray(c.available).astype(np.int64).copy()
+    valid = np.asarray(c.valid)
+    e_elig = valid & ~np.asarray(c.unschedulable) & np.asarray(c.ready)
+    d_mask = e_elig.copy()
+    d_order, e_order = oracle_orders(c, d_mask, valid)
+    # oracle_orders applies eligibility itself; driver eligibility here is
+    # the executor eligibility (queue mode, no kube candidate list).
+    blocked = False
+    out = []
+    for i in range(len(apps.app_valid)):
+        dreq = np.asarray(apps.driver_req[i], np.int64)
+        ereq = np.asarray(apps.exec_req[i], np.int64)
+        too_big = int(apps.exec_count[i]) > EMAX
+        count = int(min(apps.exec_count[i], EMAX))
+        drv, execs, ok, _ = G.greedy_spark_bin_pack(
+            avail, dreq, ereq, count, d_order, e_order, fill
+        )
+        packed = ok and bool(apps.app_valid[i]) and not too_big
+        admitted = packed and not blocked
+        if admitted:
+            avail[drv] -= dreq
+            for n in execs:
+                avail[n] -= ereq
+        else:
+            drv, execs = -1, []
+        if bool(apps.app_valid[i]) and not packed and not bool(apps.skippable[i]):
+            blocked = True
+        out.append((drv, list(execs), admitted, packed))
+    return out, avail
+
+
+@pytest.mark.parametrize("fill", ["tightly-pack", "distribute-evenly", "minimal-fragmentation"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_batched_matches_sequential_oracle(fill, seed):
+    rng = np.random.default_rng(seed)
+    c = random_cluster(rng, 40)
+    apps = random_apps(rng, 12, pad_to=16)
+    got = batched_fifo_pack(c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
+    want, want_avail = oracle_batched(c, apps, fill)
+    for i, (drv, execs, admitted, packed) in enumerate(want):
+        assert bool(got.admitted[i]) == admitted, f"app {i} admitted"
+        assert bool(got.packed[i]) == packed, f"app {i} packed"
+        assert int(got.driver_node[i]) == drv, f"app {i} driver"
+        got_execs = [int(x) for x in np.asarray(got.executor_nodes[i]) if x >= 0]
+        assert got_execs == execs, f"app {i} executors"
+    live = np.asarray(c.valid)
+    np.testing.assert_array_equal(
+        np.asarray(got.available_after)[live], want_avail.astype(np.int32)[live]
+    )
+
+
+def test_strict_fifo_blocking():
+    rng = np.random.default_rng(7)
+    c = random_cluster(rng, 20)
+    # App 1 requests an impossible gang and is NOT skippable: apps 2.. must
+    # be rejected even though they'd fit (resource.go:241-249).
+    driver = np.ones((3, 3), np.int32)
+    execs = np.ones((3, 3), np.int32)
+    counts = np.array([1, 10**6, 1], np.int32)
+    counts = np.minimum(counts, EMAX)
+    execs[1] = 10**6  # impossible request instead
+    apps = make_app_batch(driver, execs, counts, skippable=[False, False, False])
+    got = batched_fifo_pack(c, apps, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES)
+    assert bool(got.admitted[0])
+    assert not bool(got.admitted[1])
+    assert not bool(got.admitted[2])
+    assert bool(got.packed[2])  # would fit; blocked only by FIFO
+
+    # Same queue but app 1 skippable: app 2 goes through (resource.go:260-270).
+    apps2 = make_app_batch(driver, execs, counts, skippable=[False, True, False])
+    got2 = batched_fifo_pack(c, apps2, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES)
+    assert bool(got2.admitted[2])
+
+
+def test_sharded_matches_unsharded():
+    rng = np.random.default_rng(3)
+    c = random_cluster(rng, 64)  # divisible by the 8-device "nodes" axis
+    apps = random_apps(rng, 8)
+    mesh = make_solver_mesh()  # all devices on "nodes"
+    want = batched_fifo_pack(c, apps, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES)
+    got = sharded_fifo_pack(mesh, c, apps, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES)
+    np.testing.assert_array_equal(np.asarray(got.driver_node), np.asarray(want.driver_node))
+    np.testing.assert_array_equal(
+        np.asarray(got.executor_nodes), np.asarray(want.executor_nodes)
+    )
+    np.testing.assert_array_equal(np.asarray(got.admitted), np.asarray(want.admitted))
+    np.testing.assert_array_equal(
+        np.asarray(got.available_after), np.asarray(want.available_after)
+    )
+
+
+def test_grouped_2d_parallel_matches_per_group():
+    rng = np.random.default_rng(11)
+    clusters = [random_cluster(rng, 32) for _ in range(4)]
+    batches = [random_apps(rng, 6, pad_to=8) for _ in range(4)]
+    mesh = make_solver_mesh(n_groups=2, n_nodes_shards=4)
+    stacked_c, stacked_a = stack_groups(clusters, batches)
+    got = grouped_fifo_pack(
+        mesh, stacked_c, stacked_a, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES
+    )
+    for gi in range(4):
+        want = batched_fifo_pack(
+            clusters[gi], batches[gi], fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.driver_node[gi]), np.asarray(want.driver_node)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.executor_nodes[gi]), np.asarray(want.executor_nodes)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.admitted[gi]), np.asarray(want.admitted)
+        )
