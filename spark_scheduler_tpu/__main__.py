@@ -1,0 +1,95 @@
+"""CLI (cmd/root.go:22-35, cmd/server.go:44-54):
+
+  python -m spark_scheduler_tpu server [--config install.yml] [--port N]
+  python -m spark_scheduler_tpu version
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+__version__ = "0.1.0"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="spark-scheduler-tpu")
+    sub = parser.add_subparsers(dest="command")
+    sub.add_parser("version", help="print version")
+    srv = sub.add_parser("server", help="run the scheduler extender server")
+    srv.add_argument("--config", help="install YAML (config/config.go:24-84 surface)")
+    srv.add_argument("--host", default="0.0.0.0")
+    srv.add_argument("--port", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        print(__version__)
+        return 0
+    if args.command != "server":
+        parser.print_help()
+        return 2
+
+    from spark_scheduler_tpu.events import EventEmitter
+    from spark_scheduler_tpu.metrics import (
+        CacheReporter,
+        MetricRegistry,
+        QueueReporter,
+        ReporterRunner,
+        SchedulerMetrics,
+        SoftReservationReporter,
+        UsageReporter,
+        WasteReporter,
+    )
+    from spark_scheduler_tpu.server.app import build_scheduler_app
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+    from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
+
+    config = InstallConfig()
+    if args.config:
+        import yaml
+
+        with open(args.config) as f:
+            config = InstallConfig.from_dict(yaml.safe_load(f) or {})
+    if args.port is not None:
+        config.port = args.port
+
+    registry = MetricRegistry()
+    metrics = SchedulerMetrics(registry, config.instance_group_label)
+    events = EventEmitter(instance_group_label=config.instance_group_label)
+    waste = WasteReporter(registry, config.instance_group_label)
+    backend = InMemoryBackend()
+    backend.register_crd(DEMAND_CRD)
+    app = build_scheduler_app(
+        backend, config, metrics=metrics, events=events, waste=waste
+    )
+
+    class _Cleanups:  # periodic state eviction on the reporter tick
+        def report_once(self):
+            waste.cleanup()
+            metrics.report_once()
+
+    reporters = ReporterRunner(
+        [
+            UsageReporter(registry, app.reservation_manager),
+            CacheReporter(
+                registry,
+                {"resourcereservations": app.rr_cache, "demands": app.demand_cache},
+            ),
+            SoftReservationReporter(registry, app.soft_store),
+            QueueReporter(registry, backend, config.instance_group_label),
+            _Cleanups(),
+        ]
+    )
+    server = SchedulerHTTPServer(app, registry, host=args.host, port=config.port)
+    reporters.start()
+    print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)
+    try:
+        server.serve_forever()
+    finally:
+        reporters.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

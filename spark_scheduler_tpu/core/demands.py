@@ -30,12 +30,13 @@ POD_DEMAND_CREATED_CONDITION = "PodDemandCreated"
 
 class DemandManager:
     def __init__(self, backend, demand_cache, instance_group_label: str,
-                 is_single_az_binpacker: bool = False, events=None):
+                 is_single_az_binpacker: bool = False, events=None, waste=None):
         self._backend = backend
         self._cache = demand_cache
         self._instance_group_label = instance_group_label
         self._is_single_az = is_single_az_binpacker
         self._events = events
+        self._waste = waste
 
     # -- creation -----------------------------------------------------------
 
@@ -101,6 +102,8 @@ class DemandManager:
             return self._cache.get(demand.namespace, demand.name)
         if self._events is not None:
             self._events.emit_demand_created(demand)
+        if self._waste is not None:
+            self._waste.on_demand_created(pod.key)
         pod.set_condition(PodCondition(type=POD_DEMAND_CREATED_CONDITION, status=True))
         return demand
 

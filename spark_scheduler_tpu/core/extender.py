@@ -112,6 +112,7 @@ class SparkSchedulerExtender:
         reconciler=None,
         metrics=None,
         events=None,
+        waste=None,
         clock=time.time,
     ):
         self._backend = backend
@@ -125,6 +126,7 @@ class SparkSchedulerExtender:
         self._reconciler = reconciler
         self._metrics = metrics
         self._events = events
+        self._waste = waste
         self._clock = clock
         self._last_request: float = 0.0
 
@@ -162,6 +164,8 @@ class SparkSchedulerExtender:
     def _fail(self, args: ExtenderArgs, outcome: str, message: str) -> ExtenderFilterResult:
         if self._metrics is not None:
             self._metrics.mark_failed_scheduling_attempt(args.pod, outcome)
+        if self._waste is not None:
+            self._waste.mark_failed_scheduling_attempt(args.pod, outcome)
         return ExtenderFilterResult(
             node_names=[],
             failed_nodes={name: message for name in args.node_names},

@@ -56,6 +56,7 @@ class PodCondition:
 class Container:
     """A container's resource *requests* (the only part scheduling reads)."""
 
+    name: str = ""
     requests: Resources = dataclasses.field(default_factory=Resources.zero)
     terminated: bool = False  # status: all-containers-terminated => pod dead
 

@@ -61,6 +61,7 @@ def build_scheduler_app(
     config: InstallConfig | None = None,
     metrics=None,
     events=None,
+    waste=None,
     clock=None,
 ) -> SchedulerApp:
     import time as _time
@@ -91,8 +92,36 @@ def build_scheduler_app(
         config.instance_group_label,
         is_single_az_binpacker=binpacker.is_single_az,
         events=events,
+        waste=waste,
     )
     start_demand_gc(backend, demand_manager)
+
+    # Waste / retry-state lifecycle hooks (waste.go:90-146 informer hookup):
+    # pod scheduled -> close out waste phases; pod deleted -> drop state.
+    if waste is not None or metrics is not None:
+
+        def _on_pod_update(old, new):
+            if waste is not None and not old.node_name and new.node_name:
+                waste.on_pod_scheduled(new)
+
+        def _on_pod_delete(pod):
+            if waste is not None:
+                waste.on_pod_deleted(pod)
+            if metrics is not None and hasattr(metrics, "forget_pod"):
+                metrics.forget_pod(pod)
+
+        backend.subscribe("pods", on_update=_on_pod_update, on_delete=_on_pod_delete)
+    if waste is not None:
+        from spark_scheduler_tpu.models.demands import DEMAND_NAME_PREFIX
+
+        def _on_demand_update(old, new):
+            # External autoscaler flips the phase to fulfilled
+            # (waste.go:235-243 OnDemandFulfilled).
+            if new.is_fulfilled() and not old.is_fulfilled():
+                pod_name = new.name[len(DEMAND_NAME_PREFIX):]
+                waste.on_demand_fulfilled((new.namespace, pod_name))
+
+        backend.subscribe("demands", on_update=_on_demand_update)
     solver = PlacementSolver(
         driver_label_priority=(
             config.driver_prioritized_node_label.as_tuple()
@@ -122,7 +151,7 @@ def build_scheduler_app(
         overhead_computer,
         binpacker,
         solver,
-        ExtenderConfig(
+        config=ExtenderConfig(
             fifo=config.fifo,
             fifo_config=config.fifo_config,
             instance_group_label=config.instance_group_label,
@@ -133,6 +162,7 @@ def build_scheduler_app(
         reconciler=reconciler,
         metrics=metrics,
         events=events,
+        waste=waste,
         clock=clock,
     )
     marker = UnschedulablePodMarker(

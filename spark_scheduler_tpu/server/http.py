@@ -1,0 +1,155 @@
+r"""HTTP front-end — the witchcraft-server slot (cmd/server.go, cmd/endpoints.go).
+
+Routes (all JSON):
+
+  POST /predicates            kube-scheduler extender filter call
+                              (ExtenderArgs -> ExtenderFilterResult,
+                              cmd/endpoints.go:28-42)
+  GET  /status/liveness       200 when the process is up
+  GET  /status/readiness      200 once state is synced and solver warm
+  GET  /metrics               metric-registry snapshot
+  PUT  /state/nodes           upsert a k8s Node object   \  informer-watch
+  PUT  /state/pods            upsert a k8s Pod object     } substitute: the
+  DELETE /state/pods/{ns}/{n} remove a pod               /  state-sync API
+
+The reference learns cluster state through apiserver watch streams
+(cmd/server.go:111-147); in environments without one, the state-sync routes
+carry the same information. Threaded stdlib server: the predicate handler is
+serialized by the extender's internal ordering, matching the reference's
+single Predicate goroutine assumption (SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from spark_scheduler_tpu.core.extender import ExtenderArgs
+from spark_scheduler_tpu.server.kube_io import (
+    extender_args_from_k8s,
+    filter_result_to_k8s,
+    node_from_k8s,
+    pod_from_k8s,
+)
+
+
+class SchedulerHTTPServer:
+    def __init__(self, app, registry=None, host: str = "127.0.0.1", port: int = 8484):
+        self.app = app
+        self.registry = registry
+        self.ready = threading.Event()
+        # One predicate at a time — the serialization point for mutable
+        # scheduling state (SURVEY.md §7 "Mutable-state races").
+        self._predicate_lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _write(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):
+                if self.path == "/status/liveness":
+                    self._write(200, {"status": "up"})
+                elif self.path == "/status/readiness":
+                    code = 200 if outer.ready.is_set() else 503
+                    self._write(code, {"ready": outer.ready.is_set()})
+                elif self.path == "/metrics":
+                    snap = outer.registry.snapshot() if outer.registry else {}
+                    self._write(200, snap)
+                else:
+                    self._write(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path == "/predicates":
+                    try:
+                        pod, node_names = extender_args_from_k8s(self._body())
+                    except Exception as exc:
+                        self._write(500, {"Error": str(exc)})
+                        return
+                    with outer._predicate_lock:
+                        result = outer.app.extender.predicate(
+                            ExtenderArgs(pod=pod, node_names=node_names)
+                        )
+                    self._write(200, filter_result_to_k8s(result))
+                else:
+                    self._write(404, {"error": "not found"})
+
+            def do_PUT(self):
+                try:
+                    if self.path == "/state/nodes":
+                        node = node_from_k8s(self._body())
+                        existing = outer.app.backend.get_node(node.name)
+                        if existing is None:
+                            outer.app.backend.add_node(node)
+                        else:
+                            outer.app.backend.update("nodes", node)
+                        self._write(200, {"applied": node.name})
+                    elif self.path == "/state/pods":
+                        pod = pod_from_k8s(self._body())
+                        if outer.app.backend.get("pods", pod.namespace, pod.name) is None:
+                            outer.app.backend.add_pod(pod)
+                        else:
+                            outer.app.backend.update_pod(pod)
+                        self._write(200, {"applied": pod.name})
+                    else:
+                        self._write(404, {"error": "not found"})
+                except Exception as exc:
+                    self._write(500, {"error": str(exc)})
+
+            def do_DELETE(self):
+                try:
+                    parts = self.path.strip("/").split("/")
+                    if len(parts) == 4 and parts[:2] == ["state", "pods"]:
+                        ns, name = parts[2], parts[3]
+                        pod = outer.app.backend.get("pods", ns, name)
+                        if pod is None:
+                            self._write(404, {"error": "pod not found"})
+                        else:
+                            outer.app.backend.delete_pod(pod)
+                            self._write(200, {"deleted": name})
+                    else:
+                        self._write(404, {"error": "not found"})
+                except Exception as exc:  # e.g. concurrent-delete race
+                    self._write(500, {"error": str(exc)})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self.app.start_background()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="scheduler-http"
+        )
+        self._thread.start()
+        self.ready.set()
+
+    def stop(self) -> None:
+        self.ready.clear()
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.app.stop()
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            self._thread.join()
+        except KeyboardInterrupt:
+            self.stop()

@@ -149,6 +149,9 @@ class SafeDemandCache:
     def list(self) -> list[Any]:
         return self._cache.list() if self.crd_exists() else []
 
+    def queue_lengths(self) -> list[int]:
+        return self._cache.queue_lengths() if self._cache is not None else []
+
     def flush(self) -> None:
         if self._cache is not None:
             self._cache.flush()

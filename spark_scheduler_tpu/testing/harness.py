@@ -127,6 +127,9 @@ class Harness:
         binpack_algo: str = "single-az-tightly-pack",
         fifo: bool = True,
         same_az_dynamic_allocation: bool = False,
+        metrics=None,
+        events=None,
+        waste=None,
         **config_kw,
     ):
         self.backend = InMemoryBackend()
@@ -143,6 +146,9 @@ class Harness:
                 sync_writes=True,
                 **config_kw,
             ),
+            metrics=metrics,
+            events=events,
+            waste=waste,
         )
         self.extender = self.app.extender
         # suppress time-gap reconciliation in deterministic tests

@@ -1,0 +1,162 @@
+"""End-to-end over the wire: state-sync a cluster, then gang-schedule a Spark
+app through POST /predicates with real k8s-shaped ExtenderArgs JSON.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from spark_scheduler_tpu.metrics import MetricRegistry, SchedulerMetrics
+from spark_scheduler_tpu.server.app import build_scheduler_app
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
+
+INSTANCE_GROUP_LABEL = "resource_channel"
+GROUP = "batch-medium-priority"
+
+
+def _k8s_node(name, zone="zone1"):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {
+                "failure-domain.beta.kubernetes.io/zone": zone,
+                INSTANCE_GROUP_LABEL: GROUP,
+            },
+        },
+        "status": {
+            "allocatable": {"cpu": "8", "memory": "8Gi", "nvidia.com/gpu": "1"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def _k8s_spark_pod(app_id, role, name, executors=2):
+    annotations = {
+        "spark-driver-cpu": "1",
+        "spark-driver-mem": "1Gi",
+        "spark-executor-cpu": "1",
+        "spark-executor-mem": "1Gi",
+        "spark-executor-count": str(executors),
+    }
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "ns",
+            "uid": f"uid-{name}",
+            "labels": {"spark-role": role, "spark-app-id": app_id},
+            "annotations": annotations,
+            "creationTimestamp": "2026-07-29T12:00:00Z",
+        },
+        "spec": {
+            "schedulerName": "spark-scheduler",
+            "nodeSelector": {INSTANCE_GROUP_LABEL: GROUP},
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {"requests": {"cpu": "1", "memory": "1Gi"}},
+                }
+            ],
+        },
+        "status": {"phase": "Pending"},
+    }
+
+
+def _request(port, method, path, payload=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else None,
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture()
+def server():
+    backend = InMemoryBackend()
+    backend.register_crd(DEMAND_CRD)
+    registry = MetricRegistry()
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=True,
+            binpack_algo="single-az-tightly-pack",
+            instance_group_label=INSTANCE_GROUP_LABEL,
+            sync_writes=True,
+        ),
+        metrics=SchedulerMetrics(registry, INSTANCE_GROUP_LABEL),
+    )
+    srv = SchedulerHTTPServer(app, registry, port=0)  # ephemeral port
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_gang_schedule_over_http(server):
+    port = server.port
+    status, body = _request(port, "GET", "/status/liveness")
+    assert status == 200 and body["status"] == "up"
+    status, body = _request(port, "GET", "/status/readiness")
+    assert status == 200
+
+    for i in range(4):
+        status, _ = _request(port, "PUT", "/state/nodes", _k8s_node(f"n{i}"))
+        assert status == 200
+
+    node_names = [f"n{i}" for i in range(4)]
+
+    # Driver: gang admission over the extender protocol.
+    driver = _k8s_spark_pod("app-http", "driver", "app-http-driver")
+    _request(port, "PUT", "/state/pods", driver)
+    status, result = _request(
+        port, "POST", "/predicates", {"Pod": driver, "NodeNames": node_names}
+    )
+    assert status == 200
+    assert result["NodeNames"], f"driver rejected: {result}"
+    driver_node = result["NodeNames"][0]
+    assert driver_node in node_names and not result["FailedNodes"]
+
+    # Simulate the bind, then schedule both executors onto reserved slots.
+    driver["spec"]["nodeName"] = driver_node
+    driver["status"]["phase"] = "Running"
+    _request(port, "PUT", "/state/pods", driver)
+    for i in range(2):
+        ex = _k8s_spark_pod("app-http", "executor", f"app-http-exec-{i}")
+        _request(port, "PUT", "/state/pods", ex)
+        status, result = _request(
+            port, "POST", "/predicates", {"Pod": ex, "NodeNames": node_names}
+        )
+        assert status == 200 and result["NodeNames"], f"executor rejected: {result}"
+        ex["spec"]["nodeName"] = result["NodeNames"][0]
+        _request(port, "PUT", "/state/pods", ex)
+
+    # An app too large for the cluster fails every node with failure-fit.
+    big = _k8s_spark_pod("app-big", "driver", "app-big-driver", executors=100)
+    _request(port, "PUT", "/state/pods", big)
+    status, result = _request(
+        port, "POST", "/predicates", {"Pod": big, "NodeNames": node_names}
+    )
+    assert status == 200 and not result["NodeNames"]
+    assert set(result["FailedNodes"]) == set(node_names)
+
+    # Metrics flowed.
+    status, snap = _request(port, "GET", "/metrics")
+    assert status == 200
+    assert "foundry.spark.scheduler.requests" in snap
+
+
+def test_non_spark_pod_rejected(server):
+    port = server.port
+    _request(port, "PUT", "/state/nodes", _k8s_node("n0"))
+    pod = {
+        "metadata": {"name": "web", "namespace": "ns", "labels": {}},
+        "spec": {"containers": []},
+    }
+    status, result = _request(
+        port, "POST", "/predicates", {"Pod": pod, "NodeNames": ["n0"]}
+    )
+    assert status == 200 and not result["NodeNames"]

@@ -1,0 +1,234 @@
+"""Observability: scheduler metrics through a real scheduling flow, periodic
+reporters, waste phase attribution, and business events.
+"""
+
+import numpy as np
+
+from spark_scheduler_tpu.events import EventEmitter
+from spark_scheduler_tpu.metrics import (
+    CacheReporter,
+    MetricRegistry,
+    QueueReporter,
+    SchedulerMetrics,
+    SoftReservationReporter,
+    UsageReporter,
+    WasteReporter,
+)
+from spark_scheduler_tpu.metrics import reporters as R
+from spark_scheduler_tpu.metrics import scheduler_metrics as SM
+from spark_scheduler_tpu.metrics.waste import SCHEDULING_WASTE
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    INSTANCE_GROUP_LABEL,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _scheduled_harness(metrics=None, events=None):
+    h = Harness(metrics=metrics, events=events)
+    h.add_nodes(*[new_node(f"n{i}") for i in range(4)])
+    pods = static_allocation_spark_pods("app-1", 2)
+    names = [f"n{i}" for i in range(4)]
+    results = h.schedule_app(pods, names)
+    assert all(r.ok for r in results)
+    return h
+
+
+def test_schedule_flow_populates_metrics():
+    metrics = SchedulerMetrics(instance_group_label=INSTANCE_GROUP_LABEL)
+    _scheduled_harness(metrics=metrics)
+    snap = metrics.registry.snapshot()
+
+    requests = snap[SM.REQUEST_COUNTER]
+    by_role = {(e["tags"]["sparkrole"], e["tags"]["outcome"]): e["value"] for e in requests}
+    assert by_role[("driver", "success")] == 1
+    assert by_role[("executor", "success")] == 2
+    assert all(
+        e["tags"]["instance-group"] == "batch-medium-priority" for e in requests
+    )
+    assert snap[SM.SCHEDULE_TIME][0]["count"] >= 1
+    # Packing efficiency histograms exist for all four dimensions.
+    dims = {e["tags"]["dimension"] for e in snap[SM.PACKING_EFFICIENCY]}
+    assert dims == {"CPU", "Memory", "GPU", "Max"}
+    # One-zone cluster: pairs exist, none cross-zone.
+    total = next(e["value"] for e in snap[SM.TOTAL_TRAFFIC])
+    cross = next(e["value"] for e in snap[SM.CROSS_AZ_TRAFFIC])
+    assert total == 3 and cross == 0  # driver+2 executors = C(3,2) pairs
+
+
+def test_failed_attempt_then_success_marks_retry_time():
+    clock = FakeClock()
+    metrics = SchedulerMetrics(instance_group_label=INSTANCE_GROUP_LABEL, clock=clock)
+    h = Harness(metrics=metrics)
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("big-app", 40)  # doesn't fit
+    r = h.schedule(pods[0], ["n0"])
+    assert not r.ok
+    clock.advance(30.0)
+    # Capacity arrives; retry succeeds.
+    h.add_nodes(*[new_node(f"m{i}") for i in range(8)])
+    r2 = h.schedule(pods[0], ["n0"] + [f"m{i}" for i in range(8)])
+    assert r2.ok
+    snap = metrics.registry.snapshot()
+    retry = [
+        e for e in snap[SM.RETRY_TIME] if e["tags"]["outcome"] == "success"
+    ]
+    assert retry and abs(retry[0]["max"] - 30.0) < 1e-6
+
+
+def test_usage_cache_soft_reporters():
+    registry = MetricRegistry()
+    h = _scheduled_harness()
+    UsageReporter(registry, h.app.reservation_manager).report_once()
+    CacheReporter(
+        registry, {"resourcereservations": h.app.rr_cache}
+    ).report_once()
+    SoftReservationReporter(registry, h.app.soft_store).report_once()
+    snap = registry.snapshot()
+    # 3 pods x (1 CPU = 1000 milli) on some nodes.
+    cpu_total = sum(e["value"] for e in snap[R.USAGE_CPU])
+    assert cpu_total == 3000
+    assert next(e["value"] for e in snap[R.CACHED_OBJECTS]) == 1  # one RR
+    assert next(e["value"] for e in snap[R.SOFT_RESERVATION_COUNT]) == 0
+
+    # Node usage disappears after the app's pods die -> stale series dropped.
+    for p in h.backend.list_pods():
+        h.terminate_pod(p)
+    h.app.reservation_manager.compact_dynamic_allocation_applications()
+
+
+def test_queue_reporter_lifecycles():
+    clock = FakeClock(t=100.0)
+    registry = MetricRegistry()
+    h = Harness()
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-q", 30)  # will not fit
+    r = h.schedule(pods[0], ["n0"])
+    assert not r.ok
+    rep = QueueReporter(registry, h.backend, INSTANCE_GROUP_LABEL, clock=clock)
+    clock.advance(5.0)
+    rep.report_once()
+    snap = registry.snapshot()
+    queued = [
+        e for e in snap[R.LIFECYCLE_COUNT] if e["tags"]["lifecycle"] == "queued"
+    ]
+    assert queued and queued[0]["value"] == 1
+    stuck = []
+    rep2 = QueueReporter(
+        registry, h.backend, INSTANCE_GROUP_LABEL, clock=clock,
+        on_stuck_pod=lambda pod, lc, age: stuck.append(pod.name),
+    )
+    clock.advance(13 * 3600.0)
+    rep2.report_once()
+    assert stuck == [pods[0].name]
+
+
+def test_waste_reporter_phases():
+    clock = FakeClock(t=0.0)
+    w = WasteReporter(instance_group_label=INSTANCE_GROUP_LABEL, clock=clock)
+    pods = static_allocation_spark_pods("app-w", 1)
+    driver = pods[0]
+    w.mark_failed_scheduling_attempt(driver, "failure-fit")
+    clock.advance(10.0)  # 10s before demand creation
+    w.on_demand_created(driver.key)
+    clock.advance(20.0)
+    w.on_demand_fulfilled(driver.key)
+    clock.advance(5.0)  # 5s after fulfillment, no further failures
+    w.on_pod_scheduled(driver)
+    snap = w.registry.snapshot()
+    by_type = {e["tags"]["wastetype"]: e for e in snap[SCHEDULING_WASTE]}
+    assert abs(by_type["before-demand-creation"]["max"] - 10.0) < 1e-6
+    assert abs(by_type["after-demand-fulfilled"]["max"] - 5.0) < 1e-6
+    assert "after-demand-fulfilled-no-failures" in by_type
+    assert "total-time-no-demand" not in by_type
+
+    # No-demand path.
+    w2 = WasteReporter(instance_group_label=INSTANCE_GROUP_LABEL, clock=clock)
+    w2.mark_failed_scheduling_attempt(driver, "failure-fit")
+    clock.advance(7.0)
+    w2.on_pod_scheduled(driver)
+    snap2 = w2.registry.snapshot()
+    types2 = {e["tags"]["wastetype"] for e in snap2[SCHEDULING_WASTE]}
+    assert types2 == {"total-time-no-demand"}
+
+
+def test_queue_reporter_clears_stale_series():
+    clock = FakeClock(t=100.0)
+    registry = MetricRegistry()
+    h = Harness()
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-s", 30)
+    h.schedule(pods[0], ["n0"])  # fails -> queued
+    rep = QueueReporter(registry, h.backend, INSTANCE_GROUP_LABEL, clock=clock)
+    rep.report_once()
+    assert any(
+        e["tags"]["lifecycle"] == "queued"
+        for e in registry.snapshot()[R.LIFECYCLE_COUNT]
+    )
+    h.delete_pod(pods[0])  # queue empties
+    rep.report_once()
+    assert R.LIFECYCLE_COUNT not in registry.snapshot()
+
+
+def test_waste_reporter_wired_through_app():
+    """The production wiring: demand creation, demand fulfillment (external
+    autoscaler), and pod scheduling all feed the waste reporter."""
+    clock = FakeClock(t=0.0)
+    w = WasteReporter(instance_group_label=INSTANCE_GROUP_LABEL, clock=clock)
+    h = Harness(waste=w)
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-ww", 20)
+    r = h.schedule(pods[0], ["n0"])
+    assert not r.ok  # failed attempt + demand created, via the wiring
+    clock.advance(4.0)
+    # External autoscaler fulfills the demand.
+    demand = h.demands()[0]
+    import dataclasses as dc
+
+    from spark_scheduler_tpu.models.demands import PHASE_FULFILLED
+
+    updated = dc.replace(demand)
+    updated.status = dc.replace(demand.status, phase=PHASE_FULFILLED)
+    h.backend.update("demands", updated)
+    clock.advance(6.0)
+    # Capacity arrives; driver schedules -> waste attributed.
+    h.add_nodes(*[new_node(f"w{i}") for i in range(8)])
+    r2 = h.schedule(pods[0], ["n0"] + [f"w{i}" for i in range(8)])
+    assert r2.ok
+    snap = w.registry.snapshot()
+    by_type = {e["tags"]["wastetype"]: e for e in snap[SCHEDULING_WASTE]}
+    assert abs(by_type["after-demand-fulfilled"]["max"] - 6.0) < 1e-6
+
+
+def test_events_emitted():
+    events = []
+    emitter = EventEmitter(
+        sink=events.append, instance_group_label=INSTANCE_GROUP_LABEL
+    )
+    h = Harness(events=emitter)
+    h.add_nodes(*[new_node(f"n{i}") for i in range(2)])
+    pods = static_allocation_spark_pods("app-e", 1)
+    h.schedule_app(pods, ["n0", "n1"])
+    names = [e["event"] for e in events]
+    assert "foundry.spark.scheduler.application_scheduled" in names
+    sched = next(e for e in events if e["event"].endswith("application_scheduled"))
+    assert sched["sparkAppID"] == "app-e"
+    assert sched["minExecutorCount"] == 1
+
+    # Demand events: app that does not fit creates a demand.
+    big = static_allocation_spark_pods("app-big", 50)
+    r = h.schedule(big[0], ["n0", "n1"])
+    assert not r.ok
+    assert any(e["event"].endswith("demand_created") for e in events)
