@@ -64,10 +64,13 @@ def main(argv=None) -> int:
         backend, config, metrics=metrics, events=events, waste=waste
     )
 
-    class _Cleanups:  # periodic state eviction on the reporter tick
+    class _Cleanups:  # periodic state eviction + metric flush on the tick
         def report_once(self):
             waste.cleanup()
             metrics.report_once()
+            if config.metrics_log:
+                with open(config.metrics_log, "a") as f:
+                    registry.emit(f)
 
     reporters = ReporterRunner(
         [

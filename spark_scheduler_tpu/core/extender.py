@@ -151,12 +151,6 @@ class SparkSchedulerExtender:
             )
         if node is None:
             return self._fail(args, outcome, message or outcome)
-        if role == ROLE_DRIVER and self._events is not None:
-            try:
-                app_resources = spark_resources(pod)
-                self._events.emit_application_scheduled(pod, app_resources)
-            except SparkPodError:
-                pass
         return ExtenderFilterResult(node_names=[node], failed_nodes={}, outcome=outcome)
 
     # ------------------------------------------------------------- plumbing
@@ -251,6 +245,10 @@ class SparkSchedulerExtender:
             )
         except ReservationError as exc:
             return None, FAILURE_INTERNAL, str(exc)
+        if self._events is not None:
+            # Only on fresh admission — the idempotent-retry branch above
+            # must not double-emit application_scheduled (events.go:27-50).
+            self._events.emit_application_scheduled(driver, app_resources)
         return packing.driver_node, SUCCESS, ""
 
     def _fit_earlier_drivers(

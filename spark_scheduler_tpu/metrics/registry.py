@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import threading
-from typing import Iterator
 
 
 def _key(name: str, tags: dict[str, str] | None) -> tuple:
@@ -66,14 +65,6 @@ class Histogram:
             else:  # reservoir replacement, deterministic stride
                 self._values[self._count % self._cap] = value
 
-    def percentile(self, q: float) -> float:
-        with self._lock:
-            if not self._values:
-                return 0.0
-            vs = sorted(self._values)
-            idx = min(int(q * len(vs)), len(vs) - 1)
-            return vs[idx]
-
     def stats(self) -> dict:
         with self._lock:
             vs = sorted(self._values)
@@ -116,14 +107,6 @@ class MetricRegistry:
         """Drop a metric series (stale-tag cleanup, usage.go:96-113)."""
         with self._lock:
             self._metrics.pop(_key(name, tags), None)
-
-    def series(self, name: str) -> Iterator[tuple[dict[str, str], object]]:
-        """All (tags, metric) series registered under `name`."""
-        with self._lock:
-            items = list(self._metrics.items())
-        for (n, tags), m in items:
-            if n == name:
-                yield dict(tags), m
 
     def snapshot(self) -> dict:
         """{name: [{tags, kind, value|stats}]} — test/reporting view."""

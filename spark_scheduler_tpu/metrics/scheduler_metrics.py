@@ -8,6 +8,7 @@ over; tag names likewise (metrics.go:61-76).
 
 from __future__ import annotations
 
+import threading
 import time
 
 from spark_scheduler_tpu.core.sparkpods import find_instance_group
@@ -54,6 +55,8 @@ class SchedulerMetrics:
         # accumulate forever).
         self._first_failure: dict[tuple[str, str], float] = {}
         self._first_failure_max_age_s = 6 * 3600.0
+        # Request threads and the reporter tick both touch _first_failure.
+        self._ff_lock = threading.Lock()
 
     def _group(self, pod) -> str:
         return find_instance_group(pod, self._label) or ""
@@ -72,25 +75,29 @@ class SchedulerMetrics:
         self.registry.histogram(WAIT_TIME, **tags).update(
             max(now - pod.creation_timestamp, 0.0)
         )
-        first = self._first_failure.get(pod.key)
+        with self._ff_lock:
+            first = self._first_failure.get(pod.key)
+            if outcome.startswith("success"):
+                self._first_failure.pop(pod.key, None)
         if first is not None:
             self.registry.histogram(RETRY_TIME, **tags).update(max(now - first, 0.0))
-        if outcome.startswith("success"):
-            self._first_failure.pop(pod.key, None)
 
     def mark_failed_scheduling_attempt(self, pod, outcome: str):
-        self._first_failure.setdefault(pod.key, self._clock())
+        with self._ff_lock:
+            self._first_failure.setdefault(pod.key, self._clock())
 
     def forget_pod(self, pod) -> None:
         """Pod deleted without ever scheduling — drop its retry state."""
-        self._first_failure.pop(pod.key, None)
+        with self._ff_lock:
+            self._first_failure.pop(pod.key, None)
 
     def report_once(self) -> None:
         """Periodic eviction of abandoned retry state (ReporterRunner tick)."""
         cutoff = self._clock() - self._first_failure_max_age_s
-        self._first_failure = {
-            k: t for k, t in self._first_failure.items() if t > cutoff
-        }
+        with self._ff_lock:
+            stale = [k for k, t in self._first_failure.items() if t <= cutoff]
+            for k in stale:
+                del self._first_failure[k]
 
     def mark_reconciliation_finished(self, elapsed_s: float, instance_group: str = ""):
         self.registry.histogram(

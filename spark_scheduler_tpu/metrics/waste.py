@@ -17,6 +17,7 @@ terminated are dropped after the 6h cleanup tick (waste.go:279-298).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 
 from spark_scheduler_tpu.core.sparkpods import find_instance_group
@@ -48,31 +49,40 @@ class WasteReporter:
         self._label = instance_group_label
         self._clock = clock
         self._pods: dict[tuple[str, str], _PodInfo] = {}
-
-    def _info(self, key) -> _PodInfo:
-        return self._pods.setdefault(key, _PodInfo())
+        # Request threads, informer callbacks, and the reporter tick all
+        # touch _pods.
+        self._lock = threading.Lock()
 
     # --------------------------------------------------------------- inputs
 
     def mark_failed_scheduling_attempt(self, pod, outcome: str) -> None:
         now = self._clock()
-        info = self._info(pod.key)
-        if info.first_failure is None:
-            info.first_failure = now
-        info.last_failure = now
+        with self._lock:
+            info = self._pods.setdefault(pod.key, _PodInfo())
+            if info.first_failure is None:
+                info.first_failure = now
+            info.last_failure = now
 
     def on_demand_created(self, pod_key) -> None:
-        self._info(pod_key).demand_created = self._clock()
+        now = self._clock()
+        with self._lock:
+            self._pods.setdefault(pod_key, _PodInfo()).demand_created = now
 
     def on_demand_fulfilled(self, pod_key) -> None:
-        self._info(pod_key).demand_fulfilled = self._clock()
+        now = self._clock()
+        with self._lock:
+            self._pods.setdefault(pod_key, _PodInfo()).demand_fulfilled = now
 
     def on_pod_scheduled(self, pod) -> None:
-        info = self._pods.get(pod.key)
-        if info is None or info.done is not None:
-            return
         now = self._clock()
-        info.done = now
+        with self._lock:
+            info = self._pods.get(pod.key)
+            if info is None or info.done is not None:
+                return
+            # Claim the transition under the lock so a concurrently
+            # delivered duplicate update can't double-count the histograms.
+            info = dataclasses.replace(info, done=now)
+            self._pods[pod.key] = info
         group = find_instance_group(pod, self._label) or ""
 
         def mark(waste_type: str, duration: float) -> None:
@@ -104,17 +114,22 @@ class WasteReporter:
                 )
 
     def on_pod_deleted(self, pod) -> None:
-        info = self._pods.get(pod.key)
-        if info is not None and info.done is None:
-            info.done = self._clock()
+        now = self._clock()
+        with self._lock:
+            info = self._pods.get(pod.key)
+            if info is not None and info.done is None:
+                info.done = now
 
     # -------------------------------------------------------------- cleanup
 
     def cleanup(self) -> None:
         """Drop entries finished more than 6h ago (waste.go:279-298)."""
         now = self._clock()
-        self._pods = {
-            k: v
-            for k, v in self._pods.items()
-            if v.done is None or now - v.done < CLEANUP_AFTER_S
-        }
+        with self._lock:
+            stale = [
+                k
+                for k, v in self._pods.items()
+                if v.done is not None and now - v.done >= CLEANUP_AFTER_S
+            ]
+            for k in stale:
+                del self._pods[k]

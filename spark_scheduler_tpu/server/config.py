@@ -39,6 +39,10 @@ class InstallConfig:
     executor_prioritized_node_label: Optional[LabelPriorityOrder] = None
     port: int = 8484
     sync_writes: bool = False  # drain write-back inline (tests/single-thread)
+    # Append a JSON line per metric series on every reporter tick (the
+    # reference's 30s metric flush, metrics/metrics.go:79). None = off;
+    # metrics remain pollable at GET /metrics either way.
+    metrics_log: Optional[str] = None
 
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
@@ -83,6 +87,7 @@ class InstallConfig:
             driver_prioritized_node_label=label_prio("driver-prioritized-node-label"),
             executor_prioritized_node_label=label_prio("executor-prioritized-node-label"),
             port=int(raw.get("port", 8484)),
+            metrics_log=raw.get("metrics-log"),
         )
 
 

@@ -6,7 +6,8 @@ Routes (all JSON):
                               (ExtenderArgs -> ExtenderFilterResult,
                               cmd/endpoints.go:28-42)
   GET  /status/liveness       200 when the process is up
-  GET  /status/readiness      200 once state is synced and solver warm
+  GET  /status/readiness      200 once cluster state has been synced
+                              (at least one node known to the backend)
   GET  /metrics               metric-registry snapshot
   PUT  /state/nodes           upsert a k8s Node object   \  informer-watch
   PUT  /state/pods            upsert a k8s Pod object     } substitute: the
@@ -79,10 +80,21 @@ class SchedulerHTTPServer:
                     except Exception as exc:
                         self._write(500, {"Error": str(exc)})
                         return
-                    with outer._predicate_lock:
-                        result = outer.app.extender.predicate(
-                            ExtenderArgs(pod=pod, node_names=node_names)
+                    try:
+                        with outer._predicate_lock:
+                            result = outer.app.extender.predicate(
+                                ExtenderArgs(pod=pod, node_names=node_names)
+                            )
+                    except Exception as exc:
+                        # Internal errors ride the protocol's Error channel
+                        # (ExtenderFilterResult.Error) so kube-scheduler gets
+                        # a well-formed response instead of a dropped
+                        # connection.
+                        self._write(
+                            200,
+                            {"NodeNames": [], "FailedNodes": {}, "Error": str(exc)},
                         )
+                        return
                     self._write(200, filter_result_to_k8s(result))
                 else:
                     self._write(404, {"error": "not found"})
@@ -96,6 +108,7 @@ class SchedulerHTTPServer:
                             outer.app.backend.add_node(node)
                         else:
                             outer.app.backend.update("nodes", node)
+                        outer.ready.set()  # first synced node => ready
                         self._write(200, {"applied": node.name})
                     elif self.path == "/state/pods":
                         pod = pod_from_k8s(self._body())
@@ -138,7 +151,11 @@ class SchedulerHTTPServer:
             target=self._server.serve_forever, daemon=True, name="scheduler-http"
         )
         self._thread.start()
-        self.ready.set()
+        # Ready only once cluster state exists; pre-seeded backends (tests,
+        # embedded use) are ready at once, otherwise the first successful
+        # PUT /state/nodes flips it.
+        if self.app.backend.list_nodes():
+            self.ready.set()
 
     def stop(self) -> None:
         self.ready.clear()

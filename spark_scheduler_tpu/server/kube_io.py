@@ -16,8 +16,13 @@ from spark_scheduler_tpu.models.resources import Resources
 
 
 def _parse_time(val) -> float:
+    """Missing/unparsable creationTimestamp => "now": treating it as epoch 0
+    would give ~56-year pod ages, tripping stuck-pod detection and poisoning
+    the wait-time histograms."""
+    import time as _time
+
     if val is None:
-        return 0.0
+        return _time.time()
     if isinstance(val, (int, float)):
         return float(val)
     import datetime
@@ -25,7 +30,7 @@ def _parse_time(val) -> float:
     try:
         return datetime.datetime.fromisoformat(str(val).replace("Z", "+00:00")).timestamp()
     except ValueError:
-        return 0.0
+        return _time.time()
 
 
 def _resources_from_requests(requests: dict | None) -> Resources:

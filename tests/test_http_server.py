@@ -71,8 +71,11 @@ def _request(port, method, path, payload=None):
         method=method,
         headers={"Content-Type": "application/json"},
     )
-    with urllib.request.urlopen(req) as resp:
-        return resp.status, json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read())
 
 
 @pytest.fixture()
@@ -100,12 +103,18 @@ def test_gang_schedule_over_http(server):
     port = server.port
     status, body = _request(port, "GET", "/status/liveness")
     assert status == 200 and body["status"] == "up"
+    # Not ready until cluster state has been synced (no nodes known yet);
+    # gating kube-scheduler traffic on this avoids spurious failure-fit
+    # demands against an empty cluster.
     status, body = _request(port, "GET", "/status/readiness")
-    assert status == 200
+    assert status == 503 and body["ready"] is False
 
     for i in range(4):
         status, _ = _request(port, "PUT", "/state/nodes", _k8s_node(f"n{i}"))
         assert status == 200
+
+    status, body = _request(port, "GET", "/status/readiness")
+    assert status == 200 and body["ready"] is True
 
     node_names = [f"n{i}" for i in range(4)]
 

@@ -91,7 +91,8 @@ def test_failed_attempt_then_success_marks_retry_time():
 def test_usage_cache_soft_reporters():
     registry = MetricRegistry()
     h = _scheduled_harness()
-    UsageReporter(registry, h.app.reservation_manager).report_once()
+    usage_reporter = UsageReporter(registry, h.app.reservation_manager)
+    usage_reporter.report_once()
     CacheReporter(
         registry, {"resourcereservations": h.app.rr_cache}
     ).report_once()
@@ -103,10 +104,14 @@ def test_usage_cache_soft_reporters():
     assert next(e["value"] for e in snap[R.CACHED_OBJECTS]) == 1  # one RR
     assert next(e["value"] for e in snap[R.SOFT_RESERVATION_COUNT]) == 0
 
-    # Node usage disappears after the app's pods die -> stale series dropped.
-    for p in h.backend.list_pods():
-        h.terminate_pod(p)
-    h.app.reservation_manager.compact_dynamic_allocation_applications()
+    # Reservation goes away (app finished, RR deleted) -> the per-node usage
+    # series must be unregistered on the next tick (usage.go:96-113).
+    for rr in h.app.rr_cache.list():
+        h.app.rr_cache.delete(rr.namespace, rr.name)
+    usage_reporter.report_once()
+    snap2 = registry.snapshot()
+    assert R.USAGE_CPU not in snap2 or not snap2[R.USAGE_CPU]
+    assert R.USAGE_MEMORY not in snap2 or not snap2[R.USAGE_MEMORY]
 
 
 def test_queue_reporter_lifecycles():
