@@ -1,7 +1,11 @@
 """CLI (cmd/root.go:22-35, cmd/server.go:44-54):
 
   python -m spark_scheduler_tpu server [--config install.yml] [--port N]
+  python -m spark_scheduler_tpu conversion-webhook [--port N]
   python -m spark_scheduler_tpu version
+
+`conversion-webhook` is the standalone CRD-conversion service the reference
+ships as a second binary (spark-scheduler-conversion-webhook/main.go:27).
 """
 
 from __future__ import annotations
@@ -20,10 +24,24 @@ def main(argv=None) -> int:
     srv.add_argument("--config", help="install YAML (config/config.go:24-84 surface)")
     srv.add_argument("--host", default="0.0.0.0")
     srv.add_argument("--port", type=int, default=None)
+    cw = sub.add_parser(
+        "conversion-webhook", help="run the standalone CRD-conversion webhook"
+    )
+    cw.add_argument("--host", default="0.0.0.0")
+    cw.add_argument("--port", type=int, default=8485)
     args = parser.parse_args(argv)
 
     if args.command == "version":
         print(__version__)
+        return 0
+    if args.command == "conversion-webhook":
+        from spark_scheduler_tpu.server.http import ConversionWebhookServer
+
+        server = ConversionWebhookServer(host=args.host, port=args.port)
+        print(
+            f"conversion webhook serving on {args.host}:{server.port}", file=sys.stderr
+        )
+        server.serve_forever()
         return 0
     if args.command != "server":
         parser.print_help()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 
 
 def _key(name: str, tags: dict[str, str] | None) -> tuple:
@@ -123,8 +124,12 @@ class MetricRegistry:
             out.setdefault(name, []).append(entry)
         return out
 
-    def emit(self, stream) -> None:
-        """One JSON line per metric series (the 30s metric flush analog)."""
+    def emit(self, stream, now: float | None = None) -> None:
+        """One JSON line per metric series (the 30s metric flush analog).
+        Every line of a flush carries the same `time` so readers can group
+        lines into ticks and plot the values as a time series."""
+        if now is None:
+            now = time.time()
         for name, entries in self.snapshot().items():
             for e in entries:
-                stream.write(json.dumps({"metric": name, **e}) + "\n")
+                stream.write(json.dumps({"time": now, "metric": name, **e}) + "\n")

@@ -23,8 +23,12 @@ from spark_scheduler_tpu.core.soft_reservations import SoftReservationStore
 from spark_scheduler_tpu.core.sparkpods import SparkPodLister
 from spark_scheduler_tpu.core.unschedulable import UnschedulablePodMarker
 from spark_scheduler_tpu.server.config import InstallConfig
-from spark_scheduler_tpu.store.backend import ClusterBackend
+from spark_scheduler_tpu.store.backend import ClusterBackend, DEMAND_CRD
 from spark_scheduler_tpu.store.cache import ResourceReservationCache, SafeDemandCache
+from spark_scheduler_tpu.store.crd import (
+    LazyDemandCRDWatcher,
+    ensure_resource_reservations_crd,
+)
 
 
 @dataclasses.dataclass
@@ -42,13 +46,16 @@ class SchedulerApp:
     solver: PlacementSolver
     extender: SparkSchedulerExtender
     unschedulable_marker: UnschedulablePodMarker
+    demand_crd_watcher: LazyDemandCRDWatcher
 
     def start_background(self) -> None:
         """Async write-back workers + background loops (cmd/server.go:239-247)."""
         self.rr_cache.start()
         self.unschedulable_marker.start()
+        self.demand_crd_watcher.start()
 
     def stop(self) -> None:
+        self.demand_crd_watcher.stop()
         self.unschedulable_marker.stop()
         self.rr_cache.flush()
         self.rr_cache.stop()
@@ -68,6 +75,10 @@ def build_scheduler_app(
 
     config = config or InstallConfig()
     clock = clock or _time.time
+
+    # The scheduler owns its reservation CRD: create-or-upgrade + verify
+    # Established before anything consumes it (cmd/server.go:103-109).
+    ensure_resource_reservations_crd(backend)
 
     rr_cache = ResourceReservationCache(
         backend,
@@ -94,7 +105,12 @@ def build_scheduler_app(
         events=events,
         waste=waste,
     )
-    start_demand_gc(backend, demand_manager)
+    # Demand features activate only once the Demand CRD exists — it belongs
+    # to the external autoscaler and may appear any time after startup
+    # (demand_informer.go:75-138). SafeDemandCache additionally gates every
+    # operation; the watcher wires the push-style consumers (GC, waste).
+    demand_crd_watcher = LazyDemandCRDWatcher(backend, DEMAND_CRD)
+    demand_crd_watcher.on_ready(lambda: start_demand_gc(backend, demand_manager))
 
     # Waste / retry-state lifecycle hooks (waste.go:90-146 informer hookup):
     # pod scheduled -> close out waste phases; pod deleted -> drop state.
@@ -121,7 +137,9 @@ def build_scheduler_app(
                 pod_name = new.name[len(DEMAND_NAME_PREFIX):]
                 waste.on_demand_fulfilled((new.namespace, pod_name))
 
-        backend.subscribe("demands", on_update=_on_demand_update)
+        demand_crd_watcher.on_ready(
+            lambda: backend.subscribe("demands", on_update=_on_demand_update)
+        )
     solver = PlacementSolver(
         driver_label_priority=(
             config.driver_prioritized_node_label.as_tuple()
@@ -173,6 +191,10 @@ def build_scheduler_app(
         timeout_s=config.unschedulable_pod_timeout_s,
         clock=clock,
     )
+    # A pre-existing Demand CRD (registered before the app was built)
+    # activates demand features synchronously; otherwise the background
+    # poll in start_background() picks it up.
+    demand_crd_watcher.check_now()
     return SchedulerApp(
         backend=backend,
         config=config,
@@ -187,4 +209,5 @@ def build_scheduler_app(
         solver=solver,
         extender=extender,
         unschedulable_marker=marker,
+        demand_crd_watcher=demand_crd_watcher,
     )

@@ -1,0 +1,388 @@
+"""CRD version-conversion webhook (SURVEY.md L9).
+
+Rebuilds the reference's conversion webhook
+(internal/conversionwebhook/resource_reservation.go:44-98 and the standalone
+service spark-scheduler-conversion-webhook/): a `POST /convert` route that
+receives a Kubernetes `ConversionReview` and converts CRD objects between
+served versions:
+
+  ResourceReservation  sparkscheduler.palantir.com  v1beta1 <-> v1beta2
+  Demand               scaler.palantir.com          v1alpha1 <-> v1alpha2
+
+Wire-object codecs live here (the apiserver speaks JSON-shaped CRD objects);
+the pure model-to-model conversion rules live in
+`models.reservations` / `models.demands` (the k8s-free layer, mirroring
+v1beta1/conversion_resource_reservation.go:29-121 and apis/scaler/v1alpha1).
+Unknown fields are preserved verbatim where the round-trip annotation
+carries them; unknown groups/versions fail the review with a `Failed`
+result, matching controller-runtime's conversion handler behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from spark_scheduler_tpu.models.demands import (
+    Demand,
+    DemandSpec,
+    DemandStatus,
+    DemandUnit,
+    DemandUnitV1Alpha1,
+    DemandV1Alpha1,
+    convert_demand_from_v1alpha1,
+    convert_demand_to_v1alpha1,
+)
+from spark_scheduler_tpu.models.reservations import (
+    Reservation,
+    ReservationSpec,
+    ReservationStatus,
+    ReservationV1Beta1,
+    ResourceReservation,
+    ResourceReservationV1Beta1,
+    convert_from_v1beta1,
+    convert_to_v1beta1,
+)
+from spark_scheduler_tpu.models.resources import Resources
+
+SPARK_SCHEDULER_GROUP = "sparkscheduler.palantir.com"
+SCALER_GROUP = "scaler.palantir.com"
+
+RR_V1BETA1 = f"{SPARK_SCHEDULER_GROUP}/v1beta1"
+RR_V1BETA2 = f"{SPARK_SCHEDULER_GROUP}/v1beta2"
+DEMAND_V1ALPHA1 = f"{SCALER_GROUP}/v1alpha1"
+DEMAND_V1ALPHA2 = f"{SCALER_GROUP}/v1alpha2"
+
+
+# ---------------------------------------------------------------- quantities
+
+
+def _quantity_milli(milli: int) -> str:
+    """Milli-units -> k8s quantity string ("1500m", or "2" when integral)."""
+    if milli % 1000 == 0:
+        return str(milli // 1000)
+    return f"{milli}m"
+
+
+def _quantity_kib(kib: int) -> str:
+    return f"{kib}Ki"
+
+
+def _resources_to_wire(res: Resources) -> dict:
+    out = {
+        "cpu": _quantity_milli(res.cpu_milli),
+        "memory": _quantity_kib(res.mem_kib),
+    }
+    if res.gpu_milli:
+        out["nvidia.com/gpu"] = _quantity_milli(res.gpu_milli)
+    return out
+
+
+def _resources_from_wire(raw: dict | None) -> Resources:
+    raw = raw or {}
+    return Resources.from_quantities(
+        str(raw.get("cpu", "0")),
+        str(raw.get("memory", "0")),
+        str(raw.get("nvidia.com/gpu", "0")),
+    )
+
+
+def _metadata_to_wire(obj) -> dict:
+    meta: dict[str, Any] = {"name": obj.name, "namespace": obj.namespace}
+    if obj.labels:
+        meta["labels"] = dict(obj.labels)
+    annotations = getattr(obj, "annotations", None)
+    if annotations:
+        meta["annotations"] = dict(annotations)
+    if obj.resource_version:
+        meta["resourceVersion"] = str(obj.resource_version)
+    return meta
+
+
+def _metadata_fields(raw: dict, *, with_annotations: bool = True) -> dict:
+    meta = raw.get("metadata") or {}
+    rv = meta.get("resourceVersion") or 0
+    out = {
+        "name": meta.get("name", ""),
+        "namespace": meta.get("namespace", "default"),
+        "labels": dict(meta.get("labels") or {}),
+        "resource_version": int(rv),
+    }
+    if with_annotations:  # the Demand models carry no annotations
+        out["annotations"] = dict(meta.get("annotations") or {})
+    return out
+
+
+# ------------------------------------------------- ResourceReservation wire
+
+
+def rr_v1beta2_to_wire(rr: ResourceReservation) -> dict:
+    """types_resource_reservation.go:40-102 (v1beta2 storage shape)."""
+    return {
+        "apiVersion": RR_V1BETA2,
+        "kind": "ResourceReservation",
+        "metadata": _metadata_to_wire(rr),
+        "spec": {
+            "reservations": {
+                name: {"node": r.node, "resources": _resources_to_wire(r.resources)}
+                for name, r in rr.spec.reservations.items()
+            }
+        },
+        "status": {"pods": dict(rr.status.pods)},
+    }
+
+
+def rr_v1beta2_from_wire(raw: dict) -> ResourceReservation:
+    reservations = {
+        name: Reservation(
+            node=r.get("node", ""),
+            resources=_resources_from_wire(r.get("resources")),
+        )
+        for name, r in ((raw.get("spec") or {}).get("reservations") or {}).items()
+    }
+    return ResourceReservation(
+        spec=ReservationSpec(reservations),
+        status=ReservationStatus(dict((raw.get("status") or {}).get("pods") or {})),
+        **_metadata_fields(raw),
+    )
+
+
+def rr_v1beta1_to_wire(rr1: ResourceReservationV1Beta1) -> dict:
+    """v1beta1 flat shape (types_resource_reservation.go:22-68): per-slot
+    {node, cpu, memory}; GPU travels in the reservation-spec annotation."""
+    return {
+        "apiVersion": RR_V1BETA1,
+        "kind": "ResourceReservation",
+        "metadata": _metadata_to_wire(rr1),
+        "spec": {
+            "reservations": {
+                name: {
+                    "node": r.node,
+                    "cpu": _quantity_milli(r.cpu_milli),
+                    "memory": _quantity_kib(r.mem_kib),
+                }
+                for name, r in rr1.reservations.items()
+            }
+        },
+        "status": {"pods": dict(rr1.pods)},
+    }
+
+
+def rr_v1beta1_from_wire(raw: dict) -> ResourceReservationV1Beta1:
+    reservations = {}
+    for name, r in ((raw.get("spec") or {}).get("reservations") or {}).items():
+        res = _resources_from_wire({"cpu": r.get("cpu", "0"), "memory": r.get("memory", "0")})
+        reservations[name] = ReservationV1Beta1(
+            node=r.get("node", ""), cpu_milli=res.cpu_milli, mem_kib=res.mem_kib
+        )
+    return ResourceReservationV1Beta1(
+        reservations=reservations,
+        pods=dict((raw.get("status") or {}).get("pods") or {}),
+        **_metadata_fields(raw),
+    )
+
+
+def _parse_transition_time(val) -> float:
+    """Accept epoch numbers or RFC3339 strings (k8s metav1.Time)."""
+    if val is None:
+        return 0.0
+    if isinstance(val, (int, float)):
+        return float(val)
+    import datetime
+
+    try:
+        return datetime.datetime.fromisoformat(
+            str(val).replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        return 0.0
+
+
+# --------------------------------------------------------------- Demand wire
+
+
+def demand_v1alpha2_to_wire(d: Demand) -> dict:
+    """types_demand.go:23-157 (v1alpha2, status subresource)."""
+    spec: dict[str, Any] = {
+        "units": [
+            {
+                "resources": _resources_to_wire(u.resources),
+                "count": u.count,
+                "podNamesByNamespace": {
+                    ns: list(names) for ns, names in u.pod_names_by_namespace.items()
+                },
+            }
+            for u in d.spec.units
+        ],
+        "instanceGroup": d.spec.instance_group,
+    }
+    if d.spec.is_long_lived:
+        spec["isLongLived"] = True
+    if d.spec.enforce_single_zone_scheduling:
+        spec["enforceSingleZoneScheduling"] = True
+    if d.spec.zone:
+        spec["zone"] = d.spec.zone
+    status: dict[str, Any] = {}
+    if d.status.phase:
+        status["phase"] = d.status.phase
+    if d.status.last_transition_time:
+        status["lastTransitionTime"] = d.status.last_transition_time
+    if d.status.fulfilled_zone:
+        status["fulfilledZone"] = d.status.fulfilled_zone
+    return {
+        "apiVersion": DEMAND_V1ALPHA2,
+        "kind": "Demand",
+        "metadata": _metadata_to_wire(d),
+        "spec": spec,
+        "status": status,
+    }
+
+
+def demand_v1alpha2_from_wire(raw: dict) -> Demand:
+    spec_raw = raw.get("spec") or {}
+    units = [
+        DemandUnit(
+            resources=_resources_from_wire(u.get("resources")),
+            count=int(u.get("count", 0)),
+            pod_names_by_namespace={
+                ns: list(names)
+                for ns, names in (u.get("podNamesByNamespace") or {}).items()
+            },
+        )
+        for u in spec_raw.get("units") or []
+    ]
+    status_raw = raw.get("status") or {}
+    return Demand(
+        spec=DemandSpec(
+            units=units,
+            instance_group=spec_raw.get("instanceGroup", ""),
+            is_long_lived=bool(spec_raw.get("isLongLived", False)),
+            enforce_single_zone_scheduling=bool(
+                spec_raw.get("enforceSingleZoneScheduling", False)
+            ),
+            zone=spec_raw.get("zone") or None,
+        ),
+        status=DemandStatus(
+            phase=status_raw.get("phase", ""),
+            last_transition_time=_parse_transition_time(
+                status_raw.get("lastTransitionTime")
+            ),
+            fulfilled_zone=status_raw.get("fulfilledZone") or None,
+        ),
+        **_metadata_fields(raw, with_annotations=False),
+    )
+
+
+def demand_v1alpha1_to_wire(d1: DemandV1Alpha1) -> dict:
+    """v1alpha1 legacy shape (apis/scaler/v1alpha1): units carry a flat
+    cpu/memory pair and no zone semantics."""
+    return {
+        "apiVersion": DEMAND_V1ALPHA1,
+        "kind": "Demand",
+        "metadata": _metadata_to_wire(d1),
+        "spec": {
+            "units": [
+                {
+                    "cpu": _quantity_milli(u.cpu_milli),
+                    "memory": _quantity_kib(u.mem_kib),
+                    "count": u.count,
+                }
+                for u in d1.units
+            ],
+            "instanceGroup": d1.instance_group,
+            "isLongLived": d1.is_long_lived,
+        },
+        "status": {"phase": d1.phase} if d1.phase else {},
+    }
+
+
+def demand_v1alpha1_from_wire(raw: dict) -> DemandV1Alpha1:
+    spec_raw = raw.get("spec") or {}
+    units = []
+    for u in spec_raw.get("units") or []:
+        res = _resources_from_wire({"cpu": u.get("cpu", "0"), "memory": u.get("memory", "0")})
+        units.append(
+            DemandUnitV1Alpha1(
+                cpu_milli=res.cpu_milli, mem_kib=res.mem_kib, count=int(u.get("count", 0))
+            )
+        )
+    return DemandV1Alpha1(
+        units=units,
+        instance_group=spec_raw.get("instanceGroup", ""),
+        is_long_lived=bool(spec_raw.get("isLongLived", False)),
+        phase=(raw.get("status") or {}).get("phase", ""),
+        **_metadata_fields(raw, with_annotations=False),
+    )
+
+
+# ------------------------------------------------------------- review logic
+
+_DECODERS: dict[str, Callable[[dict], Any]] = {
+    RR_V1BETA1: rr_v1beta1_from_wire,
+    RR_V1BETA2: rr_v1beta2_from_wire,
+    DEMAND_V1ALPHA1: demand_v1alpha1_from_wire,
+    DEMAND_V1ALPHA2: demand_v1alpha2_from_wire,
+}
+
+
+def _convert_object(raw: dict, desired: str) -> dict:
+    src = raw.get("apiVersion", "")
+    decode = _DECODERS.get(src)
+    if decode is None:
+        raise ValueError(f"unsupported apiVersion {src!r}")
+    if desired not in _DECODERS:
+        raise ValueError(f"unsupported desiredAPIVersion {desired!r}")
+    if src == desired:
+        return raw
+    obj = decode(raw)
+
+    if src == RR_V1BETA1:
+        obj = convert_from_v1beta1(obj)
+    elif src == DEMAND_V1ALPHA1:
+        obj = convert_demand_from_v1alpha1(obj)
+    # obj is now the hub (storage) model: v1beta2 RR or v1alpha2 Demand.
+
+    if desired == RR_V1BETA2:
+        return rr_v1beta2_to_wire(obj)
+    if desired == RR_V1BETA1:
+        return rr_v1beta1_to_wire(convert_to_v1beta1(obj))
+    if desired == DEMAND_V1ALPHA2:
+        return demand_v1alpha2_to_wire(obj)
+    return demand_v1alpha1_to_wire(convert_demand_to_v1alpha1(obj))
+
+
+def convert_review(review: dict) -> dict:
+    """Handle a ConversionReview (conversionwebhook/resource_reservation.go:
+    44-98): convert request.objects to request.desiredAPIVersion; any failure
+    fails the whole review (the apiserver retries)."""
+    if not isinstance(review, dict):
+        review = {}
+    request = review.get("request")
+    if not isinstance(request, dict):
+        request = {}
+    uid = request.get("uid", "")
+    desired = request.get("desiredAPIVersion", "")
+    converted = []
+    try:
+        objects = request.get("objects") or []
+        if not isinstance(objects, list):
+            raise ValueError("request.objects must be a list")
+        for raw in objects:
+            if not isinstance(raw, dict):
+                raise ValueError("conversion objects must be JSON objects")
+            converted.append(_convert_object(raw, desired))
+        response: dict[str, Any] = {
+            "uid": uid,
+            "convertedObjects": converted,
+            "result": {"status": "Success"},
+        }
+    except Exception as exc:
+        response = {
+            "uid": uid,
+            "convertedObjects": [],
+            "result": {"status": "Failed", "message": str(exc)},
+        }
+    return {
+        "apiVersion": review.get("apiVersion", "apiextensions.k8s.io/v1"),
+        "kind": "ConversionReview",
+        "response": response,
+    }

@@ -5,6 +5,9 @@ Routes (all JSON):
   POST /predicates            kube-scheduler extender filter call
                               (ExtenderArgs -> ExtenderFilterResult,
                               cmd/endpoints.go:28-42)
+  POST /convert               CRD version-conversion webhook
+                              (ConversionReview, SURVEY.md L9; also served
+                              standalone by ConversionWebhookServer)
   GET  /status/liveness       200 when the process is up
   GET  /status/readiness      200 once cluster state has been synced
                               (at least one node known to the backend)
@@ -27,12 +30,50 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from spark_scheduler_tpu.core.extender import ExtenderArgs
+from spark_scheduler_tpu.server.conversion import convert_review
 from spark_scheduler_tpu.server.kube_io import (
     extender_args_from_k8s,
     filter_result_to_k8s,
     node_from_k8s,
     pod_from_k8s,
 )
+
+
+class _JSONHandler(BaseHTTPRequestHandler):
+    """Shared JSON plumbing + the routes both servers serve
+    (liveness, POST /convert)."""
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _write(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _handle_liveness(self) -> None:
+        self._write(200, {"status": "up"})
+
+    def _handle_convert(self) -> None:
+        try:
+            review = self._body()
+        except Exception as exc:
+            self._write(400, {"error": str(exc)})
+            return
+        self._write(200, convert_review(review))
+
+
+def _run_threaded(server: ThreadingHTTPServer, name: str) -> threading.Thread:
+    thread = threading.Thread(target=server.serve_forever, daemon=True, name=name)
+    thread.start()
+    return thread
 
 
 class SchedulerHTTPServer:
@@ -45,25 +86,10 @@ class SchedulerHTTPServer:
         self._predicate_lock = threading.Lock()
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args):  # quiet
-                pass
-
-            def _write(self, code: int, payload) -> None:
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def _body(self):
-                length = int(self.headers.get("Content-Length") or 0)
-                return json.loads(self.rfile.read(length) or b"{}")
-
+        class Handler(_JSONHandler):
             def do_GET(self):
                 if self.path == "/status/liveness":
-                    self._write(200, {"status": "up"})
+                    self._handle_liveness()
                 elif self.path == "/status/readiness":
                     code = 200 if outer.ready.is_set() else 503
                     self._write(code, {"ready": outer.ready.is_set()})
@@ -96,6 +122,8 @@ class SchedulerHTTPServer:
                         )
                         return
                     self._write(200, filter_result_to_k8s(result))
+                elif self.path == "/convert":
+                    self._handle_convert()
                 else:
                     self._write(404, {"error": "not found"})
 
@@ -147,10 +175,7 @@ class SchedulerHTTPServer:
 
     def start(self) -> None:
         self.app.start_background()
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True, name="scheduler-http"
-        )
-        self._thread.start()
+        self._thread = _run_threaded(self._server, "scheduler-http")
         # Ready only once cluster state exists; pre-seeded backends (tests,
         # embedded use) are ready at once, otherwise the first successful
         # PUT /state/nodes flips it.
@@ -163,6 +188,48 @@ class SchedulerHTTPServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
         self.app.stop()
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            self._thread.join()
+        except KeyboardInterrupt:
+            self.stop()
+
+
+class ConversionWebhookServer:
+    """Standalone conversion-webhook service (the reference ships this as a
+    second binary: spark-scheduler-conversion-webhook/cmd/server.go:39-54).
+    Serves only POST /convert + liveness; no scheduler state."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8485):
+        class Handler(_JSONHandler):
+            def do_GET(self):
+                if self.path == "/status/liveness":
+                    self._handle_liveness()
+                else:
+                    self._write(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path == "/convert":
+                    self._handle_convert()
+                else:
+                    self._write(404, {"error": "not found"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = _run_threaded(self._server, "conversion-http")
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
 
     def serve_forever(self) -> None:
         self.start()

@@ -85,6 +85,11 @@ class InMemoryBackend(ClusterBackend):
         with self._lock:
             return name in self._crds
 
+    def unregister_crd(self, name: str) -> None:
+        """Delete-on-failed-verify path (crd/utils.go:134-149)."""
+        with self._lock:
+            self._crds.discard(name)
+
     # -- event subscription -------------------------------------------------
 
     def subscribe(

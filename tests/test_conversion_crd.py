@@ -1,0 +1,212 @@
+"""CRD version conversion (webhook + pure converters) and CRD lifecycle
+(ensure/verify, lazy Demand-CRD watching)."""
+
+import threading
+
+from spark_scheduler_tpu.models.demands import (
+    Demand,
+    DemandSpec,
+    DemandStatus,
+    DemandUnit,
+)
+from spark_scheduler_tpu.models.reservations import (
+    Reservation,
+    ReservationSpec,
+    ReservationStatus,
+    ResourceReservation,
+)
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.server.conversion import (
+    DEMAND_V1ALPHA1,
+    DEMAND_V1ALPHA2,
+    RR_V1BETA1,
+    RR_V1BETA2,
+    convert_review,
+    demand_v1alpha2_to_wire,
+    rr_v1beta2_from_wire,
+    rr_v1beta2_to_wire,
+)
+from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
+from spark_scheduler_tpu.store.crd import (
+    CRDError,
+    LazyDemandCRDWatcher,
+    ensure_resource_reservations_crd,
+)
+
+
+def _rr() -> ResourceReservation:
+    return ResourceReservation(
+        name="app-1",
+        namespace="ns",
+        labels={"spark-app-id": "app-1"},
+        resource_version=7,
+        spec=ReservationSpec(
+            {
+                "driver": Reservation("n0", Resources(1000, 1024 * 1024, 0)),
+                "executor-1": Reservation("n1", Resources(2000, 2 * 1024 * 1024, 1000)),
+            }
+        ),
+        status=ReservationStatus({"driver": "drv-pod"}),
+    )
+
+
+def _review(objects, desired):
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "request": {"uid": "u-1", "desiredAPIVersion": desired, "objects": objects},
+    }
+
+
+def test_rr_roundtrip_through_webhook_preserves_gpu():
+    wire2 = rr_v1beta2_to_wire(_rr())
+    # Downgrade to v1beta1 over the webhook...
+    out = convert_review(_review([wire2], RR_V1BETA1))
+    assert out["response"]["result"]["status"] == "Success"
+    assert out["response"]["uid"] == "u-1"
+    (old,) = out["response"]["convertedObjects"]
+    assert old["apiVersion"] == RR_V1BETA1
+    # v1beta1 is flat {node, cpu, memory}; GPU survives via the annotation.
+    slot = old["spec"]["reservations"]["executor-1"]
+    assert set(slot) == {"node", "cpu", "memory"}
+    assert "reservation-spec" in old["metadata"]["annotations"]
+    # ...and back up: lossless round-trip (conversion_resource_reservation.go:29-121).
+    back = convert_review(_review([old], RR_V1BETA2))
+    (new,) = back["response"]["convertedObjects"]
+    rr2 = rr_v1beta2_from_wire(new)
+    assert rr2.spec.reservations["executor-1"].resources.gpu_milli == 1000
+    assert rr2.spec.reservations["executor-1"].node == "n1"
+    assert rr2.status.pods == {"driver": "drv-pod"}
+    # The round-trip carrier annotation is consumed on upgrade.
+    assert "reservation-spec" not in rr2.annotations
+
+
+def test_demand_downgrade_and_upgrade():
+    d = Demand(
+        name="demand-pod-1",
+        namespace="ns",
+        spec=DemandSpec(
+            instance_group="ig",
+            units=[
+                DemandUnit(
+                    Resources(500, 1024, 0),
+                    count=3,
+                    pod_names_by_namespace={"ns": ["pod-1"]},
+                )
+            ],
+            enforce_single_zone_scheduling=True,
+            zone="z1",
+        ),
+        status=DemandStatus(phase="pending"),
+    )
+    wire = demand_v1alpha2_to_wire(d)
+    out = convert_review(_review([wire], DEMAND_V1ALPHA1))
+    (old,) = out["response"]["convertedObjects"]
+    assert old["apiVersion"] == DEMAND_V1ALPHA1
+    assert old["spec"]["units"][0]["count"] == 3
+    back = convert_review(_review([old], DEMAND_V1ALPHA2))
+    (new,) = back["response"]["convertedObjects"]
+    assert new["spec"]["units"][0]["resources"]["cpu"] == "500m"
+    assert new["status"]["phase"] == "pending"
+    # Zone affinity is a v1alpha2-only concept: lost on downgrade, absent
+    # after the round trip (v1alpha1 has no carrier annotation).
+    assert "zone" not in new["spec"]
+
+
+def test_same_version_passthrough_and_unknown_version_fails():
+    wire = rr_v1beta2_to_wire(_rr())
+    out = convert_review(_review([wire], RR_V1BETA2))
+    assert out["response"]["convertedObjects"] == [wire]
+
+    bad = dict(wire, apiVersion="sparkscheduler.palantir.com/v9")
+    out = convert_review(_review([bad, wire], RR_V1BETA2))
+    assert out["response"]["result"]["status"] == "Failed"
+    assert "v9" in out["response"]["result"]["message"]
+    assert out["response"]["convertedObjects"] == []
+
+
+def test_webhook_over_http_inproc_and_standalone():
+    import json
+    import urllib.request
+
+    from spark_scheduler_tpu.server.http import ConversionWebhookServer
+
+    srv = ConversionWebhookServer(port=0)
+    srv.start()
+    try:
+        review = _review([rr_v1beta2_to_wire(_rr())], RR_V1BETA1)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/convert",
+            data=json.dumps(review).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body["kind"] == "ConversionReview"
+        assert body["response"]["result"]["status"] == "Success"
+        (obj,) = body["response"]["convertedObjects"]
+        assert obj["apiVersion"] == RR_V1BETA1
+    finally:
+        srv.stop()
+
+
+def test_ensure_reservation_crd_creates_and_verifies():
+    backend = InMemoryBackend()
+    backend.unregister_crd("resourcereservations.sparkscheduler.palantir.com")
+    assert not backend.crd_exists(
+        "resourcereservations.sparkscheduler.palantir.com"
+    )
+    ensure_resource_reservations_crd(backend)
+    assert backend.crd_exists("resourcereservations.sparkscheduler.palantir.com")
+
+
+def test_ensure_crd_deletes_on_failed_verify():
+    class NeverEstablished(InMemoryBackend):
+        def register_crd(self, name):
+            pass  # create "succeeds" but never reports Established
+
+        def crd_exists(self, name):
+            return False
+
+    unregistered = []
+    backend = NeverEstablished()
+    backend.unregister_crd = lambda name: unregistered.append(name)
+    try:
+        ensure_resource_reservations_crd(
+            backend, name="rr-crd", timeout_s=0.01, sleep=lambda s: None
+        )
+        raise AssertionError("expected CRDError")
+    except CRDError:
+        pass
+    assert unregistered == ["rr-crd"]  # half-created CRD torn down
+
+
+def test_lazy_demand_watcher_fires_once_on_crd_arrival():
+    backend = InMemoryBackend()  # no demand CRD registered yet
+    watcher = LazyDemandCRDWatcher(backend, DEMAND_CRD, poll_interval_s=0.01)
+    fired = []
+    watcher.on_ready(lambda: fired.append("a"))
+    assert not watcher.check_now() and fired == []
+
+    watcher.start()
+    backend.register_crd(DEMAND_CRD)
+    assert watcher.wait_ready(timeout=5.0)
+    watcher.stop()
+    assert fired == ["a"]
+    # Late registration fires immediately; ready callbacks never re-fire.
+    watcher.on_ready(lambda: fired.append("b"))
+    assert fired == ["a", "b"]
+    assert watcher.check_now()
+
+
+def test_lazy_watcher_callbacks_race_free():
+    backend = InMemoryBackend()
+    watcher = LazyDemandCRDWatcher(backend, DEMAND_CRD, poll_interval_s=0.001)
+    fired = []
+    for i in range(8):
+        watcher.on_ready(lambda i=i: fired.append(i))
+    threads = [threading.Thread(target=watcher.check_now) for _ in range(8)]
+    backend.register_crd(DEMAND_CRD)
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert sorted(fired) == list(range(8))  # each callback exactly once
