@@ -1,0 +1,291 @@
+// Native runtime for the TPU scheduler framework.
+//
+// Two host-side components that sit on the request path around the XLA
+// solver (the runtime slots of SURVEY.md §2d):
+//
+//   ClusterArena  — dense per-slot cluster state (allocatable, zone, flags,
+//                   priority ranks) with O(1) upsert/remove and a single-call
+//                   snapshot that materializes the ClusterTensors inputs
+//                   (available = clip(alloc - usage - overhead),
+//                   schedulable = clip(alloc - overhead)) into caller
+//                   buffers. Replaces the per-request Python walk over all
+//                   nodes (the reference rebuilds string-keyed maps per
+//                   request, resources.go:61-100; we rebuild nothing).
+//
+//   ShardedQueue  — the async write-back queue (store/queue.go:22-144
+//                   semantics): per-key dedup via an inflight set, FNV-1a
+//                   sharding so one key always lands on the same consumer,
+//                   bounded per-shard buffers, blocking/non-blocking add,
+//                   blocking pop with timeout. Payloads stay in Python;
+//                   the queue moves opaque u64 ticket ids.
+//
+// Exposed as a C ABI for ctypes. No Python.h dependency so it builds with
+// a bare g++ -shared -fPIC.
+
+#include <algorithm>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr int kDims = 3;
+constexpr int32_t kInt32Inf = 2147483647 / 2;  // models/resources.INT32_INF
+
+inline int32_t clip64(int64_t v) {
+  if (v > kInt32Inf) return kInt32Inf;
+  if (v < -kInt32Inf) return -kInt32Inf;
+  return static_cast<int32_t>(v);
+}
+
+// ----------------------------------------------------------- ClusterArena
+
+struct ClusterArena {
+  std::mutex mu;
+  // Slot-indexed, grown on demand; slot indices are owned by the Python
+  // NodeRegistry (stable across churn, recycled+masked like cluster.py).
+  std::vector<int64_t> alloc;        // [cap * 3]
+  std::vector<int32_t> zone_id;      // [cap]
+  std::vector<int32_t> name_rank;    // [cap]
+  std::vector<int32_t> lr_driver;    // [cap]
+  std::vector<int32_t> lr_executor;  // [cap]
+  std::vector<uint8_t> unschedulable;
+  std::vector<uint8_t> ready;
+  std::vector<uint8_t> valid;
+  int64_t capacity = 0;
+
+  void ensure(int64_t idx) {
+    if (idx < capacity) return;
+    int64_t cap = std::max<int64_t>(8, capacity);
+    while (cap <= idx) cap *= 2;
+    alloc.resize(cap * kDims, 0);
+    zone_id.resize(cap, 0);
+    name_rank.resize(cap, kInt32Inf);
+    lr_driver.resize(cap, kInt32Inf);
+    lr_executor.resize(cap, kInt32Inf);
+    unschedulable.resize(cap, 0);
+    ready.resize(cap, 0);
+    valid.resize(cap, 0);
+    capacity = cap;
+  }
+};
+
+// ----------------------------------------------------------- ShardedQueue
+
+struct Shard {
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::condition_variable not_full;
+  std::deque<uint64_t> tickets;
+};
+
+struct ShardedQueue {
+  std::vector<Shard> shards;
+  size_t buffer_size;
+  std::mutex inflight_mu;
+  std::unordered_set<std::string> inflight;
+
+  ShardedQueue(size_t buckets, size_t buffer)
+      : shards(buckets), buffer_size(buffer) {}
+};
+
+uint32_t fnv1a32(const char* data, size_t len) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < len; ++i) {
+    h ^= static_cast<uint8_t>(data[i]);
+    h *= 16777619u;
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- arena ----------------------------------------------------------------
+
+void* arena_create() { return new ClusterArena(); }
+
+void arena_destroy(void* h) { delete static_cast<ClusterArena*>(h); }
+
+void arena_upsert(void* h, int64_t idx, const int64_t* alloc3, int32_t zone,
+                  int32_t unschedulable, int32_t ready, int32_t lr_driver,
+                  int32_t lr_executor) {
+  auto* a = static_cast<ClusterArena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  a->ensure(idx);
+  std::memcpy(&a->alloc[idx * kDims], alloc3, kDims * sizeof(int64_t));
+  a->zone_id[idx] = zone;
+  a->unschedulable[idx] = unschedulable ? 1 : 0;
+  a->ready[idx] = ready ? 1 : 0;
+  a->lr_driver[idx] = lr_driver;
+  a->lr_executor[idx] = lr_executor;
+  a->valid[idx] = 1;
+}
+
+void arena_remove(void* h, int64_t idx) {
+  auto* a = static_cast<ClusterArena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  if (idx < a->capacity) {
+    a->valid[idx] = 0;
+    a->name_rank[idx] = kInt32Inf;
+  }
+}
+
+// ranks: [n_pairs] slot indices in name-sorted order. Slots not listed keep
+// their previous rank only if still valid; callers pass the full live set.
+void arena_set_name_ranks(void* h, const int64_t* sorted_idx, int64_t n) {
+  auto* a = static_cast<ClusterArena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  std::fill(a->name_rank.begin(), a->name_rank.end(), kInt32Inf);
+  for (int64_t r = 0; r < n; ++r) {
+    int64_t idx = sorted_idx[r];
+    a->ensure(idx);
+    a->name_rank[idx] = static_cast<int32_t>(r);
+  }
+}
+
+// Materialize the solver inputs for slots [0, n) into caller buffers.
+// usage/overhead are [n*3] int64 (sparse scatter done by the caller into a
+// reusable buffer); outputs are the ClusterTensors fields.
+void arena_snapshot(void* h, int64_t n, const int64_t* usage,
+                    const int64_t* overhead, int32_t* available,
+                    int32_t* schedulable, int32_t* zone_id, int32_t* name_rank,
+                    int32_t* lr_driver, int32_t* lr_executor,
+                    uint8_t* unschedulable, uint8_t* ready, uint8_t* valid) {
+  auto* a = static_cast<ClusterArena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  a->ensure(n > 0 ? n - 1 : 0);
+  for (int64_t i = 0; i < n; ++i) {
+    for (int d = 0; d < kDims; ++d) {
+      int64_t al = a->alloc[i * kDims + d];
+      int64_t ov = overhead[i * kDims + d];
+      int64_t us = usage[i * kDims + d];
+      available[i * kDims + d] = clip64(al - us - ov);
+      schedulable[i * kDims + d] = clip64(al - ov);
+    }
+    zone_id[i] = a->zone_id[i];
+    name_rank[i] = a->name_rank[i];
+    lr_driver[i] = a->lr_driver[i];
+    lr_executor[i] = a->lr_executor[i];
+    unschedulable[i] = a->unschedulable[i];
+    ready[i] = a->ready[i];
+    valid[i] = a->valid[i];
+  }
+}
+
+int64_t arena_capacity(void* h) {
+  auto* a = static_cast<ClusterArena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  return a->capacity;
+}
+
+// ---- queue ----------------------------------------------------------------
+
+void* queue_create(int64_t buckets, int64_t buffer_size) {
+  return new ShardedQueue(static_cast<size_t>(buckets),
+                          static_cast<size_t>(buffer_size));
+}
+
+void queue_destroy(void* h) { delete static_cast<ShardedQueue*>(h); }
+
+int64_t queue_bucket(void* h, const char* key, int64_t key_len) {
+  auto* q = static_cast<ShardedQueue*>(h);
+  return fnv1a32(key, static_cast<size_t>(key_len)) % q->shards.size();
+}
+
+// Dedup semantics of queue.go:58-68: every request marks the key inflight
+// if absent; a request whose key was already inflight is dropped (the
+// consumer reads the latest object from the store anyway) UNLESS it is a
+// delete — deletes always enqueue so created-then-deleted objects still
+// reach the backend. Returns 0 when dropped, 1 when enqueued. Blocks while
+// the shard buffer is full.
+int32_t queue_add_if_absent(void* h, const char* key, int64_t key_len,
+                            uint64_t ticket, int32_t is_delete) {
+  auto* q = static_cast<ShardedQueue*>(h);
+  std::string k(key, static_cast<size_t>(key_len));
+  bool added;
+  {
+    std::lock_guard<std::mutex> lock(q->inflight_mu);
+    added = q->inflight.insert(k).second;
+  }
+  if (!added && !is_delete) return 0;
+  Shard& s = q->shards[fnv1a32(key, key_len) % q->shards.size()];
+  std::unique_lock<std::mutex> lock(s.mu);
+  s.not_full.wait(lock, [&] { return s.tickets.size() < q->buffer_size; });
+  s.tickets.push_back(ticket);
+  s.not_empty.notify_one();
+  return 1;
+}
+
+// Non-blocking variant (TryAddIfAbsent, queue.go:73-88): returns -1 if the
+// shard buffer is full (caller handles overflow; the inflight mark this
+// call added is rolled back), else as add_if_absent.
+int32_t queue_try_add_if_absent(void* h, const char* key, int64_t key_len,
+                                uint64_t ticket, int32_t is_delete) {
+  auto* q = static_cast<ShardedQueue*>(h);
+  std::string k(key, static_cast<size_t>(key_len));
+  bool added;
+  {
+    std::lock_guard<std::mutex> lock(q->inflight_mu);
+    added = q->inflight.insert(k).second;
+  }
+  if (!added && !is_delete) return 0;
+  Shard& s = q->shards[fnv1a32(key, key_len) % q->shards.size()];
+  std::unique_lock<std::mutex> lock(s.mu);
+  if (s.tickets.size() >= q->buffer_size) {
+    lock.unlock();
+    if (added) {
+      std::lock_guard<std::mutex> ilock(q->inflight_mu);
+      q->inflight.erase(k);
+    }
+    return -1;
+  }
+  s.tickets.push_back(ticket);
+  s.not_empty.notify_one();
+  return 1;
+}
+
+// Blocking pop with timeout; returns 1 and fills *ticket, or 0 on timeout.
+int32_t queue_pop(void* h, int64_t bucket, int64_t timeout_ms,
+                  uint64_t* ticket) {
+  auto* q = static_cast<ShardedQueue*>(h);
+  Shard& s = q->shards[static_cast<size_t>(bucket)];
+  std::unique_lock<std::mutex> lock(s.mu);
+  if (!s.not_empty.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                            [&] { return !s.tickets.empty(); })) {
+    return 0;
+  }
+  *ticket = s.tickets.front();
+  s.tickets.pop_front();
+  s.not_full.notify_one();
+  return 1;
+}
+
+// Consumers release the key from the inflight set when they start working
+// on it, so later mutations re-enqueue (queue.go:90-104).
+void queue_release(void* h, const char* key, int64_t key_len) {
+  auto* q = static_cast<ShardedQueue*>(h);
+  std::lock_guard<std::mutex> lock(q->inflight_mu);
+  q->inflight.erase(std::string(key, static_cast<size_t>(key_len)));
+}
+
+int64_t queue_len(void* h, int64_t bucket) {
+  auto* q = static_cast<ShardedQueue*>(h);
+  Shard& s = q->shards[static_cast<size_t>(bucket)];
+  std::lock_guard<std::mutex> lock(s.mu);
+  return static_cast<int64_t>(s.tickets.size());
+}
+
+int64_t queue_num_buckets(void* h) {
+  auto* q = static_cast<ShardedQueue*>(h);
+  return static_cast<int64_t>(q->shards.size());
+}
+
+}  // extern "C"

@@ -20,12 +20,14 @@ from typing import NamedTuple, Optional, Sequence
 import numpy as np
 import jax.numpy as jnp
 
+from spark_scheduler_tpu import native
 from spark_scheduler_tpu.models.cluster import (
+    ClusterTensors,
     NodeRegistry,
     build_cluster_tensors,
 )
 from spark_scheduler_tpu.models.kube import Node
-from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.models.resources import INT32_INF, NUM_DIMS, Resources
 from spark_scheduler_tpu.ops import BINPACK_FUNCTIONS
 from spark_scheduler_tpu.ops.efficiency import avg_packing_efficiency
 
@@ -52,10 +54,24 @@ class PlacementSolver:
         self,
         driver_label_priority: tuple[str, list[str]] | None = None,
         executor_label_priority: tuple[str, list[str]] | None = None,
+        use_native: bool = True,
     ):
         self.registry = NodeRegistry()
         self._driver_label_priority = driver_label_priority
         self._executor_label_priority = executor_label_priority
+        # Native C++ arena (native/runtime.cpp): per-node state is upserted
+        # only when a node object actually changes, and the dense tensor
+        # inputs are materialized in one C call per request instead of a
+        # Python walk over every node.
+        self._arena = None
+        self._node_seen: dict[str, Node] = {}
+        self._rank_epoch = -1
+        if use_native and native.available():
+            self._arena = native.ClusterArena()
+
+    @property
+    def uses_native_arena(self) -> bool:
+        return self._arena is not None
 
     def build_tensors(
         self,
@@ -66,6 +82,8 @@ class PlacementSolver:
         for n in nodes:
             self.registry.intern(n.name)
         pad = _bucket(self.registry.capacity, 8)
+        if self._arena is not None:
+            return self._build_tensors_native(list(nodes), usage, overhead, pad)
         return build_cluster_tensors(
             list(nodes),
             usage,
@@ -75,6 +93,71 @@ class PlacementSolver:
             executor_label_priority=self._executor_label_priority,
             pad_to=pad,
         )
+
+    def _label_rank(self, node: Node, prio) -> int:
+        if prio is None:
+            return INT32_INF
+        label, values = prio
+        val = node.labels.get(label)
+        if val is not None and val in values:
+            return values.index(val)
+        return INT32_INF
+
+    def _build_tensors_native(
+        self,
+        nodes: list[Node],
+        usage: dict[str, Resources],
+        overhead: dict[str, Resources],
+        pad: int,
+    ) -> ClusterTensors:
+        """Arena-backed ClusterTensors. Deviation from the Python builder,
+        deliberate: name ranks are GLOBAL over all known nodes rather than
+        recomputed over the request's filtered subset — the rank values
+        differ but their relative order (all the sort kernels consume) is
+        identical for any subset."""
+        arena = self._arena
+        seen = self._node_seen
+        changed_names = False
+        for node in nodes:
+            if seen.get(node.name) is node:
+                continue
+            if node.name not in seen:
+                changed_names = True
+            seen[node.name] = node
+            idx = self.registry.intern(node.name)
+            arena.upsert(
+                idx,
+                node.allocatable.as_array(),
+                self.registry.zone_id(node.zone),
+                node.unschedulable,
+                node.ready,
+                self._label_rank(node, self._driver_label_priority),
+                self._label_rank(node, self._executor_label_priority),
+            )
+        if changed_names or self._rank_epoch < 0:
+            ordered = sorted(seen)
+            arena.set_name_ranks(
+                [self.registry.index_of(name) for name in ordered]
+            )
+            self._rank_epoch += 1
+
+        usage_t = np.zeros((pad, NUM_DIMS), dtype=np.int64)
+        overhead_t = np.zeros((pad, NUM_DIMS), dtype=np.int64)
+        for target, mapping in ((usage_t, usage), (overhead_t, overhead)):
+            for name, res in mapping.items():
+                idx = self.registry.index_of(name)
+                if idx is not None and idx < pad:
+                    target[idx] += res.as_array()
+
+        fields = arena.snapshot(pad, usage_t, overhead_t)
+        tensors = ClusterTensors(*fields)
+        # The arena knows every node ever seen; this request's candidate set
+        # is the (selector-filtered) `nodes` list — mask the rest out.
+        request_mask = np.zeros(pad, dtype=bool)
+        idxs = [self.registry.index_of(n.name) for n in nodes]
+        request_mask[[i for i in idxs if i is not None and i < pad]] = True
+        tensors.valid &= request_mask
+        return tensors
 
     def candidate_mask(self, tensors, node_names: Sequence[str]) -> np.ndarray:
         n = tensors.available.shape[0]

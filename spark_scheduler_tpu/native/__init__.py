@@ -1,0 +1,286 @@
+"""Native (C++) runtime bindings.
+
+Loads `libsched_runtime.so` (built from native/runtime.cpp), compiling it
+with g++ on first use and caching the artifact under native/build/. Exposes:
+
+  ClusterArena       — incremental dense cluster state + one-call snapshot
+                       (feeds ClusterTensors without a per-request Python
+                       walk over every node).
+  NativeShardedQueue — the write-back queue of store/queue.py with the
+                       dedup/shard/blocking semantics implemented in C++
+                       (store/queue.go:22-144 parity).
+
+`available()` reports whether the library could be built/loaded; all
+consumers fall back to the pure-Python implementations when it is False, so
+the framework works on toolchain-less hosts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "runtime.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "build", "libsched_runtime.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2",
+        "-std=c++17",
+        "-fPIC",
+        "-shared",
+        "-o",
+        _SO,
+        _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _bind(lib) -> None:
+    i64, i32, u64, u8 = (
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_uint64,
+        ctypes.c_uint8,
+    )
+    p = ctypes.POINTER
+    lib.arena_create.restype = ctypes.c_void_p
+    lib.arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.arena_upsert.argtypes = [
+        ctypes.c_void_p, i64, p(i64), i32, i32, i32, i32, i32,
+    ]
+    lib.arena_remove.argtypes = [ctypes.c_void_p, i64]
+    lib.arena_set_name_ranks.argtypes = [ctypes.c_void_p, p(i64), i64]
+    lib.arena_snapshot.argtypes = [
+        ctypes.c_void_p, i64, p(i64), p(i64), p(i32), p(i32), p(i32), p(i32),
+        p(i32), p(i32), p(u8), p(u8), p(u8),
+    ]
+    lib.arena_capacity.argtypes = [ctypes.c_void_p]
+    lib.arena_capacity.restype = i64
+    lib.queue_create.argtypes = [i64, i64]
+    lib.queue_create.restype = ctypes.c_void_p
+    lib.queue_destroy.argtypes = [ctypes.c_void_p]
+    lib.queue_bucket.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+    lib.queue_bucket.restype = i64
+    lib.queue_add_if_absent.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64, u64, i32,
+    ]
+    lib.queue_add_if_absent.restype = i32
+    lib.queue_try_add_if_absent.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64, u64, i32,
+    ]
+    lib.queue_try_add_if_absent.restype = i32
+    lib.queue_pop.argtypes = [ctypes.c_void_p, i64, i64, p(u64)]
+    lib.queue_pop.restype = i32
+    lib.queue_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+    lib.queue_len.argtypes = [ctypes.c_void_p, i64]
+    lib.queue_len.restype = i64
+    lib.queue_num_buckets.argtypes = [ctypes.c_void_p]
+    lib.queue_num_buckets.restype = i64
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            _bind(lib)
+            _lib = lib
+        except OSError:
+            _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i64p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class ClusterArena:
+    """Incremental cluster-state arena (see native/runtime.cpp)."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.arena_create()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.arena_destroy(self._h)
+            self._h = None
+
+    def upsert(
+        self,
+        idx: int,
+        alloc,  # length-3 int array (cpu_milli, mem_kib, gpu_milli)
+        zone_id: int,
+        unschedulable: bool,
+        ready: bool,
+        lr_driver: int,
+        lr_executor: int,
+    ) -> None:
+        buf = np.ascontiguousarray(alloc, dtype=np.int64)
+        self._lib.arena_upsert(
+            self._h, idx, _i64p(buf), zone_id, int(unschedulable), int(ready),
+            lr_driver, lr_executor,
+        )
+
+    def remove(self, idx: int) -> None:
+        self._lib.arena_remove(self._h, idx)
+
+    def set_name_ranks(self, sorted_indices) -> None:
+        buf = np.ascontiguousarray(sorted_indices, dtype=np.int64)
+        self._lib.arena_set_name_ranks(self._h, _i64p(buf), len(buf))
+
+    def capacity(self) -> int:
+        return int(self._lib.arena_capacity(self._h))
+
+    def snapshot(self, n: int, usage: np.ndarray, overhead: np.ndarray):
+        """Materialize ClusterTensors fields for slots [0, n).
+
+        usage/overhead: [n, 3] int64 (caller scatters the sparse maps).
+        Returns the 9 arrays in ClusterTensors field order.
+        """
+        usage = np.ascontiguousarray(usage, dtype=np.int64)
+        overhead = np.ascontiguousarray(overhead, dtype=np.int64)
+        available = np.empty((n, 3), dtype=np.int32)
+        schedulable = np.empty((n, 3), dtype=np.int32)
+        zone_id = np.empty(n, dtype=np.int32)
+        name_rank = np.empty(n, dtype=np.int32)
+        lr_driver = np.empty(n, dtype=np.int32)
+        lr_executor = np.empty(n, dtype=np.int32)
+        unschedulable = np.empty(n, dtype=np.uint8)
+        ready = np.empty(n, dtype=np.uint8)
+        valid = np.empty(n, dtype=np.uint8)
+        self._lib.arena_snapshot(
+            self._h, n, _i64p(usage), _i64p(overhead), _i32p(available),
+            _i32p(schedulable), _i32p(zone_id), _i32p(name_rank),
+            _i32p(lr_driver), _i32p(lr_executor), _u8p(unschedulable),
+            _u8p(ready), _u8p(valid),
+        )
+        return (
+            available,
+            schedulable,
+            zone_id,
+            name_rank,
+            lr_driver,
+            lr_executor,
+            unschedulable.astype(bool),
+            ready.astype(bool),
+            valid.astype(bool),
+        )
+
+
+class NativeShardedQueue:
+    """C++-backed ShardedUniqueQueue (store/queue.py interface parity).
+
+    Tickets (u64) index a Python-side table carrying the Request payloads;
+    the C++ side owns dedup, sharding, buffering, and blocking.
+    """
+
+    def __init__(self, buckets: int, buffer_size: int = 100):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.queue_create(buckets, buffer_size)
+        self._payloads: dict[int, object] = {}
+        self._next_ticket = 0
+        self._lock = threading.Lock()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.queue_destroy(self._h)
+            self._h = None
+
+    def _ticket_for(self, payload) -> int:
+        with self._lock:
+            self._next_ticket += 1
+            t = self._next_ticket
+            self._payloads[t] = payload
+        return t
+
+    @staticmethod
+    def _key_bytes(key) -> bytes:
+        return f"{key[0]}/{key[1]}".encode() if isinstance(key, tuple) else str(key).encode()
+
+    def add_if_absent(self, req) -> None:
+        kb = self._key_bytes(req.key)
+        is_delete = 1 if req.type.name == "DELETE" else 0
+        t = self._ticket_for(req)
+        if not self._lib.queue_add_if_absent(self._h, kb, len(kb), t, is_delete):
+            with self._lock:
+                self._payloads.pop(t, None)  # deduped: drop the ticket
+
+    def try_add_if_absent(self, req) -> bool:
+        kb = self._key_bytes(req.key)
+        is_delete = 1 if req.type.name == "DELETE" else 0
+        t = self._ticket_for(req)
+        rc = self._lib.queue_try_add_if_absent(self._h, kb, len(kb), t, is_delete)
+        if rc != 1:
+            with self._lock:
+                self._payloads.pop(t, None)
+        # Deduped (0) counts as success — a pending request already covers
+        # this key; only a full buffer (-1) reports failure (queue.go:73-88).
+        return rc != -1
+
+    def pop(self, bucket: int, timeout_s: float | None):
+        """Blocking pop for consumer `bucket`; None on timeout. Releases the
+        key from the inflight set so later writes re-enqueue
+        (queue.go:90-104)."""
+        ms = int((timeout_s if timeout_s is not None else 3600.0) * 1000)
+        out = ctypes.c_uint64()
+        if not self._lib.queue_pop(self._h, bucket, ms, ctypes.byref(out)):
+            return None
+        with self._lock:
+            req = self._payloads.pop(out.value)
+        kb = self._key_bytes(req.key)
+        self._lib.queue_release(self._h, kb, len(kb))
+        return req
+
+    def queue_lengths(self) -> list[int]:
+        n = self._lib.queue_num_buckets(self._h)
+        return [int(self._lib.queue_len(self._h, b)) for b in range(n)]
+
+    @property
+    def num_buckets(self) -> int:
+        return int(self._lib.queue_num_buckets(self._h))

@@ -23,7 +23,7 @@ from spark_scheduler_tpu.store.backend import (
     NotFoundError,
 )
 from spark_scheduler_tpu.store.object_store import ObjectStore
-from spark_scheduler_tpu.store.queue import Request, RequestType, ShardedUniqueQueue, drain_one
+from spark_scheduler_tpu.store.queue import Request, RequestType, ShardedUniqueQueue
 
 DEFAULT_MAX_RETRIES = 5  # config.go:72-77
 
@@ -81,10 +81,10 @@ class AsyncClient:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:
-        for i, q in enumerate(self._queue.consumers()):
+        for bucket in range(self._queue.num_buckets):
             t = threading.Thread(
-                target=self._run_worker, args=(q,), daemon=True,
-                name=f"async-{self._kind}-{i}",
+                target=self._run_worker, args=(bucket,), daemon=True,
+                name=f"async-{self._kind}-{bucket}",
             )
             t.start()
             self._threads.append(t)
@@ -92,18 +92,18 @@ class AsyncClient:
     def stop(self) -> None:
         self._stop.set()
 
-    def _run_worker(self, q) -> None:
+    def _run_worker(self, bucket: int) -> None:
         while not self._stop.is_set():
-            req = drain_one(q, timeout=0.05)
+            req = self._queue.pop(bucket, timeout_s=0.05)
             if req is not None:
                 self.process(req)
 
     def drain_sync(self) -> None:
         """Synchronously drain every shard — deterministic test mode and
         graceful-shutdown flush."""
-        for q in self._queue.consumers():
+        for bucket in range(self._queue.num_buckets):
             while True:
-                req = drain_one(q, timeout=0)
+                req = self._queue.pop(bucket, timeout_s=0)
                 if req is None:
                     break
                 self.process(req)

@@ -20,7 +20,7 @@ from spark_scheduler_tpu.store.async_client import (
 )
 from spark_scheduler_tpu.store.backend import DEMAND_CRD, ClusterBackend
 from spark_scheduler_tpu.store.object_store import ObjectStore
-from spark_scheduler_tpu.store.queue import Request, RequestType, ShardedUniqueQueue
+from spark_scheduler_tpu.store.queue import Request, RequestType, make_sharded_queue
 
 NUM_WRITE_CLIENTS = 5
 
@@ -38,7 +38,7 @@ class WriteThroughCache:
         """sync_writes=True drains the queue inline after every mutation —
         deterministic mode for tests and single-threaded deployments."""
         self._store = ObjectStore()
-        self._queue = ShardedUniqueQueue(num_clients)
+        self._queue = make_sharded_queue(num_clients)
         self._sync = sync_writes
         self.client = AsyncClient(
             backend, kind, self._store, self._queue,

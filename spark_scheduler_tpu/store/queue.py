@@ -95,11 +95,39 @@ class ShardedUniqueQueue:
     def queue_lengths(self) -> list[int]:
         return [q.qsize() for q in self._queues]
 
+    @property
+    def num_buckets(self) -> int:
+        return len(self._queues)
+
+    def pop(self, bucket: int, timeout_s: float | None) -> Request | None:
+        """Consumer-side take (same surface as the native queue): one request
+        from shard `bucket`, or None on timeout/empty."""
+        return drain_one(self._queues[bucket], timeout=timeout_s)
+
 
 def drain_one(q: _queue.Queue, timeout: float | None = None) -> Request | None:
     """Take one request thunk off a consumer queue (returns None on timeout)."""
     try:
-        thunk: Callable[[], Request] = q.get(timeout=timeout)
+        if timeout == 0:
+            thunk: Callable[[], Request] = q.get_nowait()
+        else:
+            thunk = q.get(timeout=timeout)
     except _queue.Empty:
         return None
     return thunk()
+
+
+def make_sharded_queue(
+    buckets: int,
+    buffer_size: int = QUEUE_BUFFER_SIZE,
+    prefer_native: bool = True,
+):
+    """Native C++ queue when the runtime library is available (the default),
+    else the pure-Python implementation. Both expose add_if_absent /
+    try_add_if_absent / pop / queue_lengths / num_buckets."""
+    if prefer_native:
+        from spark_scheduler_tpu import native
+
+        if native.available():
+            return native.NativeShardedQueue(buckets, buffer_size)
+    return ShardedUniqueQueue(buckets, buffer_size)
