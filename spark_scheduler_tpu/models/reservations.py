@@ -21,10 +21,17 @@ import dataclasses
 import json
 from typing import Optional
 
-from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.models.resources import (
+    Resources,
+    resources_from_quantity_map,
+    resources_to_quantity_map,
+)
 
 APP_ID_LABEL = "spark-app-id"
-RESERVATION_SPEC_ANNOTATION = "reservation-spec"  # v1beta1 round-trip carrier
+# v1beta1 round-trip carrier; fully-qualified key so reference-written objects
+# (sparkscheduler common.go:23-32 GroupName + "/reservation-spec") upgrade
+# losslessly through this webhook too.
+RESERVATION_SPEC_ANNOTATION = "sparkscheduler.palantir.com/reservation-spec"
 DRIVER_RESERVATION = "driver"
 
 
@@ -68,6 +75,12 @@ class ResourceReservation:
     annotations: dict[str, str] = dataclasses.field(default_factory=dict)
     owner_pod_uid: str = ""
     resource_version: int = 0
+    # Verbatim passthrough of metadata fields this model doesn't interpret
+    # (uid, creationTimestamp, generation, ownerReferences, finalizers, ...).
+    # The apiserver requires conversion to preserve immutable metadata, so
+    # the webhook must round-trip these (conversion_resource_reservation.go:
+    # ConvertTo/ConvertFrom DeepCopy the whole ObjectMeta).
+    metadata_extra: dict = dataclasses.field(default_factory=dict)
     spec: ReservationSpec = dataclasses.field(default_factory=ReservationSpec)
     status: ReservationStatus = dataclasses.field(default_factory=ReservationStatus)
 
@@ -79,6 +92,7 @@ class ResourceReservation:
             annotations=dict(self.annotations),
             owner_pod_uid=self.owner_pod_uid,
             resource_version=self.resource_version,
+            metadata_extra=dict(self.metadata_extra),
             spec=self.spec.copy(),
             status=self.status.copy(),
         )
@@ -131,23 +145,27 @@ class ResourceReservationV1Beta1:
     labels: dict[str, str] = dataclasses.field(default_factory=dict)
     annotations: dict[str, str] = dataclasses.field(default_factory=dict)
     resource_version: int = 0
+    metadata_extra: dict = dataclasses.field(default_factory=dict)
     reservations: dict[str, ReservationV1Beta1] = dataclasses.field(default_factory=dict)
     pods: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 def convert_to_v1beta1(rr: ResourceReservation) -> ResourceReservationV1Beta1:
-    """Downgrade, stashing the full v1beta2 spec (incl. GPU) in the
-    reservation-spec annotation for lossless round-trip
-    (conversion_resource_reservation.go:29-75)."""
+    """Downgrade, stashing the marshaled v1beta2 spec (incl. GPU) in the
+    reservation-spec annotation for lossless round-trip. The stash is the
+    reference's exact format — the JSON-marshaled v1beta2
+    ResourceReservationSpec with quantity strings — so objects written by
+    this webhook upgrade cleanly through the reference's and vice versa
+    (conversion_resource_reservation.go ConvertFrom: json.Marshal(src.Spec))."""
     spec_json = json.dumps(
         {
-            name: {
-                "node": r.node,
-                "cpu_milli": r.resources.cpu_milli,
-                "mem_kib": r.resources.mem_kib,
-                "gpu_milli": r.resources.gpu_milli,
+            "reservations": {
+                name: {
+                    "node": r.node,
+                    "resources": resources_to_quantity_map(r.resources),
+                }
+                for name, r in rr.spec.reservations.items()
             }
-            for name, r in rr.spec.reservations.items()
         },
         sort_keys=True,
     )
@@ -159,6 +177,7 @@ def convert_to_v1beta1(rr: ResourceReservation) -> ResourceReservationV1Beta1:
         labels=dict(rr.labels),
         annotations=annotations,
         resource_version=rr.resource_version,
+        metadata_extra=dict(rr.metadata_extra),
         reservations={
             name: ReservationV1Beta1(r.node, r.resources.cpu_milli, r.resources.mem_kib)
             for name, r in rr.spec.reservations.items()
@@ -168,34 +187,45 @@ def convert_to_v1beta1(rr: ResourceReservation) -> ResourceReservationV1Beta1:
 
 
 def convert_from_v1beta1(old: ResourceReservationV1Beta1) -> ResourceReservation:
-    """Upgrade: prefer the stashed annotation (lossless), fall back to the
-    flat fields with gpu=0 (conversion_resource_reservation.go:77-121)."""
+    """Upgrade with the reference's merge semantics
+    (conversion_resource_reservation.go ConvertTo): node/cpu/memory come from
+    the v1beta1 struct fields; the stashed annotation only contributes
+    resources the flat shape cannot carry (GPU). The stash annotation is
+    removed from the upgraded object."""
     annotations = dict(old.annotations)
-    stashed: Optional[dict] = None
     raw = annotations.pop(RESERVATION_SPEC_ANNOTATION, None)
+    if raw is None:
+        # Round-1 builds of this codebase stashed under a bare key.
+        raw = annotations.pop("reservation-spec", None)
+    stashed: Optional[dict] = None
     if raw is not None:
         try:
-            stashed = json.loads(raw)
+            parsed = json.loads(raw)
+            if isinstance(parsed, dict):
+                # Reference format: {"reservations": {name: {node, resources}}};
+                # round-1 legacy format was flat {name: {node, cpu_milli, ...}}.
+                stashed = parsed.get("reservations", parsed)
         except json.JSONDecodeError:
             stashed = None
     reservations: dict[str, Reservation] = {}
     for name, r in old.reservations.items():
+        gpu_milli = 0
         if stashed is not None and name in stashed:
-            s = stashed[name]
-            reservations[name] = Reservation(
-                s["node"],
-                Resources(s["cpu_milli"], s["mem_kib"], s["gpu_milli"]),
-            )
-        else:
-            reservations[name] = Reservation(
-                r.node, Resources(r.cpu_milli, r.mem_kib, 0)
-            )
+            entry = stashed[name] or {}
+            if "resources" in entry:
+                gpu_milli = resources_from_quantity_map(entry["resources"]).gpu_milli
+            else:
+                gpu_milli = int(entry.get("gpu_milli", 0))
+        reservations[name] = Reservation(
+            r.node, Resources(r.cpu_milli, r.mem_kib, gpu_milli)
+        )
     return ResourceReservation(
         name=old.name,
         namespace=old.namespace,
         labels=dict(old.labels),
         annotations=annotations,
         resource_version=old.resource_version,
+        metadata_extra=dict(old.metadata_extra),
         spec=ReservationSpec(reservations),
         status=ReservationStatus(dict(old.pods)),
     )

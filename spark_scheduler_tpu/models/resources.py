@@ -192,6 +192,39 @@ class Resources:
         )
 
 
+def format_quantity_milli(milli: int) -> str:
+    """Milli-units -> k8s quantity string ("1500m", or "2" when integral)."""
+    if milli % 1000 == 0:
+        return str(milli // 1000)
+    return f"{milli}m"
+
+
+def format_quantity_kib(kib: int) -> str:
+    return f"{kib}Ki"
+
+
+def resources_to_quantity_map(res: Resources) -> dict:
+    """Wire-shape v1beta2 ResourceList {"cpu","memory","nvidia.com/gpu"}
+    (types_resource_reservation.go:24-34,77-78); GPU omitted when zero,
+    matching how the reference only carries it for GPU apps."""
+    out = {
+        "cpu": format_quantity_milli(res.cpu_milli),
+        "memory": format_quantity_kib(res.mem_kib),
+    }
+    if res.gpu_milli:
+        out["nvidia.com/gpu"] = format_quantity_milli(res.gpu_milli)
+    return out
+
+
+def resources_from_quantity_map(raw: dict | None) -> Resources:
+    raw = raw or {}
+    return Resources.from_quantities(
+        str(raw.get("cpu", "0")),
+        str(raw.get("memory", "0")),
+        str(raw.get("nvidia.com/gpu", "0")),
+    )
+
+
 def stack_resources(items: list[Resources]) -> np.ndarray:
     """[len(items), 3] int32 tensor from a list of Resources."""
     if not items:

@@ -104,7 +104,16 @@ def _load():
     with _lib_lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        try:
+            # Source may be absent in installed artifacts with a cached .so;
+            # only rebuild when the source exists and is newer.
+            stale = not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+        except OSError:
+            stale = not os.path.exists(_SO)
+        if stale:
             if not _build():
                 _load_failed = True
                 return None

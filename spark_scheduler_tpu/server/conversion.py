@@ -42,7 +42,12 @@ from spark_scheduler_tpu.models.reservations import (
     convert_from_v1beta1,
     convert_to_v1beta1,
 )
-from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.models.resources import (
+    format_quantity_kib,
+    format_quantity_milli,
+    resources_from_quantity_map,
+    resources_to_quantity_map,
+)
 
 SPARK_SCHEDULER_GROUP = "sparkscheduler.palantir.com"
 SCALER_GROUP = "scaler.palantir.com"
@@ -53,41 +58,19 @@ DEMAND_V1ALPHA1 = f"{SCALER_GROUP}/v1alpha1"
 DEMAND_V1ALPHA2 = f"{SCALER_GROUP}/v1alpha2"
 
 
-# ---------------------------------------------------------------- quantities
-
-
-def _quantity_milli(milli: int) -> str:
-    """Milli-units -> k8s quantity string ("1500m", or "2" when integral)."""
-    if milli % 1000 == 0:
-        return str(milli // 1000)
-    return f"{milli}m"
-
-
-def _quantity_kib(kib: int) -> str:
-    return f"{kib}Ki"
-
-
-def _resources_to_wire(res: Resources) -> dict:
-    out = {
-        "cpu": _quantity_milli(res.cpu_milli),
-        "memory": _quantity_kib(res.mem_kib),
-    }
-    if res.gpu_milli:
-        out["nvidia.com/gpu"] = _quantity_milli(res.gpu_milli)
-    return out
-
-
-def _resources_from_wire(raw: dict | None) -> Resources:
-    raw = raw or {}
-    return Resources.from_quantities(
-        str(raw.get("cpu", "0")),
-        str(raw.get("memory", "0")),
-        str(raw.get("nvidia.com/gpu", "0")),
-    )
+# metadata keys the models interpret; everything else rides metadata_extra
+_KNOWN_META = ("name", "namespace", "labels", "annotations", "resourceVersion")
 
 
 def _metadata_to_wire(obj) -> dict:
-    meta: dict[str, Any] = {"name": obj.name, "namespace": obj.namespace}
+    """Re-emit metadata losslessly: uninterpreted fields (uid,
+    creationTimestamp, generation, ownerReferences, finalizers, ...) first,
+    overlaid with the model-owned fields. The apiserver rejects conversion
+    responses that mutate immutable metadata, so this must round-trip
+    everything (reference DeepCopies ObjectMeta through conversion)."""
+    meta: dict[str, Any] = dict(getattr(obj, "metadata_extra", None) or {})
+    meta["name"] = obj.name
+    meta["namespace"] = obj.namespace
     if obj.labels:
         meta["labels"] = dict(obj.labels)
     annotations = getattr(obj, "annotations", None)
@@ -106,9 +89,14 @@ def _metadata_fields(raw: dict, *, with_annotations: bool = True) -> dict:
         "namespace": meta.get("namespace", "default"),
         "labels": dict(meta.get("labels") or {}),
         "resource_version": int(rv),
+        "metadata_extra": {k: v for k, v in meta.items() if k not in _KNOWN_META},
     }
-    if with_annotations:  # the Demand models carry no annotations
+    if with_annotations:
         out["annotations"] = dict(meta.get("annotations") or {})
+    elif meta.get("annotations"):
+        # The Demand models carry no annotations field; ride them through
+        # metadata_extra so conversion doesn't erase operator-set annotations.
+        out["metadata_extra"]["annotations"] = dict(meta["annotations"])
     return out
 
 
@@ -123,7 +111,7 @@ def rr_v1beta2_to_wire(rr: ResourceReservation) -> dict:
         "metadata": _metadata_to_wire(rr),
         "spec": {
             "reservations": {
-                name: {"node": r.node, "resources": _resources_to_wire(r.resources)}
+                name: {"node": r.node, "resources": resources_to_quantity_map(r.resources)}
                 for name, r in rr.spec.reservations.items()
             }
         },
@@ -135,7 +123,7 @@ def rr_v1beta2_from_wire(raw: dict) -> ResourceReservation:
     reservations = {
         name: Reservation(
             node=r.get("node", ""),
-            resources=_resources_from_wire(r.get("resources")),
+            resources=resources_from_quantity_map(r.get("resources")),
         )
         for name, r in ((raw.get("spec") or {}).get("reservations") or {}).items()
     }
@@ -157,8 +145,8 @@ def rr_v1beta1_to_wire(rr1: ResourceReservationV1Beta1) -> dict:
             "reservations": {
                 name: {
                     "node": r.node,
-                    "cpu": _quantity_milli(r.cpu_milli),
-                    "memory": _quantity_kib(r.mem_kib),
+                    "cpu": format_quantity_milli(r.cpu_milli),
+                    "memory": format_quantity_kib(r.mem_kib),
                 }
                 for name, r in rr1.reservations.items()
             }
@@ -170,7 +158,7 @@ def rr_v1beta1_to_wire(rr1: ResourceReservationV1Beta1) -> dict:
 def rr_v1beta1_from_wire(raw: dict) -> ResourceReservationV1Beta1:
     reservations = {}
     for name, r in ((raw.get("spec") or {}).get("reservations") or {}).items():
-        res = _resources_from_wire({"cpu": r.get("cpu", "0"), "memory": r.get("memory", "0")})
+        res = resources_from_quantity_map({"cpu": r.get("cpu", "0"), "memory": r.get("memory", "0")})
         reservations[name] = ReservationV1Beta1(
             node=r.get("node", ""), cpu_milli=res.cpu_milli, mem_kib=res.mem_kib
         )
@@ -182,7 +170,7 @@ def rr_v1beta1_from_wire(raw: dict) -> ResourceReservationV1Beta1:
 
 
 def _parse_transition_time(val) -> float:
-    """Accept epoch numbers or RFC3339 strings (k8s metav1.Time)."""
+    """Accept RFC3339 strings (k8s metav1.Time) or epoch numbers."""
     if val is None:
         return 0.0
     if isinstance(val, (int, float)):
@@ -197,37 +185,66 @@ def _parse_transition_time(val) -> float:
         return 0.0
 
 
+def _format_transition_time(epoch: float) -> str:
+    """Epoch seconds -> RFC3339 UTC, the metav1.Time wire encoding
+    ("2006-01-02T15:04:05Z")."""
+    import datetime
+
+    return (
+        datetime.datetime.fromtimestamp(epoch, tz=datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+    )
+
+
 # --------------------------------------------------------------- Demand wire
+#
+# Key names are the reference CRD JSON tags EXACTLY — kebab-case
+# (apis/scaler/v1alpha2/types_demand.go:82-122, v1alpha1/types_demand.go:36-62).
+# Readers also accept this codebase's round-1 camelCase spellings for
+# backward compatibility with already-persisted objects.
+
+
+def _get(raw: dict, kebab: str, camel: str, default=None):
+    if kebab in raw:
+        return raw[kebab]
+    return raw.get(camel, default)
 
 
 def demand_v1alpha2_to_wire(d: Demand) -> dict:
-    """types_demand.go:23-157 (v1alpha2, status subresource)."""
+    """types_demand.go:71-123 (v1alpha2, status subresource). Fields without
+    omitempty (instance-group, is-long-lived, enforce-single-zone-scheduling,
+    phase) are always emitted, matching Go json marshaling."""
     spec: dict[str, Any] = {
         "units": [
             {
-                "resources": _resources_to_wire(u.resources),
+                "resources": resources_to_quantity_map(u.resources),
                 "count": u.count,
-                "podNamesByNamespace": {
-                    ns: list(names) for ns, names in u.pod_names_by_namespace.items()
-                },
+                **(
+                    {
+                        "pod-names-by-namespace": {
+                            ns: list(names)
+                            for ns, names in u.pod_names_by_namespace.items()
+                        }
+                    }
+                    if u.pod_names_by_namespace
+                    else {}
+                ),
             }
             for u in d.spec.units
         ],
-        "instanceGroup": d.spec.instance_group,
+        "instance-group": d.spec.instance_group,
+        "is-long-lived": d.spec.is_long_lived,
+        "enforce-single-zone-scheduling": d.spec.enforce_single_zone_scheduling,
     }
-    if d.spec.is_long_lived:
-        spec["isLongLived"] = True
-    if d.spec.enforce_single_zone_scheduling:
-        spec["enforceSingleZoneScheduling"] = True
     if d.spec.zone:
         spec["zone"] = d.spec.zone
-    status: dict[str, Any] = {}
-    if d.status.phase:
-        status["phase"] = d.status.phase
+    status: dict[str, Any] = {"phase": d.status.phase}
     if d.status.last_transition_time:
-        status["lastTransitionTime"] = d.status.last_transition_time
+        status["last-transition-time"] = _format_transition_time(
+            d.status.last_transition_time
+        )
     if d.status.fulfilled_zone:
-        status["fulfilledZone"] = d.status.fulfilled_zone
+        status["fulfilled-zone"] = d.status.fulfilled_zone
     return {
         "apiVersion": DEMAND_V1ALPHA2,
         "kind": "Demand",
@@ -241,11 +258,13 @@ def demand_v1alpha2_from_wire(raw: dict) -> Demand:
     spec_raw = raw.get("spec") or {}
     units = [
         DemandUnit(
-            resources=_resources_from_wire(u.get("resources")),
+            resources=resources_from_quantity_map(u.get("resources")),
             count=int(u.get("count", 0)),
             pod_names_by_namespace={
                 ns: list(names)
-                for ns, names in (u.get("podNamesByNamespace") or {}).items()
+                for ns, names in (
+                    _get(u, "pod-names-by-namespace", "podNamesByNamespace") or {}
+                ).items()
             },
         )
         for u in spec_raw.get("units") or []
@@ -254,27 +273,37 @@ def demand_v1alpha2_from_wire(raw: dict) -> Demand:
     return Demand(
         spec=DemandSpec(
             units=units,
-            instance_group=spec_raw.get("instanceGroup", ""),
-            is_long_lived=bool(spec_raw.get("isLongLived", False)),
+            instance_group=_get(spec_raw, "instance-group", "instanceGroup", ""),
+            is_long_lived=bool(_get(spec_raw, "is-long-lived", "isLongLived", False)),
             enforce_single_zone_scheduling=bool(
-                spec_raw.get("enforceSingleZoneScheduling", False)
+                _get(
+                    spec_raw,
+                    "enforce-single-zone-scheduling",
+                    "enforceSingleZoneScheduling",
+                    False,
+                )
             ),
             zone=spec_raw.get("zone") or None,
         ),
         status=DemandStatus(
             phase=status_raw.get("phase", ""),
             last_transition_time=_parse_transition_time(
-                status_raw.get("lastTransitionTime")
+                _get(status_raw, "last-transition-time", "lastTransitionTime")
             ),
-            fulfilled_zone=status_raw.get("fulfilledZone") or None,
+            fulfilled_zone=_get(status_raw, "fulfilled-zone", "fulfilledZone") or None,
         ),
         **_metadata_fields(raw, with_annotations=False),
     )
 
 
 def demand_v1alpha1_to_wire(d1: DemandV1Alpha1) -> dict:
-    """v1alpha1 legacy shape (apis/scaler/v1alpha1): units carry a flat
-    cpu/memory pair and no zone semantics."""
+    """v1alpha1 legacy shape (apis/scaler/v1alpha1/types_demand.go:36-62):
+    units carry flat cpu/memory/gpu quantities; no zone semantics."""
+    status: dict[str, Any] = {"phase": d1.phase}
+    if d1.last_transition_time:
+        status["last-transition-time"] = _format_transition_time(
+            d1.last_transition_time
+        )
     return {
         "apiVersion": DEMAND_V1ALPHA1,
         "kind": "Demand",
@@ -282,16 +311,19 @@ def demand_v1alpha1_to_wire(d1: DemandV1Alpha1) -> dict:
         "spec": {
             "units": [
                 {
-                    "cpu": _quantity_milli(u.cpu_milli),
-                    "memory": _quantity_kib(u.mem_kib),
+                    "cpu": format_quantity_milli(u.cpu_milli),
+                    "memory": format_quantity_kib(u.mem_kib),
+                    **(
+                        {"gpu": format_quantity_milli(u.gpu_milli)} if u.gpu_milli else {}
+                    ),
                     "count": u.count,
                 }
                 for u in d1.units
             ],
-            "instanceGroup": d1.instance_group,
-            "isLongLived": d1.is_long_lived,
+            "instance-group": d1.instance_group,
+            "is-long-lived": d1.is_long_lived,
         },
-        "status": {"phase": d1.phase} if d1.phase else {},
+        "status": status,
     }
 
 
@@ -299,17 +331,30 @@ def demand_v1alpha1_from_wire(raw: dict) -> DemandV1Alpha1:
     spec_raw = raw.get("spec") or {}
     units = []
     for u in spec_raw.get("units") or []:
-        res = _resources_from_wire({"cpu": u.get("cpu", "0"), "memory": u.get("memory", "0")})
+        res = resources_from_quantity_map(
+            {
+                "cpu": u.get("cpu", "0"),
+                "memory": u.get("memory", "0"),
+                "nvidia.com/gpu": u.get("gpu", "0"),
+            }
+        )
         units.append(
             DemandUnitV1Alpha1(
-                cpu_milli=res.cpu_milli, mem_kib=res.mem_kib, count=int(u.get("count", 0))
+                cpu_milli=res.cpu_milli,
+                mem_kib=res.mem_kib,
+                count=int(u.get("count", 0)),
+                gpu_milli=res.gpu_milli,
             )
         )
+    status_raw = raw.get("status") or {}
     return DemandV1Alpha1(
         units=units,
-        instance_group=spec_raw.get("instanceGroup", ""),
-        is_long_lived=bool(spec_raw.get("isLongLived", False)),
-        phase=(raw.get("status") or {}).get("phase", ""),
+        instance_group=_get(spec_raw, "instance-group", "instanceGroup", ""),
+        is_long_lived=bool(_get(spec_raw, "is-long-lived", "isLongLived", False)),
+        phase=status_raw.get("phase", ""),
+        last_transition_time=_parse_transition_time(
+            _get(status_raw, "last-transition-time", "lastTransitionTime")
+        ),
         **_metadata_fields(raw, with_annotations=False),
     )
 

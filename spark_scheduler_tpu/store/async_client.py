@@ -90,7 +90,14 @@ class AsyncClient:
             self._threads.append(t)
 
     def stop(self) -> None:
+        """Signal workers and join them. Joining matters for the native
+        queue backend: destroying the C++ queue while a worker is blocked in
+        queue_pop would free the shard mutex under a waiter; the 0.05s pop
+        timeout bounds the join."""
         self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def _run_worker(self, bucket: int) -> None:
         while not self._stop.is_set():

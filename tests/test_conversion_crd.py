@@ -10,6 +10,7 @@ from spark_scheduler_tpu.models.demands import (
     DemandUnit,
 )
 from spark_scheduler_tpu.models.reservations import (
+    RESERVATION_SPEC_ANNOTATION,
     Reservation,
     ReservationSpec,
     ReservationStatus,
@@ -69,7 +70,7 @@ def test_rr_roundtrip_through_webhook_preserves_gpu():
     # v1beta1 is flat {node, cpu, memory}; GPU survives via the annotation.
     slot = old["spec"]["reservations"]["executor-1"]
     assert set(slot) == {"node", "cpu", "memory"}
-    assert "reservation-spec" in old["metadata"]["annotations"]
+    assert RESERVATION_SPEC_ANNOTATION in old["metadata"]["annotations"]
     # ...and back up: lossless round-trip (conversion_resource_reservation.go:29-121).
     back = convert_review(_review([old], RR_V1BETA2))
     (new,) = back["response"]["convertedObjects"]
@@ -78,7 +79,7 @@ def test_rr_roundtrip_through_webhook_preserves_gpu():
     assert rr2.spec.reservations["executor-1"].node == "n1"
     assert rr2.status.pods == {"driver": "drv-pod"}
     # The round-trip carrier annotation is consumed on upgrade.
-    assert "reservation-spec" not in rr2.annotations
+    assert RESERVATION_SPEC_ANNOTATION not in rr2.annotations
 
 
 def test_demand_downgrade_and_upgrade():
@@ -111,6 +112,108 @@ def test_demand_downgrade_and_upgrade():
     # Zone affinity is a v1alpha2-only concept: lost on downgrade, absent
     # after the round trip (v1alpha1 has no carrier annotation).
     assert "zone" not in new["spec"]
+
+
+def test_reference_shaped_v1beta1_upgrades_losslessly():
+    """A v1beta1 object exactly as the reference webhook would write it —
+    fully-qualified reservation-spec annotation holding the marshaled
+    v1beta2 spec (conversion_resource_reservation.go ConvertFrom) plus full
+    ObjectMeta — upgrades with GPU recovered and metadata preserved."""
+    ref_obj = {
+        "apiVersion": RR_V1BETA1,
+        "kind": "ResourceReservation",
+        "metadata": {
+            "name": "app-9",
+            "namespace": "ns",
+            "uid": "3f2c-uid",
+            "creationTimestamp": "2026-01-05T10:00:00Z",
+            "generation": 4,
+            "resourceVersion": "42",
+            "labels": {"spark-app-id": "app-9"},
+            "ownerReferences": [
+                {"apiVersion": "v1", "kind": "Pod", "name": "drv", "uid": "p-uid"}
+            ],
+            "finalizers": ["example.com/protect"],
+            "annotations": {
+                RESERVATION_SPEC_ANNOTATION: (
+                    '{"reservations":{"driver":{"node":"n0","resources":'
+                    '{"cpu":"1","memory":"1Gi","nvidia.com/gpu":"2"}}}}'
+                )
+            },
+        },
+        "spec": {
+            "reservations": {"driver": {"node": "n0", "cpu": "1", "memory": "1Gi"}}
+        },
+        "status": {"pods": {"driver": "drv"}},
+    }
+    out = convert_review(_review([ref_obj], RR_V1BETA2))
+    assert out["response"]["result"]["status"] == "Success"
+    (new,) = out["response"]["convertedObjects"]
+    # GPU recovered from the reference-format stash; cpu/mem from flat fields.
+    res = new["spec"]["reservations"]["driver"]["resources"]
+    assert res["cpu"] == "1" and res["nvidia.com/gpu"] == "2"
+    assert res["memory"] == f"{1024 * 1024}Ki"
+    # Immutable metadata preserved verbatim; stash annotation removed.
+    meta = new["metadata"]
+    assert meta["uid"] == "3f2c-uid"
+    assert meta["creationTimestamp"] == "2026-01-05T10:00:00Z"
+    assert meta["generation"] == 4
+    assert meta["ownerReferences"][0]["name"] == "drv"
+    assert meta["finalizers"] == ["example.com/protect"]
+    assert RESERVATION_SPEC_ANNOTATION not in (meta.get("annotations") or {})
+
+
+def test_reference_shaped_demand_v1alpha2_roundtrip():
+    """A reference-format v1alpha2 Demand (kebab-case tags, RFC3339
+    last-transition-time; types_demand.go:82-122) survives downgrade to
+    v1alpha1 and back with GPU, phase and transition time intact."""
+    ref_demand = {
+        "apiVersion": DEMAND_V1ALPHA2,
+        "kind": "Demand",
+        "metadata": {
+            "name": "demand-pod-7",
+            "namespace": "ns",
+            "uid": "d-uid",
+            "creationTimestamp": "2026-02-01T00:00:00Z",
+        },
+        "spec": {
+            "units": [
+                {
+                    "resources": {
+                        "cpu": "2",
+                        "memory": "4Gi",
+                        "nvidia.com/gpu": "1",
+                    },
+                    "count": 5,
+                    "pod-names-by-namespace": {"ns": ["pod-7"]},
+                }
+            ],
+            "instance-group": "ig-a",
+            "is-long-lived": True,
+            "enforce-single-zone-scheduling": False,
+        },
+        "status": {
+            "phase": "pending",
+            "last-transition-time": "2026-02-01T12:30:45Z",
+        },
+    }
+    out = convert_review(_review([ref_demand], DEMAND_V1ALPHA1))
+    assert out["response"]["result"]["status"] == "Success"
+    (old,) = out["response"]["convertedObjects"]
+    # v1alpha1 units are flat cpu/memory/gpu (v1alpha1/types_demand.go:57-62).
+    assert old["spec"]["units"][0]["gpu"] == "1"
+    assert old["spec"]["instance-group"] == "ig-a"
+    assert old["spec"]["is-long-lived"] is True
+    assert old["status"]["last-transition-time"] == "2026-02-01T12:30:45Z"
+    assert old["metadata"]["uid"] == "d-uid"
+    # Back up to storage version: everything v1alpha1 can carry survives.
+    back = convert_review(_review([old], DEMAND_V1ALPHA2))
+    (new,) = back["response"]["convertedObjects"]
+    assert new["spec"]["units"][0]["resources"]["nvidia.com/gpu"] == "1"
+    assert new["spec"]["instance-group"] == "ig-a"
+    assert new["status"]["phase"] == "pending"
+    assert new["status"]["last-transition-time"] == "2026-02-01T12:30:45Z"
+    assert new["metadata"]["creationTimestamp"] == "2026-02-01T00:00:00Z"
 
 
 def test_same_version_passthrough_and_unknown_version_fails():
