@@ -96,6 +96,11 @@ class ExtenderConfig:
     fifo_config: FifoConfig = dataclasses.field(default_factory=FifoConfig)
     instance_group_label: str = "instance-group"
     schedule_dynamically_allocated_executors_in_same_az: bool = False
+    # One batched device solve per driver request (FIFO prefix + current app)
+    # instead of a pack per earlier driver. Decisions are identical either
+    # way (solver.pack_queue docstring); False forces the sequential loop.
+    # Single-AZ binpack strategies always use the sequential path.
+    batched_admission: bool = True
 
 
 class SparkSchedulerExtender:
@@ -211,24 +216,43 @@ class SparkSchedulerExtender:
         except SparkPodError as exc:
             return None, FAILURE_INTERNAL, f"failed to get spark resources: {exc}"
 
+        earlier: Sequence[Pod] = ()
         if self._config.fifo:
             earlier = self._pod_lister.list_earlier_drivers(driver)
-            tensors, ok = self._fit_earlier_drivers(earlier, tensors, node_names)
-            if not ok:
-                self._demands.create_demand_for_application(driver, app_resources)
-                return None, FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
 
-        packing = self._solver.pack(
-            self.binpacker.name,
-            tensors,
-            app_resources.driver_resources,
-            app_resources.executor_resources,
-            app_resources.min_executor_count,
-            node_names,
-        )
-        if not packing.has_capacity:
-            self._demands.create_demand_for_application(driver, app_resources)
-            return None, FAILURE_FIT, "application does not fit to the cluster"
+        if self._config.batched_admission and self._solver.can_batch(
+            self.binpacker.name
+        ):
+            # ONE device program admits the whole FIFO prefix + this driver
+            # (SURVEY.md §2d row 1) — replaces fitEarlierDrivers' per-driver
+            # re-pack loop (resource.go:221-258) AND the final pack with a
+            # single batched solve. Decisions are identical to the sequential
+            # path (pack_queue docstring).
+            packing, outcome, message = self._admit_driver_batched(
+                driver, app_resources, earlier, tensors, node_names
+            )
+            if packing is None:
+                self._demands.create_demand_for_application(driver, app_resources)
+                return None, outcome, message
+        else:
+            # Sequential fallback: single-AZ strategies, or batching disabled.
+            if earlier:
+                tensors, ok = self._fit_earlier_drivers(earlier, tensors, node_names)
+                if not ok:
+                    self._demands.create_demand_for_application(driver, app_resources)
+                    return None, FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
+
+            packing = self._solver.pack(
+                self.binpacker.name,
+                tensors,
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+                node_names,
+            )
+            if not packing.has_capacity:
+                self._demands.create_demand_for_application(driver, app_resources)
+                return None, FAILURE_FIT, "application does not fit to the cluster"
 
         if self._metrics is not None:
             self._metrics.report_packing_efficiency(self.binpacker.name, packing)
@@ -250,6 +274,50 @@ class SparkSchedulerExtender:
             # must not double-emit application_scheduled (events.go:27-50).
             self._events.emit_application_scheduled(driver, app_resources)
         return packing.driver_node, SUCCESS, ""
+
+    def _admit_driver_batched(
+        self,
+        driver: Pod,
+        app_resources,
+        earlier: Sequence[Pod],
+        tensors,
+        node_names: list[str],
+    ):
+        """Batched FIFO admission: earlier drivers + the current driver as
+        rows of one `pack_queue` solve. Returns (packing|None, outcome,
+        message); None packing means the caller creates a demand and fails
+        the request (resource.go:241-249 / :342-345 outcome split)."""
+        rows = []
+        for ed in earlier:
+            try:
+                res = spark_resources(ed)
+            except SparkPodError:
+                continue  # unparseable driver is skipped (resource.go:228-233)
+            rows.append(
+                (
+                    res.driver_resources,
+                    res.executor_resources,
+                    res.min_executor_count,
+                    self._should_skip_driver_fifo(ed),
+                )
+            )
+        rows.append(
+            (
+                app_resources.driver_resources,
+                app_resources.executor_resources,
+                app_resources.min_executor_count,
+                False,
+            )
+        )
+        decisions = self._solver.pack_queue(
+            self.binpacker.name, tensors, rows, node_names
+        )
+        final = decisions[-1]
+        if final.admitted:
+            return final.packing, SUCCESS, ""
+        if any(not d.packed and not row[3] for d, row in zip(decisions[:-1], rows)):
+            return None, FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
+        return None, FAILURE_FIT, "application does not fit to the cluster"
 
     def _fit_earlier_drivers(
         self, drivers: Sequence[Pod], tensors, node_names: list[str]

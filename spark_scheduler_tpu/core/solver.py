@@ -29,7 +29,15 @@ from spark_scheduler_tpu.models.cluster import (
 from spark_scheduler_tpu.models.kube import Node
 from spark_scheduler_tpu.models.resources import INT32_INF, NUM_DIMS, Resources
 from spark_scheduler_tpu.ops import BINPACK_FUNCTIONS
+from spark_scheduler_tpu.ops.batched import batched_fifo_pack, make_app_batch
 from spark_scheduler_tpu.ops.efficiency import avg_packing_efficiency
+
+# Strategies expressible as the batched kernel's executor fill. The single-AZ
+# wrappers pack per zone with efficiency-scored zone selection, which the
+# batched scan does not reproduce — those run the sequential path.
+BATCHABLE_STRATEGIES = frozenset(
+    {"tightly-pack", "distribute-evenly", "minimal-fragmentation"}
+)
 
 
 def _bucket(n: int, minimum: int) -> int:
@@ -47,6 +55,14 @@ class HostPacking(NamedTuple):
     efficiency_cpu: float
     efficiency_memory: float
     efficiency_gpu: float
+
+
+class QueueDecision(NamedTuple):
+    """One row of a batched FIFO solve (see PlacementSolver.pack_queue)."""
+
+    packing: HostPacking
+    packed: bool  # would fit, ignoring FIFO blocking
+    admitted: bool  # packed AND not blocked by an earlier non-skippable failure
 
 
 class PlacementSolver:
@@ -216,6 +232,113 @@ class PlacementSolver:
             efficiency_memory=float(eff.memory),
             efficiency_gpu=float(eff.gpu),
         )
+
+    def can_batch(self, strategy: str) -> bool:
+        return strategy in BATCHABLE_STRATEGIES
+
+    def pack_queue(
+        self,
+        strategy: str,
+        tensors,
+        rows: Sequence[tuple[Resources, Resources, int, bool]],
+        driver_candidate_names: Sequence[str],
+        domain_mask: np.ndarray | None = None,
+    ) -> list["QueueDecision"]:
+        """Admit a FIFO queue of gang requests in ONE device program.
+
+        `rows` is [(driver_resources, executor_resources, executor_count,
+        skippable)] in FIFO order; the LAST row is the app being served.
+        Decisions are bit-identical to calling `pack` per row against the
+        post-admission availability (the masked-batch parity property,
+        tests/test_batched.py::test_masked_batch_matches_sequential_spark_bin_pack),
+        replacing the reference's per-earlier-driver greedy re-pack loop
+        (fitEarlierDrivers, resource.go:221-258) with one `lax.scan`.
+
+        Packing efficiencies are computed for the final row only (the one
+        the serving path reports, resource.go:347-350); earlier rows carry
+        zeros.
+        """
+        if strategy not in BATCHABLE_STRATEGIES:
+            raise ValueError(f"strategy {strategy!r} is not batchable")
+        if not rows:
+            return []
+        n = tensors.available.shape[0]
+        driver_mask = self.candidate_mask(tensors, driver_candidate_names)
+        domain = (
+            np.asarray(tensors.valid) if domain_mask is None else np.asarray(domain_mask)
+        )
+        b = len(rows)
+        counts = [int(r[2]) for r in rows]
+        emax = _bucket(max(max(counts), 1), 8)
+        apps = make_app_batch(
+            np.stack([r[0].as_array() for r in rows]),
+            np.stack([r[1].as_array() for r in rows]),
+            np.asarray(counts, np.int32),
+            skippable=[bool(r[3]) for r in rows],
+            pad_to=_bucket(b, 4),
+            driver_cand=np.broadcast_to(driver_mask, (b, n)),
+            domain=np.broadcast_to(domain, (b, n)),
+        )
+        out = batched_fifo_pack(
+            tensors, apps, fill=strategy, emax=emax,
+            num_zones=self._num_zones_bucket(),
+        )
+
+        drivers = np.asarray(out.driver_node)
+        execs = np.asarray(out.executor_nodes)
+        admitted = np.asarray(out.admitted)
+        packed = np.asarray(out.packed)
+
+        # Efficiency of the final row against the availability it packed
+        # into: reconstruct by adding the row's own usage back. Only computed
+        # on admission — the serving path reports efficiency solely for
+        # successful packs (resource.go:347-350), so rejections skip the
+        # device launch.
+        last = b - 1
+        eff = None
+        if admitted[last]:
+            avail_before = np.array(out.available_after)
+            dreq = rows[last][0].as_array()
+            ereq = rows[last][1].as_array()
+            if drivers[last] >= 0:
+                avail_before[drivers[last]] += dreq
+            for e in execs[last]:
+                if e >= 0:
+                    avail_before[e] += ereq
+            import dataclasses as _dc
+
+            eff = avg_packing_efficiency(
+                _dc.replace(tensors, available=jnp.asarray(avail_before)),
+                jnp.int32(int(drivers[last])),
+                jnp.asarray(execs[last]),
+                jnp.asarray(dreq),
+                jnp.asarray(ereq),
+            )
+
+        decisions = []
+        for i in range(b):
+            exec_idx = [int(x) for x in execs[i] if int(x) >= 0]
+            with_eff = eff is not None and i == last
+            decisions.append(
+                QueueDecision(
+                    packing=HostPacking(
+                        driver_node=(
+                            self.registry.name_of(int(drivers[i]))
+                            if drivers[i] >= 0
+                            else None
+                        ),
+                        executor_nodes=[self.registry.name_of(x) for x in exec_idx],
+                        has_capacity=bool(packed[i]),
+                        efficiency_max=float(eff.max) if with_eff else 0.0,
+                        efficiency_cpu=float(eff.cpu) if with_eff else 0.0,
+                        efficiency_memory=float(eff.memory) if with_eff else 0.0,
+                        efficiency_gpu=float(eff.gpu) if with_eff else 0.0,
+                    ),
+                    packed=bool(packed[i]),
+                    admitted=bool(admitted[i]),
+                )
+            )
+        return decisions
 
     def subtract_usage(self, tensors, usage: dict[str, Resources]):
         """Subtract per-node usage from availability in-place-equivalent

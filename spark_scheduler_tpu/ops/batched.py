@@ -54,6 +54,14 @@ class AppBatch(NamedTuple):
     already sorted by creation time host-side (`filterToEarliestAndSort`,
     sparkpods.go:60-77). Rows past the real queue length are padding with
     `app_valid=False`.
+
+    `driver_cand` / `domain` are OPTIONAL per-app node masks. When both are
+    None the kernel runs in queue mode: every app sees the same eligibility
+    and the node priority orders are computed once from the starting
+    availability (fitEarlierDrivers semantics, resource.go:221-258). When
+    set, each app packs exactly as a standalone `spark_bin_pack` call with
+    those masks against the then-current availability — the serving path's
+    per-request decisions, batched (SURVEY.md §2d row 1).
     """
 
     driver_req: jnp.ndarray  # [B, 3] i32
@@ -61,6 +69,8 @@ class AppBatch(NamedTuple):
     exec_count: jnp.ndarray  # [B] i32 — gang size (min executors)
     app_valid: jnp.ndarray  # [B] bool — padding mask
     skippable: jnp.ndarray  # [B] bool — FIFO age-based skip (resource.go:260-270)
+    driver_cand: jnp.ndarray | None = None  # [B, N] bool — kube candidate list
+    domain: jnp.ndarray | None = None  # [B, N] bool — node-affinity domain
 
 
 class BatchedPacking(NamedTuple):
@@ -94,23 +104,64 @@ def batched_fifo_pack(
     n = cluster.available.shape[0]
     _check_cumsum_bound(n, emax)
 
-    domain = cluster.valid
-    exec_elig = domain & ~cluster.unschedulable & cluster.ready
-    driver_elig = exec_elig  # queue-mode drivers have no kube candidate filter
+    masked = apps.driver_cand is not None or apps.domain is not None
+    if not masked:
+        # Queue mode: shared eligibility, orders fixed from the starting
+        # availability (fitEarlierDrivers reuses the orders computed at
+        # resource.go:299 while only availability mutates).
+        domain0 = cluster.valid
+        exec_elig0 = domain0 & ~cluster.unschedulable & cluster.ready
+        driver_elig0 = exec_elig0  # no kube candidate filter in queue mode
+        zrank0 = zone_ranks(cluster, domain0, num_zones)
+        d_order0, _ = priority_order(
+            cluster, driver_elig0, zrank0, cluster.label_rank_driver
+        )
+        e_order0, _ = priority_order(
+            cluster, exec_elig0, zrank0, cluster.label_rank_executor
+        )
+        d_rank0 = _rank_of_position(d_order0)
 
-    zrank = zone_ranks(cluster, domain, num_zones)
-    d_order, _ = priority_order(cluster, driver_elig, zrank, cluster.label_rank_driver)
-    e_order, _ = priority_order(cluster, exec_elig, zrank, cluster.label_rank_executor)
-    d_rank = _rank_of_position(d_order)
+    if masked:
+        b = apps.driver_req.shape[0]
+        ones = jnp.ones((b, n), jnp.bool_)
+        extra = (
+            apps.driver_cand if apps.driver_cand is not None else ones,
+            apps.domain if apps.domain is not None else ones,
+        )
+    else:
+        extra = ()
 
     def step(carry, app):
         avail, blocked = carry
-        driver_req, exec_req, count, valid, skippable = app
+        driver_req, exec_req, count, valid, skippable, *masks = app
+        cand_i, dom_i = masks if masked else (None, None)
         # A gang larger than the static slot padding cannot be represented —
         # reject it outright rather than silently truncating it. Callers
         # size emax to the queue's max gang (make_app_batch knows it).
         too_big = count > emax
         count = jnp.minimum(count, emax)
+
+        if masked:
+            # Per-app masks: reproduce a standalone spark_bin_pack call with
+            # these masks against the CURRENT availability — ordering and
+            # zone ranks recomputed per step exactly as each serving request
+            # recomputes them from post-admission usage.
+            domain = dom_i & cluster.valid
+            driver_elig = domain & cand_i
+            exec_elig = domain & ~cluster.unschedulable & cluster.ready
+            zrank = zone_ranks(cluster, domain, num_zones, available=avail)
+            d_order, _ = priority_order(
+                cluster, driver_elig, zrank, cluster.label_rank_driver,
+                available=avail,
+            )
+            e_order, _ = priority_order(
+                cluster, exec_elig, zrank, cluster.label_rank_executor,
+                available=avail,
+            )
+            d_rank = _rank_of_position(d_order)
+        else:
+            driver_elig, exec_elig = driver_elig0, exec_elig0
+            d_order, d_rank, e_order = d_order0, d_rank0, e_order0
 
         driver_node, one_hot, exec_nodes, ok = pack_one_app(
             avail, exec_elig, driver_elig, d_order, d_rank, e_order,
@@ -142,7 +193,14 @@ def batched_fifo_pack(
     (avail_after, _), (drivers, execs, admitted, packed) = jax.lax.scan(
         step,
         (cluster.available, jnp.bool_(False)),
-        (apps.driver_req, apps.exec_req, apps.exec_count, apps.app_valid, apps.skippable),
+        (
+            apps.driver_req,
+            apps.exec_req,
+            apps.exec_count,
+            apps.app_valid,
+            apps.skippable,
+        )
+        + extra,
     )
     return BatchedPacking(
         driver_node=drivers,
@@ -160,8 +218,11 @@ def make_app_batch(
     *,
     pad_to: int | None = None,
     skippable=None,
+    driver_cand=None,  # [B,N] bool — per-app kube candidate masks
+    domain=None,  # [B,N] bool — per-app node-affinity domains
 ) -> AppBatch:
-    """Host helper: pad a queue to a bucketed batch size."""
+    """Host helper: pad a queue to a bucketed batch size. Padding rows get
+    all-False masks (they are already app_valid=False)."""
     import numpy as np
 
     driver_reqs = np.asarray(driver_reqs, np.int32)
@@ -175,10 +236,19 @@ def make_app_batch(
     pad = max(pad_to or b, b)
     valid = np.zeros(pad, bool)
     valid[:b] = True
+
+    def _pad_mask(m):
+        if m is None:
+            return None
+        m = np.asarray(m, bool)
+        return np.pad(m, ((0, pad - b), (0, 0)))
+
     return AppBatch(
         driver_req=np.pad(driver_reqs, ((0, pad - b), (0, 0))),
         exec_req=np.pad(exec_reqs, ((0, pad - b), (0, 0))),
         exec_count=np.pad(exec_counts, (0, pad - b)),
         app_valid=valid,
         skippable=np.pad(skippable, (0, pad - b)),
+        driver_cand=_pad_mask(driver_cand),
+        domain=_pad_mask(domain),
     )

@@ -28,9 +28,16 @@ def zone_ranks(
     cluster: ClusterTensors,
     domain_mask: jnp.ndarray,  # [N] bool — nodes in the metadata domain
     num_zones: int,  # static upper bound on zone-id space
+    available: jnp.ndarray | None = None,  # [N,3] override (defaults to cluster's)
 ) -> jnp.ndarray:  # [num_zones] i32: rank of each zone (0 = highest priority)
     """Zones ordered ascending by (total available memory, total CPU)
-    (nodesorting.go:101-104, 124-134). Zones with no domain nodes rank last."""
+    (nodesorting.go:101-104, 124-134). Zones with no domain nodes rank last.
+
+    `available` lets callers rank against a mutated availability (the batched
+    FIFO scan threads availability through admissions) without rebuilding the
+    whole ClusterTensors."""
+    if available is None:
+        available = cluster.available
     mask = domain_mask & cluster.valid
 
     def _zone_sum_chunks(vals: jnp.ndarray) -> list[jnp.ndarray]:
@@ -57,8 +64,8 @@ def zone_ranks(
         s2 = s2 & 0xFF
         return [s3, s2, s1, s0]
 
-    mem_k = _zone_sum_chunks(cluster.available[:, MEM_DIM])
-    cpu_k = _zone_sum_chunks(cluster.available[:, CPU_DIM])
+    mem_k = _zone_sum_chunks(available[:, MEM_DIM])
+    cpu_k = _zone_sum_chunks(available[:, CPU_DIM])
     present = jnp.zeros(num_zones, jnp.bool_).at[cluster.zone_id].max(mask)
     # Absent zones last; ties between zones are unordered in the reference
     # (map iteration); pin with zone id. lexsort: last key is primary.
@@ -80,13 +87,16 @@ def priority_order(
     eligible: jnp.ndarray,  # [N] bool
     zrank: jnp.ndarray,  # [num_zones] i32 from zone_ranks
     label_rank: jnp.ndarray,  # [N] i32 (INT32_INF = unranked)
+    available: jnp.ndarray | None = None,  # [N,3] override (defaults to cluster's)
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """(order[N] node indices, count) — eligible nodes in priority order,
     ineligible pushed to the end."""
+    if available is None:
+        available = cluster.available
     elig = eligible & cluster.valid
     az = zrank[cluster.zone_id]
-    mem = cluster.available[:, MEM_DIM]
-    cpu = cluster.available[:, CPU_DIM]
+    mem = available[:, MEM_DIM]
+    cpu = available[:, CPU_DIM]
     # lexsort: last key is primary.
     order = jnp.lexsort(
         (cluster.name_rank, cpu, mem, az, label_rank, jnp.where(elig, 0, 1))

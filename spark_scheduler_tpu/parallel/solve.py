@@ -42,14 +42,29 @@ def _shard_cluster(cluster: ClusterTensors, mesh: Mesh, leading=()) -> ClusterTe
 
 def _shard_apps(apps: AppBatch, mesh: Mesh, leading=()) -> AppBatch:
     """App batch: replicated across "nodes" (the scan walks it sequentially),
-    optionally sharded on a leading "groups" axis."""
+    optionally sharded on a leading "groups" axis. The optional per-app
+    [B, N] masks carry a node axis, which shards over "nodes" like the
+    cluster tensors."""
 
-    def put(x):
+    def put(x, node_axis=False):
+        if x is None:
+            return None
         x = jnp.asarray(x)
-        spec = P(*leading, *([None] * (x.ndim - len(leading))))
+        if node_axis:
+            spec = P(*leading, None, "nodes")
+        else:
+            spec = P(*leading, *([None] * (x.ndim - len(leading))))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
-    return AppBatch(*[put(x) for x in apps])
+    return AppBatch(
+        driver_req=put(apps.driver_req),
+        exec_req=put(apps.exec_req),
+        exec_count=put(apps.exec_count),
+        app_valid=put(apps.app_valid),
+        skippable=put(apps.skippable),
+        driver_cand=put(apps.driver_cand, node_axis=True),
+        domain=put(apps.domain, node_axis=True),
+    )
 
 
 def sharded_fifo_pack(
@@ -87,10 +102,19 @@ def stack_groups(
     cluster = jax.tree_util.tree_map(
         lambda *xs: np.stack([np.asarray(x) for x in xs]), *clusters
     )
-    apps = AppBatch(
-        *[np.stack([np.asarray(x) for x in cols]) for cols in zip(*app_batches)]
-    )
-    return cluster, apps
+    stacked_cols = []
+    for field, cols in zip(AppBatch._fields, zip(*app_batches)):
+        present = [x is not None for x in cols]
+        if not any(present):
+            stacked_cols.append(None)
+            continue
+        if not all(present):
+            raise ValueError(
+                f"AppBatch field {field!r} set for some groups but not others; "
+                "masks must be provided for every group or none"
+            )
+        stacked_cols.append(np.stack([np.asarray(x) for x in cols]))
+    return cluster, AppBatch(*stacked_cols)
 
 
 def grouped_fifo_pack(

@@ -176,6 +176,7 @@ def build_scheduler_app(
             schedule_dynamically_allocated_executors_in_same_az=(
                 config.should_schedule_dynamically_allocated_executors_in_same_az
             ),
+            batched_admission=config.batched_admission,
         ),
         reconciler=reconciler,
         metrics=metrics,

@@ -39,6 +39,10 @@ class InstallConfig:
     executor_prioritized_node_label: Optional[LabelPriorityOrder] = None
     port: int = 8484
     sync_writes: bool = False  # drain write-back inline (tests/single-thread)
+    # One batched device solve per driver request (FIFO prefix + current
+    # app); False forces the per-earlier-driver sequential loop. Decisions
+    # are identical either way (core/solver.py pack_queue).
+    batched_admission: bool = True
     # Append a JSON line per metric series on every reporter tick (the
     # reference's 30s metric flush, metrics/metrics.go:79). None = off;
     # metrics remain pollable at GET /metrics either way.
@@ -87,6 +91,7 @@ class InstallConfig:
             driver_prioritized_node_label=label_prio("driver-prioritized-node-label"),
             executor_prioritized_node_label=label_prio("executor-prioritized-node-label"),
             port=int(raw.get("port", 8484)),
+            batched_admission=bool(raw.get("batched-admission", True)),
             metrics_log=raw.get("metrics-log"),
         )
 

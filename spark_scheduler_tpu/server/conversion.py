@@ -58,8 +58,11 @@ DEMAND_V1ALPHA1 = f"{SCALER_GROUP}/v1alpha1"
 DEMAND_V1ALPHA2 = f"{SCALER_GROUP}/v1alpha2"
 
 
-# metadata keys the models interpret; everything else rides metadata_extra
-_KNOWN_META = ("name", "namespace", "labels", "annotations", "resourceVersion")
+# metadata keys the models interpret; everything else rides metadata_extra.
+# resourceVersion is deliberately NOT here: it is an opaque string per the
+# k8s API contract, so it rides metadata_extra verbatim (the model's int
+# resource_version is a best-effort parse for internal versioning only).
+_KNOWN_META = ("name", "namespace", "labels", "annotations")
 
 
 def _metadata_to_wire(obj) -> dict:
@@ -76,19 +79,24 @@ def _metadata_to_wire(obj) -> dict:
     annotations = getattr(obj, "annotations", None)
     if annotations:
         meta["annotations"] = dict(annotations)
-    if obj.resource_version:
+    # metadata_extra carries the wire resourceVersion verbatim; only objects
+    # built internally (no extra) emit the parsed int form.
+    if obj.resource_version and "resourceVersion" not in meta:
         meta["resourceVersion"] = str(obj.resource_version)
     return meta
 
 
 def _metadata_fields(raw: dict, *, with_annotations: bool = True) -> dict:
     meta = raw.get("metadata") or {}
-    rv = meta.get("resourceVersion") or 0
+    rv = str(meta.get("resourceVersion") or "0")
     out = {
         "name": meta.get("name", ""),
         "namespace": meta.get("namespace", "default"),
         "labels": dict(meta.get("labels") or {}),
-        "resource_version": int(rv),
+        # Opaque string per API contract; parse best-effort for the models'
+        # internal optimistic-concurrency checks, never re-emitted when the
+        # original is carried in metadata_extra.
+        "resource_version": int(rv) if rv.isdigit() else 0,
         "metadata_extra": {k: v for k, v in meta.items() if k not in _KNOWN_META},
     }
     if with_annotations:

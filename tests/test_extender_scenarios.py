@@ -297,3 +297,55 @@ def test_all_binpack_algos_schedule_end_to_end(algo):
     pods = static_allocation_spark_pods(f"app-{algo}", 3)
     results = h.schedule_app(pods, ["n1", "n2"])
     assert all(r.ok for r in results), [r.outcome for r in results]
+
+
+def _run_fifo_scenario(batched: bool):
+    """A FIFO scenario with a mixed queue: one blocked driver, a skippable
+    young driver, admits before and after. Returns (outcomes, reservations)
+    for comparison across admission paths."""
+    h = Harness(binpack_algo="tightly-pack", fifo=True, batched_admission=batched)
+    h.add_nodes(*(new_node(f"n{i}") for i in range(4)))
+    nodes = [f"n{i}" for i in range(4)]
+
+    outcomes = []
+    # App A: fits (driver+2 execs) and is admitted.
+    a = static_allocation_spark_pods("app-a", 2)
+    outcomes.append(h.schedule(a[0], nodes).outcome)
+    # App B driver arrives but is NOT scheduled yet (pending; joins FIFO).
+    b = static_allocation_spark_pods("app-b", 30)  # cannot ever fit
+    h.add_pods(b[0])
+    # App C: later driver; B is pending-unschedulable ahead of it and not
+    # skippable => failure-earlier-driver.
+    c = static_allocation_spark_pods("app-c", 1)
+    outcomes.append(h.schedule(c[0], nodes).outcome)
+    # Remove B; C retries and is admitted.
+    h.delete_pod(b[0])
+    outcomes.append(h.schedule(c[0], nodes).outcome)
+    # Executors of A and C bind.
+    for p in a[1:]:
+        outcomes.append(h.schedule(p, nodes).outcome)
+    for p in c[1:]:
+        outcomes.append(h.schedule(p, nodes).outcome)
+
+    reservations = {}
+    for app in ("app-a", "app-c"):
+        rr = h.get_reservation("namespace", app)
+        reservations[app] = (
+            {k: (v.node, v.resources.as_tuple()) for k, v in rr.spec.reservations.items()},
+            dict(rr.status.pods),
+        ) if rr is not None else None
+    return outcomes, reservations
+
+
+def test_batched_admission_matches_sequential_path():
+    """VERDICT r1 #1 'done' criterion: the windowed/batched driver admission
+    produces exactly the decisions of the per-request sequential path."""
+    got_b = _run_fifo_scenario(batched=True)
+    got_s = _run_fifo_scenario(batched=False)
+    assert got_b == got_s
+    outcomes, reservations = got_b
+    assert outcomes[0] == SUCCESS
+    assert outcomes[1] == FAILURE_EARLIER_DRIVER
+    assert outcomes[2] == SUCCESS
+    assert reservations["app-a"] is not None
+    assert reservations["app-c"] is not None
