@@ -207,7 +207,7 @@ class SparkSchedulerExtender:
         available_nodes = [
             n for n in self._backend.list_nodes() if pod_matches_node(driver, n)
         ]
-        usage = self._rrm.get_reserved_resources()
+        usage = self._rrm.reserved_usage()
         overhead = self._overhead.get_overhead(available_nodes)
         tensors = self._solver.build_tensors(available_nodes, usage, overhead)
 
@@ -450,7 +450,7 @@ class SparkSchedulerExtender:
                 nodes = [n for n in nodes if n.zone == zone]
                 single_az_zone = zone
 
-        usage = self._rrm.get_reserved_resources()
+        usage = self._rrm.reserved_usage()
         overhead = self._overhead.get_overhead(nodes)
         tensors = self._solver.build_tensors(nodes, usage, overhead)
         # A 1-executor gang with no driver = "first sorted node with room".

@@ -49,7 +49,13 @@ class ResourceReservationManager:
         self._mutex = threading.RLock()
         self._compaction_lock = threading.Lock()
         self._compaction_apps: dict[str, str] = {}  # appID -> namespace
+        # Optional delta-maintained usage aggregate (core/usage_tracker.py);
+        # attached by the DI wiring once the solver's NodeRegistry exists.
+        self.usage_tracker = None
         backend.subscribe("pods", on_delete=self._on_executor_pod_deletion)
+
+    def attach_usage_tracker(self, tracker) -> None:
+        self.usage_tracker = tracker
 
     # -- queries ------------------------------------------------------------
 
@@ -72,7 +78,11 @@ class ResourceReservationManager:
         ) and self.soft_store.executor_has_soft_reservation(pod)
 
     def get_reserved_resources(self) -> dict[str, Resources]:
-        """Per-node hard+soft reservation usage (resourcereservations.go:228-233)."""
+        """Per-node hard+soft reservation usage (resourcereservations.go:228-233).
+        With a tracker attached this is the O(nonzero) incremental view;
+        otherwise the reference's full walk."""
+        if self.usage_tracker is not None:
+            return self.usage_tracker.as_map()
         usage: dict[str, Resources] = {}
         for rr in self.rr_cache.list():
             for res in rr.spec.reservations.values():
@@ -80,6 +90,14 @@ class ResourceReservationManager:
         for node, res in self.soft_store.used_soft_reservation_resources().items():
             usage.setdefault(node, Resources.zero()).add(res)
         return usage
+
+    def reserved_usage(self):
+        """Hot-path usage view: the tracker's dense int64 array when attached
+        (O(1) per request), else the map (O(apps x slots) fallback). Both
+        shapes are accepted by PlacementSolver.build_tensors."""
+        if self.usage_tracker is not None:
+            return self.usage_tracker.array()
+        return self.get_reserved_resources()
 
     # -- gang admission -----------------------------------------------------
 

@@ -39,8 +39,35 @@ class SoftReservationStore:
     def __init__(self, backend=None):
         self._store: dict[str, SoftReservation] = {}
         self._lock = threading.RLock()
+        # Both listener families fire AFTER the store lock is released, so a
+        # listener may re-enter store queries without lock-order inversion
+        # (listeners take their own locks, then call back into this store).
+        # Consequence: deltas can be observed reordered relative to store
+        # state; consumers must treat them as commutative increments.
+        # Delta listeners: fn(node, resources, sign) on every soft-usage
+        # change (+1 reservation added, -1 removed) — the incremental feed
+        # for ReservedUsageTracker.
+        self._delta_listeners: list = []
+        # Membership listeners: fn(app_id, pod_name) fired when an executor
+        # gains/loses a soft reservation — the overhead computer's signal
+        # that the pod flipped between overhead and reserved.
+        self._membership_listeners: list = []
         if backend is not None:
             backend.subscribe("pods", on_delete=self._on_pod_deletion)
+
+    def add_delta_listener(self, fn) -> None:
+        self._delta_listeners.append(fn)
+
+    def add_membership_listener(self, fn) -> None:
+        self._membership_listeners.append(fn)
+
+    def _notify_delta(self, node: str, resources: Resources, sign: int) -> None:
+        for fn in self._delta_listeners:
+            fn(node, resources, sign)
+
+    def _notify_membership(self, app_id: str, pod_name: str) -> None:
+        for fn in self._membership_listeners:
+            fn(app_id, pod_name)
 
     # -- queries ------------------------------------------------------------
 
@@ -99,20 +126,29 @@ class SoftReservationStore:
                 return
             sr.reservations[pod_name] = reservation
             sr.status[pod_name] = True
+        self._notify_delta(reservation.node, reservation.resources, +1)
+        self._notify_membership(app_id, pod_name)
 
     def remove_executor_reservation(self, app_id: str, executor_name: str) -> None:
         with self._lock:
             sr = self._store.get(app_id)
             if sr is None:
                 return
-            sr.reservations.pop(executor_name, None)
+            removed = sr.reservations.pop(executor_name, None)
             # Always tombstone: remember the death to beat the
             # death-event/schedule-request race (softreservations.go:197-210).
             sr.status[executor_name] = False
+        if removed is not None:
+            self._notify_delta(removed.node, removed.resources, -1)
+            self._notify_membership(app_id, executor_name)
 
     def remove_driver_reservation(self, app_id: str) -> None:
         with self._lock:
-            self._store.pop(app_id, None)
+            sr = self._store.pop(app_id, None)
+        if sr is not None:
+            for name, r in sr.reservations.items():
+                self._notify_delta(r.node, r.resources, -1)
+                self._notify_membership(app_id, name)
 
     def _on_pod_deletion(self, pod: Pod) -> None:
         if not is_spark_scheduler_pod(pod):

@@ -92,9 +92,13 @@ class PlacementSolver:
     def build_tensors(
         self,
         nodes: Sequence[Node],
-        usage: dict[str, Resources],
-        overhead: dict[str, Resources],
+        usage,
+        overhead,
     ):
+        """`usage` / `overhead` are either {node: Resources} maps (the
+        reference's shape) or dense int64 [cap, 3] arrays indexed by this
+        solver's registry (the incremental-tracker fast path — no
+        per-reservation host walk)."""
         for n in nodes:
             self.registry.intern(n.name)
         pad = _bucket(self.registry.capacity, 8)
@@ -122,8 +126,8 @@ class PlacementSolver:
     def _build_tensors_native(
         self,
         nodes: list[Node],
-        usage: dict[str, Resources],
-        overhead: dict[str, Resources],
+        usage,
+        overhead,
         pad: int,
     ) -> ClusterTensors:
         """Arena-backed ClusterTensors. Deviation from the Python builder,
@@ -157,13 +161,8 @@ class PlacementSolver:
             )
             self._rank_epoch += 1
 
-        usage_t = np.zeros((pad, NUM_DIMS), dtype=np.int64)
-        overhead_t = np.zeros((pad, NUM_DIMS), dtype=np.int64)
-        for target, mapping in ((usage_t, usage), (overhead_t, overhead)):
-            for name, res in mapping.items():
-                idx = self.registry.index_of(name)
-                if idx is not None and idx < pad:
-                    target[idx] += res.as_array()
+        usage_t = self._dense_or_scatter(usage, pad)
+        overhead_t = self._dense_or_scatter(overhead, pad)
 
         fields = arena.snapshot(pad, usage_t, overhead_t)
         tensors = ClusterTensors(*fields)
@@ -174,6 +173,21 @@ class PlacementSolver:
         request_mask[[i for i in idxs if i is not None and i < pad]] = True
         tensors.valid &= request_mask
         return tensors
+
+    def _dense_or_scatter(self, mapping, pad: int) -> np.ndarray:
+        """[pad, 3] int64: a dense array is padded/truncated in one vectorized
+        op (rows past `pad` can only be registry-unused zeros); a map is
+        scattered entry-by-entry (the fallback path)."""
+        out = np.zeros((pad, NUM_DIMS), dtype=np.int64)
+        if isinstance(mapping, np.ndarray):
+            rows = min(pad, mapping.shape[0])
+            out[:rows] = mapping[:rows]
+            return out
+        for name, res in mapping.items():
+            idx = self.registry.index_of(name)
+            if idx is not None and idx < pad:
+                out[idx] += res.as_array()
+        return out
 
     def candidate_mask(self, tensors, node_names: Sequence[str]) -> np.ndarray:
         n = tensors.available.shape[0]

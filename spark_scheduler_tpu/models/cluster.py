@@ -204,10 +204,16 @@ def build_cluster_tensors(
                 if val is not None and val in values:
                     target[idx] = values.index(val)
 
+    # Dense inputs may be sized to a grown tracker buffer: rows past n_slots
+    # are registry-unused zeros, so pad/truncate to n_slots either way.
     if usage.shape[0] < n_slots:
         usage = np.pad(usage, ((0, n_slots - usage.shape[0]), (0, 0)))
+    elif usage.shape[0] > n_slots:
+        usage = usage[:n_slots]
     if overhead.shape[0] < n_slots:
         overhead = np.pad(overhead, ((0, n_slots - overhead.shape[0]), (0, 0)))
+    elif overhead.shape[0] > n_slots:
+        overhead = overhead[:n_slots]
 
     available = np.clip(
         alloc - usage.astype(np.int64) - overhead.astype(np.int64),

@@ -22,6 +22,7 @@ from spark_scheduler_tpu.core.solver import PlacementSolver
 from spark_scheduler_tpu.core.soft_reservations import SoftReservationStore
 from spark_scheduler_tpu.core.sparkpods import SparkPodLister
 from spark_scheduler_tpu.core.unschedulable import UnschedulablePodMarker
+from spark_scheduler_tpu.core.usage_tracker import ReservedUsageTracker
 from spark_scheduler_tpu.server.config import InstallConfig
 from spark_scheduler_tpu.store.backend import ClusterBackend, DEMAND_CRD
 from spark_scheduler_tpu.store.cache import ResourceReservationCache, SafeDemandCache
@@ -151,6 +152,12 @@ def build_scheduler_app(
             if config.executor_prioritized_node_label
             else None
         ),
+    )
+    # Delta-maintained reserved-usage aggregate over the solver's node-index
+    # space: the hot path reads a dense array instead of walking every
+    # reservation slot per request (SURVEY.md §7 latency budget).
+    reservation_manager.attach_usage_tracker(
+        ReservedUsageTracker(solver.registry, rr_cache, soft_store)
     )
     reconciler = FailoverReconciler(
         backend,

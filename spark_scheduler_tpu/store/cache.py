@@ -11,6 +11,7 @@ CRD kind gets 5 write workers over a sharded dedup queue
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional
 
 from spark_scheduler_tpu.store.async_client import (
@@ -40,6 +41,16 @@ class WriteThroughCache:
         self._store = ObjectStore()
         self._queue = make_sharded_queue(num_clients)
         self._sync = sync_writes
+        # Mutation listeners: fn(old, new) fired synchronously after every
+        # local-store mutation (create: old=None; delete: new=None). This is
+        # the delta feed for incremental aggregates (ReservedUsageTracker).
+        # The read-old -> write -> notify sequence is serialized by
+        # `_write_mutex`: the owner is the sole REQUEST-path writer, but the
+        # watch thread delivers `apply_external_delete`, so without the mutex
+        # racing writers could deliver mismatched (old, new) pairs and
+        # permanently corrupt delta-maintained state.
+        self._mutation_listeners: list = []
+        self._write_mutex = threading.RLock()
         self.client = AsyncClient(
             backend, kind, self._store, self._queue,
             max_retries=max_retries, metrics=AsyncClientMetrics(),
@@ -58,8 +69,20 @@ class WriteThroughCache:
         # deleter is this cache itself (delete already removed it); a k8s
         # adapter should call `apply_external_delete` from its watch stream.
 
+    def add_mutation_listener(self, fn) -> None:
+        """fn(old, new); see __init__ note. Must be fast and non-blocking."""
+        self._mutation_listeners.append(fn)
+
+    def _notify(self, old: Any, new: Any) -> None:
+        for fn in self._mutation_listeners:
+            fn(old, new)
+
     def apply_external_delete(self, namespace: str, name: str) -> None:
-        self._store.delete(namespace, name)
+        with self._write_mutex:
+            old = self._store.get(namespace, name)
+            self._store.delete(namespace, name)
+            if old is not None:
+                self._notify(old, None)
 
     def start(self) -> None:
         if not self._sync:
@@ -76,23 +99,32 @@ class WriteThroughCache:
             self.client.drain_sync()
 
     def create(self, obj: Any) -> bool:
-        if not self._store.put_if_absent(obj):
-            return False
-        self._queue.add_if_absent(Request(key=(obj.namespace, obj.name), type=RequestType.CREATE))
+        with self._write_mutex:
+            if not self._store.put_if_absent(obj):
+                return False
+            self._queue.add_if_absent(Request(key=(obj.namespace, obj.name), type=RequestType.CREATE))
+            self._notify(None, obj)
         self._after_write()
         return True
 
     def update(self, obj: Any) -> bool:
-        if self._store.get(obj.namespace, obj.name) is None:
-            return False
-        self._store.put(obj)
-        self._queue.add_if_absent(Request(key=(obj.namespace, obj.name), type=RequestType.UPDATE))
+        with self._write_mutex:
+            old = self._store.get(obj.namespace, obj.name)
+            if old is None:
+                return False
+            self._store.put(obj)
+            self._queue.add_if_absent(Request(key=(obj.namespace, obj.name), type=RequestType.UPDATE))
+            self._notify(old, obj)
         self._after_write()
         return True
 
     def delete(self, namespace: str, name: str) -> None:
-        self._store.delete(namespace, name)
-        self._queue.add_if_absent(Request(key=(namespace, name), type=RequestType.DELETE))
+        with self._write_mutex:
+            old = self._store.get(namespace, name)
+            self._store.delete(namespace, name)
+            self._queue.add_if_absent(Request(key=(namespace, name), type=RequestType.DELETE))
+            if old is not None:
+                self._notify(old, None)
         self._after_write()
 
     def get(self, namespace: str, name: str) -> Optional[Any]:

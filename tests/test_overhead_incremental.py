@@ -1,0 +1,113 @@
+"""Incremental overhead aggregates == the reference's per-query walk.
+
+The OverheadComputer maintains per-node overhead via membership deltas
+(pod/RR/soft events) instead of walking pods per query (overhead.go:120-168);
+these tests prove the aggregates exact against the oracle walk through the
+full scheduling lifecycle, including non-spark pods and unreserved pods of
+other schedulers.
+"""
+
+from __future__ import annotations
+
+from spark_scheduler_tpu.models.kube import Container, Pod
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    dynamic_allocation_spark_pods,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+def assert_overhead_consistent(h: Harness):
+    oc = h.app.overhead_computer
+    nodes = h.backend.list_nodes()
+    inc = oc.get_overhead(nodes)
+    inc_ns = oc.get_non_schedulable_overhead(nodes)
+    for n in nodes:
+        want, want_ns = oc.compute_node_overhead_oracle(n.name)
+        got = inc.get(n.name, Resources.zero())
+        got_ns = inc_ns.get(n.name, Resources.zero())
+        assert got.as_tuple() == want.as_tuple(), f"overhead mismatch on {n.name}"
+        assert got_ns.as_tuple() == want_ns.as_tuple(), (
+            f"non-schedulable overhead mismatch on {n.name}"
+        )
+
+
+def other_scheduler_pod(name: str, node: str, cpu="2", mem="2Gi") -> Pod:
+    return Pod(
+        name=name,
+        namespace="kube-system",
+        node_name=node,
+        phase="Running",
+        scheduler_name="default-scheduler",
+        containers=[Container(requests=Resources.from_quantities(cpu, mem))],
+    )
+
+
+def test_overhead_tracks_scheduling_lifecycle():
+    h = Harness()
+    h.add_nodes(*[new_node(f"n{i}") for i in range(5)])
+    names = [f"n{i}" for i in range(5)]
+
+    # Foreign pods (other scheduler, no reservations) are pure overhead.
+    h.backend.add_pod(other_scheduler_pod("daemon-1", "n0"))
+    h.backend.add_pod(other_scheduler_pod("daemon-2", "n3", cpu="1", mem="512Mi"))
+    assert_overhead_consistent(h)
+
+    # Spark pods gain reservations on admission -> leave overhead.
+    pods = static_allocation_spark_pods("app-1", 3)
+    assert all(r.ok for r in h.schedule_app(pods, names))
+    assert_overhead_consistent(h)
+
+    # Dynamic allocation: extras ride soft reservations (still reserved).
+    dpods = dynamic_allocation_spark_pods("app-2", 1, 3)
+    assert all(r.ok for r in h.schedule_app(dpods, names))
+    assert_overhead_consistent(h)
+
+    # Executor death + deletion: compaction moves soft->hard; pod leaves state.
+    h.terminate_pod(pods[2])
+    h.delete_pod(pods[2])
+    assert_overhead_consistent(h)
+
+    # Foreign pod deletion retracts its contribution.
+    h.backend.delete("pods", "kube-system", "daemon-1")
+    assert_overhead_consistent(h)
+
+
+def test_overhead_counts_unreserved_spark_pod():
+    """A spark pod bound WITHOUT a reservation (e.g. placed by another
+    scheduler path) is overhead until a reservation appears."""
+    h = Harness()
+    h.add_nodes(new_node("n0"), new_node("n1"))
+    pods = static_allocation_spark_pods("app-x", 1)
+    driver = pods[0]
+    # bind the driver directly, bypassing admission: no reservation exists
+    h.backend.add_pod(driver)
+    h.backend.bind_pod(driver, "n0")
+    assert_overhead_consistent(h)
+    oc = h.app.overhead_computer
+    got = oc.get_overhead(h.backend.list_nodes()).get("n0")
+    assert got is not None and got.cpu_milli > 0
+
+
+def test_overhead_recomputes_are_delta_scoped():
+    """Scheduling N apps must not trigger O(cluster) recomputes per request:
+    recompute count stays linear in events, not apps x pods."""
+    h = Harness()
+    h.add_nodes(*[new_node(f"n{i}") for i in range(8)])
+    names = [f"n{i}" for i in range(8)]
+    oc = h.app.overhead_computer
+
+    before = oc.recomputes
+    pods = static_allocation_spark_pods("app-solo", 2)
+    assert all(r.ok for r in h.schedule_app(pods, names))
+    per_app = oc.recomputes - before
+
+    before = oc.recomputes
+    for i in range(4):
+        extra = static_allocation_spark_pods(f"app-{i}", 2)
+        assert all(r.ok for r in h.schedule_app(extra, names))
+    # Each additional app costs about the same number of recomputes as the
+    # first (its own pods' events), not an amount growing with cluster size.
+    assert oc.recomputes - before <= 4 * (per_app + 4)
