@@ -84,15 +84,14 @@ class OverheadComputer:
         self._recompute(pod.namespace, pod.name)
 
     def _on_rr_mutation(self, old, new) -> None:
-        """An app's RR changed: pods named in either version's Status.Pods
-        may have flipped membership (O(slots of one app))."""
-        names: set[tuple[str, str]] = set()
-        for rr in (old, new):
-            if rr is None:
-                continue
-            for pod_name in rr.status.pods.values():
-                names.add((rr.namespace, pod_name))
-        for ns, name in names:
+        """An app's RR changed: only pods whose Status.Pods membership
+        actually flipped can change overhead membership, so recompute the
+        symmetric difference (one pod per executor bind), not the union —
+        a union walk would make binding executor k of an n-gang O(k·n) and
+        the whole gang O(n³) via pod_has_reservation's slot scan."""
+        old_pods = set((old.namespace, p) for p in old.status.pods.values()) if old else set()
+        new_pods = set((new.namespace, p) for p in new.status.pods.values()) if new else set()
+        for ns, name in old_pods.symmetric_difference(new_pods):
             self._recompute(ns, name)
 
     def _on_soft_membership(self, app_id: str, pod_name: str) -> None:

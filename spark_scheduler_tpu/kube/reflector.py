@@ -1,0 +1,323 @@
+"""List+watch reflectors feeding the ClusterBackend.
+
+The client-go informer slot (SURVEY.md L3): the reference builds a
+SharedInformerFactory per API group, lists then watches each resource,
+and hands add/update/delete events to components (cmd/server.go:111-147).
+`Reflector` reproduces the reflector/informer contract natively:
+
+  1. LIST the collection, remember the collection resourceVersion,
+     replace the local state wholesale (firing synthetic deletes for
+     objects that vanished during a watch gap);
+  2. WATCH from that resourceVersion, applying ADDED/MODIFIED/DELETED
+     incrementally and advancing the resume point with every event;
+  3. on stream end / network error: re-watch from the last seen
+     resourceVersion (resume, no relist);
+  4. on `410 Gone` (history expired): relist, then watch again — the
+     informer resync path;
+  5. `wait_synced` = WaitForCacheSync (cmd/server.go:140-147).
+
+`KubeIngestion` wires node + pod reflectors into a ClusterBackend and
+measures the creation→ingestion delay histogram the reference records per
+informer add (internal/metrics/informer.go:28-51).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+from typing import Any, Callable, Optional
+from urllib.parse import urlparse
+
+from spark_scheduler_tpu.server.kube_io import node_from_k8s, pod_from_k8s
+
+LIST_TIMEOUT_S = 10.0
+WATCH_TIMEOUT_S = 30.0  # per-request watch window; the loop re-arms
+RELIST_BACKOFF_S = 0.2
+INFORMER_DELAY_METRIC = "foundry.spark.scheduler.informer.delay"
+
+
+class GoneError(Exception):
+    """Watch history expired (HTTP 410 / ERROR event) — relist required."""
+
+
+class BackendSyncTarget:
+    """Applies decoded watch events to a ClusterBackend kind, diffing
+    wholesale relists into the add/update/delete stream subscribers expect
+    (the informer cache replace semantics)."""
+
+    def __init__(
+        self,
+        backend,
+        kind: str,
+        on_add: Optional[Callable[[Any], None]] = None,
+    ):
+        self._backend = backend
+        self._kind = kind
+        self._on_add = on_add
+
+    @staticmethod
+    def _key(obj) -> tuple[str, str]:
+        return (getattr(obj, "namespace", ""), obj.name)
+
+    def replace(self, objects: list) -> None:
+        new = {self._key(o): o for o in objects}
+        current = {self._key(o): o for o in self._backend.list(self._kind)}
+        for key, obj in current.items():
+            if key not in new:
+                self._backend.delete(self._kind, key[0], key[1])
+        for key, obj in new.items():
+            if key in current:
+                if current[key] != obj:  # dataclass field equality
+                    self._backend.update(self._kind, obj)
+            else:
+                self._backend.create(self._kind, obj)
+                if self._on_add:
+                    self._on_add(obj)
+
+    def add(self, obj) -> None:
+        if self._backend.get(self._kind, *self._key(obj)) is None:
+            self._backend.create(self._kind, obj)
+            if self._on_add:
+                self._on_add(obj)
+        else:
+            self._backend.update(self._kind, obj)
+
+    def update(self, obj) -> None:
+        if self._backend.get(self._kind, *self._key(obj)) is None:
+            self.add(obj)
+        else:
+            self._backend.update(self._kind, obj)
+
+    def delete(self, obj) -> None:
+        key = self._key(obj)
+        if self._backend.get(self._kind, *key) is not None:
+            self._backend.delete(self._kind, key[0], key[1])
+
+
+class Reflector:
+    """One resource's list+watch loop against a k8s-API base URL."""
+
+    def __init__(
+        self,
+        base_url: str,
+        collection_path: str,
+        decode: Callable[[dict], Any],
+        target: BackendSyncTarget,
+        name: str = "",
+        watch_timeout_s: float = WATCH_TIMEOUT_S,
+        relist_backoff_s: float = RELIST_BACKOFF_S,
+    ):
+        parsed = urlparse(base_url)
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or 80
+        self._path = collection_path
+        self._decode = decode
+        self._target = target
+        self.name = name or collection_path
+        self._watch_timeout_s = watch_timeout_s
+        self._relist_backoff_s = relist_backoff_s
+        self._stop = threading.Event()
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._conn_lock = threading.Lock()
+        self._watch_conn: Optional[http.client.HTTPConnection] = None
+        self.last_resource_version = 0
+        self.relist_count = 0  # observable: how many LISTs happened
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"reflector-{self.name}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._conn_lock:
+            if self._watch_conn is not None:
+                try:
+                    # shutdown() (not just close()) so a reader blocked in
+                    # recv() on another thread wakes immediately.
+                    sock = self._watch_conn.sock
+                    if sock is not None:
+                        import socket as _socket
+
+                        sock.shutdown(_socket.SHUT_RDWR)
+                    self._watch_conn.close()
+                except OSError:
+                    pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def wait_synced(self, timeout: Optional[float] = None) -> bool:
+        return self._synced.wait(timeout)
+
+    # -- the loop -----------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._list_and_watch()
+            except GoneError:
+                continue  # relist immediately
+            except Exception:
+                if self._stop.is_set():
+                    return
+                self._stop.wait(self._relist_backoff_s)
+
+    def _list_and_watch(self) -> None:
+        rv = self._list()
+        self.last_resource_version = rv
+        self._synced.set()
+        while not self._stop.is_set():
+            try:
+                self._watch_once()
+            except GoneError:
+                raise
+            except (OSError, http.client.HTTPException):
+                if self._stop.is_set():
+                    return
+                # Transient stream loss: resume from the last seen rv
+                # without relisting (reflector resume semantics).
+                self._stop.wait(self._relist_backoff_s)
+
+    def _list(self) -> int:
+        conn = http.client.HTTPConnection(self._host, self._port, timeout=LIST_TIMEOUT_S)
+        try:
+            conn.request("GET", self._path)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise http.client.HTTPException(f"list {self._path}: {resp.status}")
+            body = json.loads(resp.read())
+        finally:
+            conn.close()
+        self.relist_count += 1
+        items = [self._decode(raw) for raw in body.get("items", [])]
+        self._target.replace(items)
+        try:
+            return int((body.get("metadata") or {}).get("resourceVersion") or 0)
+        except ValueError:
+            return 0
+
+    def _watch_once(self) -> None:
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self._watch_timeout_s + LIST_TIMEOUT_S
+        )
+        with self._conn_lock:
+            self._watch_conn = conn
+        try:
+            conn.request(
+                "GET",
+                f"{self._path}?watch=true"
+                f"&resourceVersion={self.last_resource_version}"
+                f"&timeoutSeconds={self._watch_timeout_s:g}",
+            )
+            resp = conn.getresponse()
+            if resp.status == 410:
+                raise GoneError()
+            if resp.status != 200:
+                raise http.client.HTTPException(f"watch {self._path}: {resp.status}")
+            while not self._stop.is_set():
+                line = resp.readline()
+                if not line:
+                    return  # server closed the window; re-arm
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                self._apply(event)
+        finally:
+            with self._conn_lock:
+                self._watch_conn = None
+            conn.close()
+
+    def _apply(self, event: dict) -> None:
+        etype = event.get("type")
+        raw = event.get("object") or {}
+        if etype == "ERROR":
+            if raw.get("code") == 410:
+                raise GoneError()
+            raise http.client.HTTPException(f"watch error: {raw}")
+        if etype == "BOOKMARK":
+            rv = (raw.get("metadata") or {}).get("resourceVersion")
+            if rv:
+                self.last_resource_version = int(rv)
+            return
+        obj = self._decode(raw)
+        if etype == "ADDED":
+            self._target.add(obj)
+        elif etype == "MODIFIED":
+            self._target.update(obj)
+        elif etype == "DELETED":
+            self._target.delete(obj)
+        rv = (raw.get("metadata") or {}).get("resourceVersion")
+        if rv:
+            try:
+                self.last_resource_version = int(rv)
+            except ValueError:
+                pass
+
+
+class KubeIngestion:
+    """Node + pod reflectors for a scheduler app — the informer-factory
+    slot of initServer (cmd/server.go:111-147). Also records the
+    pod-creation→ingestion delay histogram (internal/metrics/informer.go:
+    28-51: time from pod creationTimestamp to the informer add callback)."""
+
+    def __init__(
+        self,
+        backend,
+        base_url: str,
+        metrics=None,
+        clock: Callable[[], float] = time.time,
+        watch_timeout_s: float = WATCH_TIMEOUT_S,
+    ):
+        def on_pod_add(pod) -> None:
+            if metrics is not None and pod.creation_timestamp:
+                delay = max(0.0, clock() - pod.creation_timestamp)
+                metrics.histogram(INFORMER_DELAY_METRIC, kind="pods").update(delay)
+
+        self.node_reflector = Reflector(
+            base_url,
+            "/api/v1/nodes",
+            node_from_k8s,
+            BackendSyncTarget(backend, "nodes"),
+            name="nodes",
+            watch_timeout_s=watch_timeout_s,
+        )
+        self.pod_reflector = Reflector(
+            base_url,
+            "/api/v1/pods",
+            pod_from_k8s,
+            BackendSyncTarget(backend, "pods", on_add=on_pod_add),
+            name="pods",
+            watch_timeout_s=watch_timeout_s,
+        )
+        self.reflectors = [self.node_reflector, self.pod_reflector]
+
+    def start(self) -> None:
+        for r in self.reflectors:
+            r.start()
+
+    def stop(self) -> None:
+        for r in self.reflectors:
+            r.stop()
+
+    def wait_synced(self, timeout: Optional[float] = None) -> bool:
+        """WaitForCacheSync: all reflectors listed at least once
+        (cmd/server.go:140-147)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for r in self.reflectors:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if not r.wait_synced(remaining):
+                return False
+        return True

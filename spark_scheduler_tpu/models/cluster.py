@@ -24,6 +24,7 @@ incremental scatter updates to device-resident state stay cheap.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Mapping
 
 import numpy as np
@@ -84,9 +85,15 @@ _jtu.register_pytree_node(
 
 
 class NodeRegistry:
-    """Host-side interning of node names and zone labels to stable indices."""
+    """Host-side interning of node names and zone labels to stable indices.
+
+    Interning is locked: indices are long-lived (the ReservedUsageTracker
+    scatters deltas into a dense array keyed by them from informer/listener
+    threads while request threads intern new nodes), so two threads racing
+    `intern` must never be handed the same index for different names."""
 
     def __init__(self):
+        self._intern_lock = threading.Lock()
         self._index: dict[str, int] = {}
         self._names: list[str | None] = []
         self._free: list[int] = []
@@ -94,22 +101,24 @@ class NodeRegistry:
         self._zone_names: list[str] = []
 
     def intern(self, name: str) -> int:
-        idx = self._index.get(name)
-        if idx is None:
-            if self._free:
-                idx = self._free.pop()
-                self._names[idx] = name
-            else:
-                idx = len(self._names)
-                self._names.append(name)
-            self._index[name] = idx
-        return idx
+        with self._intern_lock:
+            idx = self._index.get(name)
+            if idx is None:
+                if self._free:
+                    idx = self._free.pop()
+                    self._names[idx] = name
+                else:
+                    idx = len(self._names)
+                    self._names.append(name)
+                self._index[name] = idx
+            return idx
 
     def remove(self, name: str) -> None:
-        idx = self._index.pop(name, None)
-        if idx is not None:
-            self._names[idx] = None
-            self._free.append(idx)
+        with self._intern_lock:
+            idx = self._index.pop(name, None)
+            if idx is not None:
+                self._names[idx] = None
+                self._free.append(idx)
 
     def index_of(self, name: str) -> int | None:
         return self._index.get(name)

@@ -48,14 +48,21 @@ class SchedulerApp:
     extender: SparkSchedulerExtender
     unschedulable_marker: UnschedulablePodMarker
     demand_crd_watcher: LazyDemandCRDWatcher
+    ingestion: object | None = None  # KubeIngestion when kube_api_url is set
 
     def start_background(self) -> None:
-        """Async write-back workers + background loops (cmd/server.go:239-247)."""
+        """Async write-back workers + background loops (cmd/server.go:239-247).
+        Ingestion reflectors start first so WaitForCacheSync-style readiness
+        can observe them (cmd/server.go:111-147)."""
+        if self.ingestion is not None:
+            self.ingestion.start()
         self.rr_cache.start()
         self.unschedulable_marker.start()
         self.demand_crd_watcher.start()
 
     def stop(self) -> None:
+        if self.ingestion is not None:
+            self.ingestion.stop()
         self.demand_crd_watcher.stop()
         self.unschedulable_marker.stop()
         self.rr_cache.flush()
@@ -199,6 +206,13 @@ def build_scheduler_app(
         timeout_s=config.unschedulable_pod_timeout_s,
         clock=clock,
     )
+    ingestion = None
+    if config.kube_api_url:
+        from spark_scheduler_tpu.kube.reflector import KubeIngestion
+
+        ingestion = KubeIngestion(
+            backend, config.kube_api_url, metrics=metrics, clock=clock
+        )
     # A pre-existing Demand CRD (registered before the app was built)
     # activates demand features synchronously; otherwise the background
     # poll in start_background() picks it up.
@@ -218,4 +232,5 @@ def build_scheduler_app(
         extender=extender,
         unschedulable_marker=marker,
         demand_crd_watcher=demand_crd_watcher,
+        ingestion=ingestion,
     )

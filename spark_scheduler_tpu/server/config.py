@@ -47,6 +47,10 @@ class InstallConfig:
     # reference's 30s metric flush, metrics/metrics.go:79). None = off;
     # metrics remain pollable at GET /metrics either way.
     metrics_log: Optional[str] = None
+    # Kubernetes apiserver base URL for list+watch ingestion (the informer
+    # slot, cmd/server.go:111-147). None = state arrives via PUT /state/*
+    # or an embedding program driving the backend directly.
+    kube_api_url: Optional[str] = None
 
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
@@ -93,6 +97,7 @@ class InstallConfig:
             port=int(raw.get("port", 8484)),
             batched_admission=bool(raw.get("batched-admission", True)),
             metrics_log=raw.get("metrics-log"),
+            kube_api_url=raw.get("kube-api-url"),
         )
 
 

@@ -81,6 +81,7 @@ class SchedulerHTTPServer:
         self.app = app
         self.registry = registry
         self.ready = threading.Event()
+        self._shutdown = threading.Event()
         # One predicate at a time — the serialization point for mutable
         # scheduling state (SURVEY.md §7 "Mutable-state races").
         self._predicate_lock = threading.Lock()
@@ -178,11 +179,28 @@ class SchedulerHTTPServer:
         self._thread = _run_threaded(self._server, "scheduler-http")
         # Ready only once cluster state exists; pre-seeded backends (tests,
         # embedded use) are ready at once, otherwise the first successful
-        # PUT /state/nodes flips it.
+        # PUT /state/nodes — or watch-ingestion cache sync
+        # (WaitForCacheSync, cmd/server.go:140-147) — flips it.
         if self.app.backend.list_nodes():
             self.ready.set()
+        elif getattr(self.app, "ingestion", None) is not None:
+            def _ready_on_sync():
+                # Wait as long as it takes (WaitForCacheSync blocks until
+                # sync or shutdown) — a slow apiserver must not leave the
+                # server permanently not-ready.
+                while not self.ready.is_set():
+                    if self.app.ingestion.wait_synced(timeout=30.0):
+                        self.ready.set()
+                        return
+                    if self._shutdown.is_set():
+                        return
+
+            threading.Thread(
+                target=_ready_on_sync, daemon=True, name="ingestion-sync-ready"
+            ).start()
 
     def stop(self) -> None:
+        self._shutdown.set()
         self.ready.clear()
         self._server.shutdown()
         if self._thread is not None:
