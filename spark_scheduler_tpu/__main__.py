@@ -24,6 +24,17 @@ def main(argv=None) -> int:
     srv.add_argument("--config", help="install YAML (config/config.go:24-84 surface)")
     srv.add_argument("--host", default="0.0.0.0")
     srv.add_argument("--port", type=int, default=None)
+    srv.add_argument(
+        "--durable-store",
+        default=None,
+        help="JSONL write-ahead log path; state survives restarts "
+        "(the etcd/CRD persistence slot, SURVEY.md §5.4)",
+    )
+    srv.add_argument(
+        "--kube-api-url",
+        default=None,
+        help="apiserver base URL for list+watch ingestion (informer slot)",
+    )
     cw = sub.add_parser(
         "conversion-webhook", help="run the standalone CRD-conversion webhook"
     )
@@ -71,12 +82,21 @@ def main(argv=None) -> int:
             config = InstallConfig.from_dict(yaml.safe_load(f) or {})
     if args.port is not None:
         config.port = args.port
+    if args.durable_store is not None:
+        config.durable_store_path = args.durable_store
+    if args.kube_api_url is not None:
+        config.kube_api_url = args.kube_api_url
 
     registry = MetricRegistry()
     metrics = SchedulerMetrics(registry, config.instance_group_label)
     events = EventEmitter(instance_group_label=config.instance_group_label)
     waste = WasteReporter(registry, config.instance_group_label)
-    backend = InMemoryBackend()
+    if config.durable_store_path:
+        from spark_scheduler_tpu.store.durable import DurableBackend
+
+        backend = DurableBackend(config.durable_store_path)
+    else:
+        backend = InMemoryBackend()
     backend.register_crd(DEMAND_CRD)
     app = build_scheduler_app(
         backend, config, metrics=metrics, events=events, waste=waste
@@ -106,7 +126,20 @@ def main(argv=None) -> int:
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)
     try:
-        server.serve_forever()
+        server.start()
+        if config.durable_store_path:
+            # Restored WAL state must be reconciled against CURRENT cluster
+            # state: wait for watch-ingestion cache sync first so pods
+            # deleted during downtime don't spawn phantom reservations
+            # (WaitForCacheSync precedes failover recovery,
+            # cmd/server.go:140-147 then failover.go:35-72 — the restart IS
+            # a leader change).
+            if app.ingestion is not None:
+                app.ingestion.wait_synced(timeout=300.0)
+            app.reconciler.sync_resource_reservations_and_demands()
+        server.join()
+    except KeyboardInterrupt:
+        server.stop()
     finally:
         reporters.stop()
     return 0

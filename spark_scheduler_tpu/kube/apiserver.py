@@ -66,6 +66,10 @@ COLLECTIONS = (
 )
 
 
+class ValidationError(Exception):
+    """Object rejected by its CRD's openAPI schema (HTTP 422 Invalid)."""
+
+
 def _meta(obj: dict) -> dict:
     return obj.setdefault("metadata", {})
 
@@ -90,6 +94,10 @@ class FakeKubeAPIServer:
             res: _Collection(res, namespaced, kind, prefix)
             for res, namespaced, kind, prefix in COLLECTIONS
         }
+        # CRD manifests by plural resource name; writes to a collection with
+        # a registered CRD are validated against its openAPI schema the way
+        # the real apiserver's structural validation would reject them.
+        self._crds: dict[str, dict] = {}
         # (rv, resource, event_type, object-snapshot); single global window,
         # mirroring etcd's single revision domain.
         self._history: collections.deque = collections.deque(maxlen=history_limit)
@@ -144,23 +152,65 @@ class FakeKubeAPIServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    # -- CRD registration ----------------------------------------------------
+
+    def register_crd(self, crd: dict) -> None:
+        plural = crd["spec"]["names"]["plural"]
+        with self._lock:
+            self._crds[plural] = crd
+
+    def _validate(self, resource: str, obj: dict) -> None:
+        with self._lock:
+            crd = self._crds.get(resource)
+        if crd is None:
+            return
+        from spark_scheduler_tpu.models.crds import validate_custom_resource
+
+        errors = validate_custom_resource(crd, obj)
+        if errors:
+            raise ValidationError("; ".join(errors))
+
     # -- state mutation (also the test-driver API) --------------------------
 
     def create(self, resource: str, obj: dict) -> dict:
         col = self.collections[resource]
+        self._validate(resource, obj)
         with self._cond:
             key = _obj_key(obj)
             if key in col.objects:
                 raise KeyError(f"{resource} {key} exists")
             self._rv += 1
             _meta(obj)["resourceVersion"] = str(self._rv)
-            col.objects[key] = obj
-            self._history.append((self._rv, resource, "ADDED", json.loads(json.dumps(obj))))
+            # Store a snapshot, not the caller's dict: later caller-side
+            # mutation must not change apiserver state without a watch event.
+            snapshot = json.loads(json.dumps(obj))
+            col.objects[key] = snapshot
+            self._history.append((self._rv, resource, "ADDED", snapshot))
             self._cond.notify_all()
         return obj
 
+    def create_many(self, resource: str, objs: list[dict]) -> None:
+        """Create a batch under ONE lock acquisition — no watcher can
+        interleave, so a batch larger than the history window deterministically
+        forces the mid-stream 410 path (tests) and bulk seeding is fast."""
+        col = self.collections[resource]
+        for obj in objs:
+            self._validate(resource, obj)
+        with self._cond:
+            for obj in objs:
+                key = _obj_key(obj)
+                if key in col.objects:
+                    raise KeyError(f"{resource} {key} exists")
+                self._rv += 1
+                _meta(obj)["resourceVersion"] = str(self._rv)
+                snapshot = json.loads(json.dumps(obj))
+                col.objects[key] = snapshot
+                self._history.append((self._rv, resource, "ADDED", snapshot))
+            self._cond.notify_all()
+
     def update(self, resource: str, obj: dict, check_rv: bool = False) -> dict:
         col = self.collections[resource]
+        self._validate(resource, obj)
         with self._cond:
             key = _obj_key(obj)
             cur = col.objects.get(key)
@@ -174,8 +224,9 @@ class FakeKubeAPIServer:
                     )
             self._rv += 1
             _meta(obj)["resourceVersion"] = str(self._rv)
-            col.objects[key] = obj
-            self._history.append((self._rv, resource, "MODIFIED", json.loads(json.dumps(obj))))
+            snapshot = json.loads(json.dumps(obj))
+            col.objects[key] = snapshot
+            self._history.append((self._rv, resource, "MODIFIED", snapshot))
             self._cond.notify_all()
         return obj
 
@@ -325,17 +376,25 @@ class FakeKubeAPIServer:
 
         while True:
             batch: list[tuple[str, dict]] = []
+            expired_mid_stream = False
             with self._cond:
-                for rv, resource, etype, obj in self._history:
-                    if rv <= last_sent or resource != col.resource:
-                        continue
-                    if ns is not None and _obj_key(obj)[0] != ns:
-                        # Filtered events still advance the cursor.
+                # Events the client hasn't consumed yet can be pruned while
+                # the stream is blocked on a slow writer; silently skipping
+                # them would let the client diverge forever. Error the watch
+                # (410) so it relists — real apiserver behavior.
+                if self._history and self._history[0][0] > last_sent + 1:
+                    expired_mid_stream = True
+                else:
+                    for rv, resource, etype, obj in self._history:
+                        if rv <= last_sent or resource != col.resource:
+                            continue
+                        if ns is not None and _obj_key(obj)[0] != ns:
+                            # Filtered events still advance the cursor.
+                            last_sent = rv
+                            continue
+                        batch.append((etype, obj))
                         last_sent = rv
-                        continue
-                    batch.append((etype, obj))
-                    last_sent = rv
-                if not batch:
+                if not batch and not expired_mid_stream:
                     if self._closed:
                         break
                     remaining = deadline - _time.monotonic()
@@ -345,6 +404,18 @@ class FakeKubeAPIServer:
                     if self._closed:
                         break
                     continue
+            if expired_mid_stream:
+                send_event(
+                    {
+                        "type": "ERROR",
+                        "object": self._status(
+                            410,
+                            "Expired",
+                            f"events pruned past resource version {last_sent}",
+                        ),
+                    }
+                )
+                break
             ok = True
             for etype, obj in batch:
                 if not send_event({"type": etype, "object": obj}):
@@ -398,6 +469,8 @@ class FakeKubeAPIServer:
                     return
                 self.delete(col.resource, ns or "", name)
                 self._write_json(handler, 200, self._status(200, "Success", name))
+        except ValidationError as exc:
+            self._write_json(handler, 422, self._status(422, "Invalid", str(exc)))
         except KeyError as exc:
             self._write_json(handler, 409, self._status(409, "AlreadyExists", str(exc)))
         except LookupError as exc:

@@ -129,12 +129,13 @@ class NodeRegistry:
         return None
 
     def zone_id(self, zone: str) -> int:
-        zid = self._zone_ids.get(zone)
-        if zid is None:
-            zid = len(self._zone_names)
-            self._zone_ids[zone] = zid
-            self._zone_names.append(zone)
-        return zid
+        with self._intern_lock:
+            zid = self._zone_ids.get(zone)
+            if zid is None:
+                zid = len(self._zone_names)
+                self._zone_ids[zone] = zid
+                self._zone_names.append(zone)
+            return zid
 
     @property
     def capacity(self) -> int:

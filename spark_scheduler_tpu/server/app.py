@@ -85,8 +85,11 @@ def build_scheduler_app(
     clock = clock or _time.time
 
     # The scheduler owns its reservation CRD: create-or-upgrade + verify
-    # Established before anything consumes it (cmd/server.go:103-109).
-    ensure_resource_reservations_crd(backend)
+    # Established before anything consumes it (cmd/server.go:103-109); the
+    # full manifest (schemas + conversion strategy) is registered.
+    ensure_resource_reservations_crd(
+        backend, webhook_url=config.conversion_webhook_url
+    )
 
     rr_cache = ResourceReservationCache(
         backend,

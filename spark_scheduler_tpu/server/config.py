@@ -51,6 +51,13 @@ class InstallConfig:
     # slot, cmd/server.go:111-147). None = state arrives via PUT /state/*
     # or an embedding program driving the backend directly.
     kube_api_url: Optional[str] = None
+    # Conversion webhook client URL wired into the ResourceReservation CRD
+    # (config.go:79-84 WebhookServiceConfig + conversionwebhook client
+    # config). None = conversion strategy "None".
+    conversion_webhook_url: Optional[str] = None
+    # JSONL write-ahead log path for the durable backend (the etcd slot);
+    # used by the CLI to construct a DurableBackend. None = in-memory only.
+    durable_store_path: Optional[str] = None
 
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
@@ -98,6 +105,8 @@ class InstallConfig:
             batched_admission=bool(raw.get("batched-admission", True)),
             metrics_log=raw.get("metrics-log"),
             kube_api_url=raw.get("kube-api-url"),
+            conversion_webhook_url=raw.get("conversion-webhook-url"),
+            durable_store_path=raw.get("durable-store-path"),
         )
 
 

@@ -207,10 +207,15 @@ class SchedulerHTTPServer:
             self._thread.join(timeout=5)
         self.app.stop()
 
+    def join(self) -> None:
+        """Block until the serving thread exits (after start())."""
+        if self._thread is not None:
+            self._thread.join()
+
     def serve_forever(self) -> None:
         self.start()
         try:
-            self._thread.join()
+            self.join()
         except KeyboardInterrupt:
             self.stop()
 

@@ -138,6 +138,117 @@ def node_from_k8s(raw: dict[str, Any]) -> Node:
     )
 
 
+def _requests_to_k8s(res: Resources) -> dict:
+    from spark_scheduler_tpu.models.resources import resources_to_quantity_map
+
+    return resources_to_quantity_map(res)
+
+
+def pod_to_k8s(pod: Pod) -> dict[str, Any]:
+    """Inverse of pod_from_k8s: emit the k8s-shaped JSON the parser reads
+    back losslessly (numeric epoch timestamps are accepted by _parse_time,
+    so sub-second creation times survive). Used by the durable store's
+    log records and by test fixtures."""
+    containers = []
+    container_statuses = []
+    for c in pod.containers:
+        containers.append(
+            {"name": c.name, "resources": {"requests": _requests_to_k8s(c.requests)}}
+        )
+        if c.terminated:
+            container_statuses.append({"name": c.name, "state": {"terminated": {}}})
+    raw: dict[str, Any] = {
+        "kind": "Pod",
+        "apiVersion": "v1",
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "labels": dict(pod.labels),
+            "annotations": dict(pod.annotations),
+            "creationTimestamp": pod.creation_timestamp,
+            "uid": pod.uid,
+            **(
+                {"deletionTimestamp": pod.deletion_timestamp}
+                if pod.deletion_timestamp is not None
+                else {}
+            ),
+        },
+        "spec": {
+            "schedulerName": pod.scheduler_name,
+            **({"nodeName": pod.node_name} if pod.node_name else {}),
+            "nodeSelector": dict(pod.node_selector),
+            **(
+                {
+                    "affinity": {
+                        "nodeAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": {
+                                "nodeSelectorTerms": [
+                                    {
+                                        "matchExpressions": [
+                                            {
+                                                "key": k,
+                                                "operator": "In",
+                                                "values": list(vals),
+                                            }
+                                            for k, vals in pod.node_affinity.items()
+                                        ]
+                                    }
+                                ]
+                            }
+                        }
+                    }
+                }
+                if pod.node_affinity
+                else {}
+            ),
+            "containers": containers,
+            "initContainers": [
+                {"name": c.name, "resources": {"requests": _requests_to_k8s(c.requests)}}
+                for c in pod.init_containers
+            ],
+        },
+        "status": {
+            "phase": pod.phase,
+            "conditions": [
+                {
+                    "type": c.type,
+                    "status": "True" if c.status else "False",
+                    "reason": c.reason,
+                    "message": c.message,
+                    "lastTransitionTime": c.last_transition_time,
+                }
+                for c in pod.conditions
+            ],
+            **(
+                {"containerStatuses": container_statuses}
+                if container_statuses
+                else {}
+            ),
+        },
+    }
+    return raw
+
+
+def node_to_k8s(node: Node) -> dict[str, Any]:
+    """Inverse of node_from_k8s."""
+    return {
+        "kind": "Node",
+        "apiVersion": "v1",
+        "metadata": {
+            "name": node.name,
+            "labels": dict(node.labels),
+            "creationTimestamp": node.creation_timestamp,
+        },
+        "spec": {"unschedulable": node.unschedulable},
+        "status": {
+            "allocatable": _requests_to_k8s(node.allocatable),
+            "conditions": [
+                {"type": "Ready", "status": "True" if node.ready else "False"}
+            ],
+        },
+    }
+
+
 def filter_result_to_k8s(result) -> dict[str, Any]:
     """ExtenderFilterResult with Go field names (types.go:86-101; the Go
     struct has no json tags, so fields serialize capitalized). Internal

@@ -71,24 +71,38 @@ class InMemoryBackend(ClusterBackend):
         self._handlers: dict[str, _Handlers] = {k: _Handlers() for k in KINDS}
         self._rv_counter = 0
         self._crds: set[str] = {RESERVATION_CRD}
+        # Full CRD manifests (openAPI schemas etc.) keyed by CRD name; the
+        # reference ships complete CustomResourceDefinition objects
+        # (crd_resource_reservation.go:83-115), not just names.
+        self._crd_definitions: dict[str, dict] = {}
         self.terminating_namespaces: set[str] = set()
         # Write fault injection for tests: fn(kind, verb, obj) -> Exception | None
         self.fault_injector: Optional[Callable[[str, str, Any], Optional[Exception]]] = None
 
     # -- CRDs ---------------------------------------------------------------
 
-    def register_crd(self, name: str) -> None:
+    def register_crd(self, name: str, definition: Optional[dict] = None) -> None:
+        """Create-or-upgrade: re-registering an existing CRD replaces its
+        definition (the reference's EnsureResourceReservationsCRD update
+        path, crd/utils.go:98-133)."""
         with self._lock:
             self._crds.add(name)
+            if definition is not None:
+                self._crd_definitions[name] = definition
 
     def crd_exists(self, name: str) -> bool:
         with self._lock:
             return name in self._crds
 
+    def get_crd_definition(self, name: str) -> Optional[dict]:
+        with self._lock:
+            return self._crd_definitions.get(name)
+
     def unregister_crd(self, name: str) -> None:
         """Delete-on-failed-verify path (crd/utils.go:134-149)."""
         with self._lock:
             self._crds.discard(name)
+            self._crd_definitions.pop(name, None)
 
     # -- event subscription -------------------------------------------------
 

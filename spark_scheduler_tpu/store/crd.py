@@ -35,12 +35,21 @@ def ensure_resource_reservations_crd(
     timeout_s: float = ESTABLISH_TIMEOUT_S,
     clock=time.monotonic,
     sleep=time.sleep,
+    webhook_url: str | None = None,
+    ca_bundle: str | None = None,
 ) -> None:
-    """Create-or-upgrade the reservation CRD, then poll until it reports
+    """Create-or-upgrade the reservation CRD — the FULL manifest with
+    openAPI schemas, served/storage versions and (when `webhook_url` is
+    given) the webhook conversion strategy — then poll until it reports
     Established; on verification failure delete the half-created CRD and
-    raise, so a restart retries cleanly (crd/utils.go:98-151)."""
-    if not backend.crd_exists(name):
-        backend.register_crd(name)
+    raise, so a restart retries cleanly (crd/utils.go:98-151,
+    crd_resource_reservation.go:83-115)."""
+    from spark_scheduler_tpu.models.crds import resource_reservation_crd
+
+    definition = resource_reservation_crd(webhook_url=webhook_url, ca_bundle=ca_bundle)
+    # Upsert even when the CRD already exists: the reference's ensure path
+    # *updates* an existing CRD to the current definition (version upgrade).
+    backend.register_crd(name, definition)
     deadline = clock() + timeout_s
     while not backend.crd_exists(name):
         if clock() > deadline:

@@ -1,0 +1,299 @@
+"""File-backed durable ClusterBackend (the etcd slot).
+
+In the reference, reservations/demands persist in etcd via CRDs — the CRDs
+*are* the checkpoint (SURVEY.md §5.4): a restarted leader refills its cache
+from the apiserver (cache/resourcereservations.go:53-60) and reconciles
+drift from observed pods (failover.go:35-72). `DurableBackend` gives a
+standalone deployment the same property without an apiserver: every
+mutation appends one JSON-line record (k8s wire-shaped object payloads) to
+a log; on startup the log replays into memory, after which the normal
+failover reconciliation runs against real persisted state.
+
+Record format (one JSON object per line):
+
+    {"verb": "create|update|delete", "kind": "<collection>",
+     "ns": "...", "name": "...", "object": {<k8s wire form>}}
+    {"verb": "register_crd"|"unregister_crd", "name": "...",
+     "definition": {...}}
+
+`compact()` rewrites the log as one create per live object (the etcd
+compaction analog) — callable any time; the scheduler also compacts on
+startup after replay so the log stays bounded across restart cycles.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional
+
+from spark_scheduler_tpu.models.demands import Demand
+from spark_scheduler_tpu.models.kube import Node, Pod
+from spark_scheduler_tpu.models.reservations import ResourceReservation
+from spark_scheduler_tpu.store.backend import InMemoryBackend
+
+
+def _rr_to_record(rr: ResourceReservation) -> dict:
+    from spark_scheduler_tpu.server.conversion import rr_v1beta2_to_wire
+
+    wire = rr_v1beta2_to_wire(rr)
+    # The ownerReference to the driver pod normally lives in ObjectMeta
+    # (newResourceReservation sets it); models carry it as owner_pod_uid.
+    if rr.owner_pod_uid and not wire["metadata"].get("ownerReferences"):
+        wire["metadata"]["ownerReferences"] = [
+            {"apiVersion": "v1", "kind": "Pod", "uid": rr.owner_pod_uid}
+        ]
+    return wire
+
+
+def _rr_from_record(raw: dict) -> ResourceReservation:
+    from spark_scheduler_tpu.server.conversion import rr_v1beta2_from_wire
+
+    rr = rr_v1beta2_from_wire(raw)
+    for ref in (raw.get("metadata") or {}).get("ownerReferences") or []:
+        if ref.get("kind") == "Pod" and ref.get("uid"):
+            rr.owner_pod_uid = ref["uid"]
+            break
+    return rr
+
+
+def _demand_to_record(d: Demand) -> dict:
+    from spark_scheduler_tpu.server.conversion import demand_v1alpha2_to_wire
+
+    return demand_v1alpha2_to_wire(d)
+
+
+def _demand_from_record(raw: dict) -> Demand:
+    from spark_scheduler_tpu.server.conversion import demand_v1alpha2_from_wire
+
+    return demand_v1alpha2_from_wire(raw)
+
+
+def _pod_to_record(p: Pod) -> dict:
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+
+    return pod_to_k8s(p)
+
+
+def _pod_from_record(raw: dict) -> Pod:
+    from spark_scheduler_tpu.server.kube_io import pod_from_k8s
+
+    return pod_from_k8s(raw)
+
+
+def _node_to_record(n: Node) -> dict:
+    from spark_scheduler_tpu.server.kube_io import node_to_k8s
+
+    return node_to_k8s(n)
+
+
+def _node_from_record(raw: dict) -> Node:
+    from spark_scheduler_tpu.server.kube_io import node_from_k8s
+
+    return node_from_k8s(raw)
+
+
+_CODECS = {
+    "pods": (_pod_to_record, _pod_from_record),
+    "nodes": (_node_to_record, _node_from_record),
+    "resourcereservations": (_rr_to_record, _rr_from_record),
+    "demands": (_demand_to_record, _demand_from_record),
+}
+
+
+class DurableBackend(InMemoryBackend):
+    """InMemoryBackend + JSONL write-ahead persistence. Replays the log on
+    construction (before any component subscribes, so no spurious events
+    fire), then compacts it."""
+
+    def __init__(self, path: str, fsync: bool = False, compact_on_load: bool = True):
+        super().__init__()
+        self.path = path
+        self._fsync = fsync
+        self._log_lock = threading.Lock()
+        self._replaying = False
+        self._file: Optional[Any] = None
+        if os.path.exists(path):
+            self._replay()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        if compact_on_load:
+            self.compact()
+        else:
+            self._file = open(self.path, "a", encoding="utf-8")
+
+    # -- persistence plumbing ------------------------------------------------
+
+    def _append(self, record: dict) -> None:
+        if self._replaying:
+            return
+        with self._log_lock:
+            if self._file is None:
+                self._file = open(self.path, "a", encoding="utf-8")
+            self._file.write(json.dumps(record) + "\n")
+            self._file.flush()
+            if self._fsync:
+                os.fsync(self._file.fileno())
+
+    def _replay(self) -> None:
+        self._replaying = True
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        record = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail write from a crash — skip
+                    self._apply_record(record)
+        finally:
+            self._replaying = False
+
+    def _apply_record(self, record: dict) -> None:
+        verb = record.get("verb")
+        if verb == "register_crd":
+            self._crds.add(record["name"])
+            if record.get("definition"):
+                self._crd_definitions[record["name"]] = record["definition"]
+            return
+        if verb == "unregister_crd":
+            self._crds.discard(record["name"])
+            self._crd_definitions.pop(record["name"], None)
+            return
+        # fall through to object records
+        kind = record.get("kind")
+        if kind not in _CODECS:
+            return
+        decode = _CODECS[kind][1]
+        key = (record.get("ns", ""), record.get("name", ""))
+        if verb == "delete":
+            self._objects[kind].pop(key, None)
+        elif verb in ("create", "update"):
+            obj = decode(record["object"])
+            if hasattr(obj, "resource_version"):
+                # Fresh rv domain per process life; replayed order preserves
+                # monotonicity.
+                obj.resource_version = self._next_rv()
+            self._objects[kind][key] = obj
+        # No handler fires during replay: components subscribe only after
+        # the backend is constructed (build_scheduler_app ordering).
+
+    def compact(self) -> None:
+        """Rewrite the log to one create per live object + the CRD registry
+        (atomic via rename)."""
+        tmp = self.path + ".tmp"
+        with self._log_lock, self._lock:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for name in sorted(self._crds):
+                    f.write(
+                        json.dumps(
+                            {
+                                "verb": "register_crd",
+                                "name": name,
+                                **(
+                                    {"definition": self._crd_definitions[name]}
+                                    if name in self._crd_definitions
+                                    else {}
+                                ),
+                            }
+                        )
+                        + "\n"
+                    )
+                for kind, (encode, _) in _CODECS.items():
+                    for (ns, name), obj in sorted(self._objects[kind].items()):
+                        f.write(
+                            json.dumps(
+                                {
+                                    "verb": "create",
+                                    "kind": kind,
+                                    "ns": ns,
+                                    "name": name,
+                                    "object": encode(obj),
+                                }
+                            )
+                            + "\n"
+                        )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            if self._file is not None:
+                self._file.close()
+            self._file = open(self.path, "a", encoding="utf-8")
+
+    def close(self) -> None:
+        with self._log_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    # -- logged mutations ----------------------------------------------------
+
+    def create(self, kind: str, obj: Any):
+        created = super().create(kind, obj)
+        if kind in _CODECS:
+            encode = _CODECS[kind][0]
+            ns = getattr(created, "namespace", "")
+            self._append(
+                {
+                    "verb": "create",
+                    "kind": kind,
+                    "ns": ns,
+                    "name": created.name,
+                    "object": encode(created),
+                }
+            )
+        return created
+
+    def update(self, kind: str, obj: Any):
+        updated = super().update(kind, obj)
+        if kind in _CODECS:
+            encode = _CODECS[kind][0]
+            ns = getattr(updated, "namespace", "")
+            self._append(
+                {
+                    "verb": "update",
+                    "kind": kind,
+                    "ns": ns,
+                    "name": updated.name,
+                    "object": encode(updated),
+                }
+            )
+        return updated
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        super().delete(kind, namespace, name)
+        if kind in _CODECS:
+            self._append(
+                {"verb": "delete", "kind": kind, "ns": namespace, "name": name}
+            )
+
+    def bind_pod(self, pod: Pod, node_name: str, phase: str = "Running"):
+        bound = super().bind_pod(pod, node_name, phase)
+        self._append(
+            {
+                "verb": "update",
+                "kind": "pods",
+                "ns": bound.namespace,
+                "name": bound.name,
+                "object": _pod_to_record(bound),
+            }
+        )
+        return bound
+
+    # -- CRD registry (persisted) --------------------------------------------
+
+    def register_crd(self, name: str, definition: Optional[dict] = None) -> None:
+        super().register_crd(name, definition)
+        self._append(
+            {
+                "verb": "register_crd",
+                "name": name,
+                **({"definition": definition} if definition is not None else {}),
+            }
+        )
+
+    def unregister_crd(self, name: str) -> None:
+        super().unregister_crd(name)
+        self._append({"verb": "unregister_crd", "name": name})

@@ -130,9 +130,12 @@ class Harness:
         metrics=None,
         events=None,
         waste=None,
+        backend=None,
         **config_kw,
     ):
-        self.backend = InMemoryBackend()
+        # An injected backend (e.g. DurableBackend for restart tests) is
+        # used as-is; default is a fresh in-memory cluster.
+        self.backend = backend if backend is not None else InMemoryBackend()
         self.backend.register_crd(DEMAND_CRD)
         self.app: SchedulerApp = build_scheduler_app(
             self.backend,

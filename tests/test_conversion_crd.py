@@ -265,7 +265,7 @@ def test_ensure_reservation_crd_creates_and_verifies():
 
 def test_ensure_crd_deletes_on_failed_verify():
     class NeverEstablished(InMemoryBackend):
-        def register_crd(self, name):
+        def register_crd(self, name, definition=None):
             pass  # create "succeeds" but never reports Established
 
         def crd_exists(self, name):

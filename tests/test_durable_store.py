@@ -1,0 +1,264 @@
+"""Durable store + full CRD definition tests (VERDICT #7, SURVEY.md §5.4).
+
+The reference's CRDs persist in etcd and survive leader changes; a new
+leader refills caches from the apiserver and reconciles drift from pods
+(cache/resourcereservations.go:53-60, failover.go:35-72). DurableBackend
+gives the standalone deployment the same property via a JSONL write-ahead
+log; these tests prove reservations survive process death.
+"""
+
+from __future__ import annotations
+
+from spark_scheduler_tpu.models.crds import (
+    DEMAND_CRD_NAME,
+    RESERVATION_CRD_NAME,
+    demand_crd,
+    resource_reservation_crd,
+    validate_custom_resource,
+)
+from spark_scheduler_tpu.models.demands import Demand, DemandSpec, DemandStatus, DemandUnit
+from spark_scheduler_tpu.models.reservations import (
+    Reservation,
+    ReservationSpec,
+    ReservationStatus,
+    ResourceReservation,
+)
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.server.conversion import (
+    demand_v1alpha2_to_wire,
+    rr_v1beta2_to_wire,
+)
+from spark_scheduler_tpu.store.backend import DEMAND_CRD, RESERVATION_CRD
+from spark_scheduler_tpu.store.durable import DurableBackend
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+def _sample_rr() -> ResourceReservation:
+    return ResourceReservation(
+        name="app-1",
+        namespace="ns",
+        labels={"a": "b"},
+        owner_pod_uid="uid-driver",
+        spec=ReservationSpec(
+            {
+                "driver": Reservation("n0", Resources.from_quantities("1", "1Gi")),
+                "executor-1": Reservation("n1", Resources.from_quantities("2", "2Gi", "1")),
+            }
+        ),
+        status=ReservationStatus({"driver": "app-1-driver"}),
+    )
+
+
+def _sample_demand() -> Demand:
+    return Demand(
+        name="demand-app-2-driver",
+        namespace="ns",
+        spec=DemandSpec(
+            units=[
+                DemandUnit(
+                    resources=Resources.from_quantities("2", "4Gi"),
+                    count=3,
+                    pod_names_by_namespace={"ns": ["app-2-driver"]},
+                )
+            ],
+            instance_group="ig1",
+            is_long_lived=False,
+        ),
+        status=DemandStatus(phase="pending"),
+    )
+
+
+class TestCRDDefinitions:
+    def test_reservation_crd_shape(self):
+        crd = resource_reservation_crd()
+        assert crd["metadata"]["name"] == RESERVATION_CRD_NAME == RESERVATION_CRD
+        versions = {v["name"]: v for v in crd["spec"]["versions"]}
+        assert versions["v1beta2"]["storage"] and versions["v1beta2"]["served"]
+        assert versions["v1beta1"]["served"] and not versions["v1beta1"]["storage"]
+        # schemas are structural: spec.reservations typed through
+        schema = versions["v1beta2"]["schema"]["openAPIV3Schema"]
+        res_schema = schema["properties"]["spec"]["properties"]["reservations"]
+        assert res_schema["additionalProperties"]["required"] == ["node", "resources"]
+        assert crd["spec"]["conversion"]["strategy"] == "None"
+
+    def test_reservation_crd_webhook_strategy(self):
+        crd = resource_reservation_crd(webhook_url="https://svc:8484/convert", ca_bundle="Q0E=")
+        conv = crd["spec"]["conversion"]
+        assert conv["strategy"] == "Webhook"
+        assert conv["webhook"]["clientConfig"]["url"] == "https://svc:8484/convert"
+        assert conv["webhook"]["clientConfig"]["caBundle"] == "Q0E="
+
+    def test_demand_crd_shape(self):
+        crd = demand_crd()
+        assert crd["metadata"]["name"] == DEMAND_CRD_NAME == DEMAND_CRD
+        versions = {v["name"]: v for v in crd["spec"]["versions"]}
+        assert versions["v1alpha2"]["storage"]
+        assert versions["v1alpha2"]["subresources"] == {"status": {}}
+        phase = versions["v1alpha2"]["schema"]["openAPIV3Schema"]["properties"][
+            "status"
+        ]["properties"]["phase"]
+        assert "cannot-fulfill" in phase["enum"]
+
+    def test_wire_objects_validate_against_schemas(self):
+        """The codecs' output passes the CRDs' structural validation — what
+        a real apiserver would enforce on every write."""
+        rr_wire = rr_v1beta2_to_wire(_sample_rr())
+        assert validate_custom_resource(resource_reservation_crd(), rr_wire) == []
+        d_wire = demand_v1alpha2_to_wire(_sample_demand())
+        assert validate_custom_resource(demand_crd(), d_wire) == []
+
+    def test_schema_rejects_malformed(self):
+        rr_wire = rr_v1beta2_to_wire(_sample_rr())
+        del rr_wire["spec"]["reservations"]["driver"]["node"]
+        errors = validate_custom_resource(resource_reservation_crd(), rr_wire)
+        assert any("node" in e for e in errors)
+        d_wire = demand_v1alpha2_to_wire(_sample_demand())
+        d_wire["status"]["phase"] = "bogus"
+        errors = validate_custom_resource(demand_crd(), d_wire)
+        assert any("enum" in e for e in errors)
+
+    def test_fake_apiserver_enforces_schema(self):
+        """A CRD registered with the fake apiserver makes its schema
+        load-bearing: invalid CRs are rejected with 422 Invalid."""
+        import pytest
+
+        from spark_scheduler_tpu.kube.apiserver import FakeKubeAPIServer, ValidationError
+
+        api = FakeKubeAPIServer()
+        api.register_crd(resource_reservation_crd())
+        good = rr_v1beta2_to_wire(_sample_rr())
+        api.create("resourcereservations", good)
+        bad = rr_v1beta2_to_wire(_sample_rr())
+        bad["metadata"]["name"] = "app-bad"
+        del bad["spec"]["reservations"]["driver"]["node"]
+        with pytest.raises(ValidationError):
+            api.create("resourcereservations", bad)
+
+    def test_ensure_registers_full_definition(self):
+        from spark_scheduler_tpu.store.backend import InMemoryBackend
+        from spark_scheduler_tpu.store.crd import ensure_resource_reservations_crd
+
+        backend = InMemoryBackend()
+        ensure_resource_reservations_crd(
+            backend, webhook_url="https://127.0.0.1:8484/convert"
+        )
+        definition = backend.get_crd_definition(RESERVATION_CRD)
+        assert definition is not None
+        assert definition["spec"]["conversion"]["strategy"] == "Webhook"
+
+
+class TestDurableBackend:
+    def test_object_round_trip(self, tmp_path):
+        path = str(tmp_path / "state.jsonl")
+        backend = DurableBackend(path)
+        node = new_node("n0")
+        backend.add_node(node)
+        pods = static_allocation_spark_pods("app-rt", 1)
+        for p in pods:
+            backend.add_pod(p)
+        backend.create("resourcereservations", _sample_rr())
+        backend.register_crd(DEMAND_CRD)
+        backend.create("demands", _sample_demand())
+        backend.bind_pod(pods[0], "n0")
+        backend.close()
+
+        re_backend = DurableBackend(path)
+        assert re_backend.get_node("n0") == node
+        re_pod = re_backend.get("pods", pods[0].namespace, pods[0].name)
+        assert re_pod.node_name == "n0"  # bind survived
+        assert re_pod.annotations == pods[0].annotations
+        assert re_pod.uid == pods[0].uid
+        rr = re_backend.get("resourcereservations", "ns", "app-1")
+        assert rr.spec == _sample_rr().spec
+        assert rr.status == _sample_rr().status
+        assert rr.owner_pod_uid == "uid-driver"
+        d = re_backend.get("demands", "ns", "demand-app-2-driver")
+        assert d.spec == _sample_demand().spec
+        assert d.status.phase == "pending"
+        assert re_backend.crd_exists(DEMAND_CRD)
+        re_backend.close()
+
+    def test_delete_survives(self, tmp_path):
+        path = str(tmp_path / "state.jsonl")
+        backend = DurableBackend(path)
+        backend.add_node(new_node("n0"))
+        backend.add_node(new_node("n1"))
+        backend.delete("nodes", "", "n0")
+        backend.close()
+        re_backend = DurableBackend(path)
+        assert re_backend.get_node("n0") is None
+        assert re_backend.get_node("n1") is not None
+        re_backend.close()
+
+    def test_compaction_bounds_log(self, tmp_path):
+        path = str(tmp_path / "state.jsonl")
+        backend = DurableBackend(path)
+        node = backend.add_node(new_node("n0"))
+        for _ in range(50):
+            backend.update("nodes", node)
+        with open(path) as f:
+            assert len(f.readlines()) > 50
+        backend.compact()
+        with open(path) as f:
+            lines = f.readlines()
+        # registry (1 reservation CRD entry) + 1 node
+        assert len(lines) <= 3, lines
+        re_backend = DurableBackend(path)
+        assert re_backend.get_node("n0") is not None
+        re_backend.close()
+        backend.close()
+
+    def test_torn_tail_write_is_skipped(self, tmp_path):
+        path = str(tmp_path / "state.jsonl")
+        backend = DurableBackend(path, compact_on_load=False)
+        backend.add_node(new_node("n0"))
+        backend.close()
+        with open(path, "a") as f:
+            f.write('{"verb": "create", "kind": "nodes", "na')  # crash mid-write
+        re_backend = DurableBackend(path)
+        assert re_backend.get_node("n0") is not None
+        re_backend.close()
+
+
+class TestRestartRecovery:
+    def test_reservations_survive_restart(self, tmp_path):
+        """Kill the scheduler after gang admission; a new process over the
+        same log restores reservations, reconciles, and keeps scheduling —
+        the executor rebind proves restored state is live, not cosmetic."""
+        path = str(tmp_path / "state.jsonl")
+        backend = DurableBackend(path)
+        h = Harness(backend=backend)
+        node_names = [f"n{i}" for i in range(4)]
+        h.add_nodes(*(new_node(n) for n in node_names))
+        pods = static_allocation_spark_pods("app-surv", 2)
+        driver, execs = pods[0], pods[1:]
+        result = h.schedule(driver, node_names)
+        assert result.node_names, result
+        driver_node = result.node_names[0]
+        res0 = h.schedule(execs[0], node_names)
+        assert res0.node_names
+        h.app.stop()
+        backend.close()
+
+        # --- process death; new process over the same log ---
+        backend2 = DurableBackend(path)
+        h2 = Harness(backend=backend2)
+        # the restart is a leader change: reconcile CRD state with pods
+        h2.app.reconciler.sync_resource_reservations_and_demands()
+        rrs = backend2.list("resourcereservations")
+        assert len(rrs) == 1
+        rr = rrs[0]
+        assert rr.name == "app-surv"
+        assert rr.status.pods["driver"] == driver.name
+        # the second executor binds onto its restored reservation
+        res1 = h2.schedule(execs[1], node_names)
+        assert res1.node_names, res1
+        reserved_nodes = {r.node for n, r in rr.spec.reservations.items() if n != "driver"}
+        assert res1.node_names[0] in reserved_nodes
+        assert backend2.get("pods", driver.namespace, driver.name).node_name == driver_node
+        h2.app.stop()
+        backend2.close()

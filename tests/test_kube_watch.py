@@ -219,6 +219,40 @@ class TestResume:
         finally:
             small.stop()
 
+    def test_mid_stream_pruning_forces_relist(self, apiserver):
+        """Events pruned while a watcher is connected must NOT be silently
+        skipped: the server errors the watch (410) and the reflector relists
+        and converges (real-apiserver watch-expiry behavior)."""
+        small = FakeKubeAPIServer(history_limit=3)
+        small.start()
+        try:
+            small.create("nodes", k8s_node("seed"))
+            backend = InMemoryBackend()
+            reflector = Reflector(
+                small.base_url,
+                "/api/v1/nodes",
+                node_from_k8s,
+                BackendSyncTarget(backend, "nodes"),
+                watch_timeout_s=5.0,
+            )
+            reflector.start()
+            try:
+                assert reflector.wait_synced(timeout=5.0)
+                # One atomic burst larger than the history window: the
+                # connected watcher cannot interleave, so its next scan sees
+                # pruned history and must take the 410 path.
+                small.create_many(
+                    "nodes", [k8s_node(f"burst{i}") for i in range(6)]
+                )
+                assert wait_until(
+                    lambda: len(backend.list_nodes()) == 7, timeout=5.0
+                )
+                assert reflector.relist_count >= 2
+            finally:
+                reflector.stop()
+        finally:
+            small.stop()
+
     def test_gone_triggers_relist_and_converges(self, apiserver):
         small = FakeKubeAPIServer(history_limit=3)
         small.start()
