@@ -1,28 +1,33 @@
-"""North-star benchmark (BASELINE.md): gang-schedule 1k concurrent Spark apps
-over a 10k-node cluster; target p50 placement latency < 50 ms on a single
-TPU chip.
+"""Benchmark suite — all five BASELINE.md configs + the HTTP serving path.
 
-Model: the pending queue drains in admission windows of 100 apps (one
-`batched_fifo_pack` call per window; availability threads between windows as
-device-resident tensors, so consecutive windows form one dependent device
-chain with no host round-trips — exactly how the serving layer drives the
-solver). A window's decisions land when it completes, so the scheduler's
-steady-state placement latency under 1k-concurrent load is the per-window
-service time.
-
-Measurement: this machine reaches the TPU through a tunnel whose RPC
-round-trip (~70 ms) would swamp a single-call timing, and
-`jax.block_until_ready` does not reliably wait on the experimental backend —
-only a host transfer does. So the service time is measured as the MARGINAL
-cost of extending a dependent window chain: (T(chain of 12) - T(chain of 2))
-/ 10, each chain forced by one host transfer of its final [B] bool output.
-The fixed RPC/dispatch overhead cancels; what remains is the true per-window
-device time, which is what pipelined serving pays. p50 is taken over
-repeated marginal measurements.
-
-Prints ONE JSON line:
+Prints ONE JSON line per benchmark (6 lines). The north-star config (#5,
+10k nodes x 1k apps) prints LAST and is the headline metric:
   {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
-`vs_baseline` = target_ms / measured_ms (>1 means beating the 50 ms target).
+`vs_baseline` = 50ms-target / measured (>1 beats the target).
+
+Configs (BASELINE.md "Benchmark configs to reproduce"):
+  1. 1 driver + 8 executors on 10 nodes, tightly-pack
+  2. 100 FIFO drivers x 8 executors, 500 nodes, distribute-evenly,
+     skippable=False — strict-FIFO blocking EXERCISED
+  3. dynamic-allocation min=2/max=32, 200 apps, 1k nodes
+  4. 5 instance-groups, heterogeneous node shapes, 5k nodes
+     (grouped_fifo_pack, vmapped over groups)
+  5. 10k-node x 1k-app batched admission (north star)
+plus `serving_http`: wall-clock p50 of POST /predicates through the real
+HTTP server + extender + batched solver + write-back (the served path,
+cmd/endpoints.go:28-42 equivalent).
+
+Device-timing method: this machine reaches the TPU through a tunnel whose
+RPC round-trip (~70 ms) would swamp a single-call timing, and
+`jax.block_until_ready` does not reliably wait on the experimental
+backend — only a host transfer does. So kernel service time is measured as
+the MARGINAL cost of extending a dependent window chain:
+(T(chain of K_long) - T(chain of K_short)) / (K_long - K_short), each chain
+forced by one host transfer of its final output. Fixed RPC/dispatch
+overhead cancels; what remains is the true per-window device time — what
+pipelined serving pays. p50 over repeated marginal measurements. The
+admission kernels are data-independent (same XLA program whether apps
+admit or block), so recycling windows through the chain is timing-faithful.
 """
 
 from __future__ import annotations
@@ -33,21 +38,19 @@ import time
 
 import numpy as np
 
+TARGET_MS = 50.0
 
-def main() -> None:
+
+def _make_cluster(rng, n_nodes, num_zones, *, cpu=(8, 96), mem=(16, 256), gpu=(0, 2)):
     import jax
 
     from spark_scheduler_tpu.models.cluster import ClusterTensors, INT32_INF
-    from spark_scheduler_tpu.ops.batched import batched_fifo_pack, make_app_batch
 
-    n_nodes, n_apps, window, emax, num_zones = 10_000, 1_000, 100, 8, 4
-    k_short, k_long, repeats = 2, 12, 5
-    rng = np.random.default_rng(0)
-
-    avail = rng.integers(8, 96, size=(n_nodes, 3)).astype(np.int32)
-    avail[:, 1] = rng.integers(16, 256, size=n_nodes)
-    avail[:, 2] = rng.integers(0, 2, size=n_nodes)
-    cluster = jax.device_put(
+    avail = np.empty((n_nodes, 3), np.int32)
+    avail[:, 0] = rng.integers(*cpu, size=n_nodes)
+    avail[:, 1] = rng.integers(*mem, size=n_nodes)
+    avail[:, 2] = rng.integers(*gpu, size=n_nodes)
+    return jax.device_put(
         ClusterTensors(
             available=avail,
             schedulable=avail.copy(),
@@ -60,71 +63,297 @@ def main() -> None:
             valid=np.ones(n_nodes, bool),
         )
     )
+
+
+def _make_batches(rng, n_apps, window, emax, *, exec_count=None, skippable=True):
+    import jax
+
+    from spark_scheduler_tpu.ops.batched import make_app_batch
+
     driver = rng.integers(1, 4, size=(n_apps, 3)).astype(np.int32)
     driver[:, 2] = 0
     execs = rng.integers(1, 6, size=(n_apps, 3)).astype(np.int32)
     execs[:, 2] = 0
-    counts = rng.integers(1, emax + 1, size=n_apps).astype(np.int32)
-    batches = [
+    if exec_count is None:
+        counts = rng.integers(1, emax + 1, size=n_apps).astype(np.int32)
+    else:
+        counts = np.full(n_apps, exec_count, np.int32)
+    return [
         jax.device_put(
             make_app_batch(
                 driver[lo : lo + window],
                 execs[lo : lo + window],
                 counts[lo : lo + window],
-                skippable=np.ones(window, bool),
+                skippable=np.full(min(window, n_apps - lo), skippable, bool),
             )
         )
         for lo in range(0, n_apps, window)
     ]
 
-    def chain(k):
-        """Drain the first k windows as one dependent device chain; force
-        completion with a single host transfer. Returns total admitted."""
-        c = cluster
-        admitted = []
-        for i in range(k):
-            out = batched_fifo_pack(
-                c, batches[i % len(batches)], fill="tightly-pack",
-                emax=emax, num_zones=num_zones,
-            )
-            c = dataclasses.replace(c, available=out.available_after)
-            admitted.append(out.admitted)
-        return np.asarray(jax.numpy.concatenate(admitted))  # forces the chain
 
-    full = chain(len(batches))  # compile + warm; also the correctness run
-    n_admitted = int(full.sum())
+def _measure_marginal_ms(chain, n_batches, k_short=2, repeats=5):
+    """p50 of the marginal per-window time of a dependent device chain.
+
+    The chain-length spread is ADAPTIVE: tunnel RPC jitter is tens of ms
+    per call, so the long chain is sized until its delta over the short
+    chain dominates jitter (>= ~200 ms of device work), else fast windows
+    (a few ms) drown in noise and the marginal can even go negative."""
+    chain(max(12, n_batches))  # compile + warm (also the correctness run)
 
     def timed(k):
         t0 = time.perf_counter()
         chain(k)
         return time.perf_counter() - t0
 
-    timed(k_short), timed(k_long)  # warm both chain lengths
+    # Crude per-window estimate to size the spread.
+    t2 = min(timed(k_short) for _ in range(2))
+    k_long = k_short + 10
+    while True:
+        t_long = min(timed(k_long) for _ in range(2))
+        if t_long - t2 >= 0.2 or k_long >= 512:
+            break
+        k_long = min(512, k_long * 4)
+
     marginals_ms = []
     for _ in range(repeats):
         t_short = min(timed(k_short) for _ in range(2))
         t_long = min(timed(k_long) for _ in range(2))
         marginals_ms.append((t_long - t_short) * 1e3 / (k_long - k_short))
+    return float(np.percentile(marginals_ms, 50))
 
-    p50_ms = float(np.percentile(marginals_ms, 50))
-    target_ms = 50.0
+
+def _emit(metric, window_ms, window_apps, extra=None):
+    import jax
+
+    per_app = window_ms / window_apps
     print(
         json.dumps(
             {
-                "metric": "gang_placement_p50_window_service_ms_10k_nodes_1k_apps",
-                "value": round(p50_ms, 3),
+                "metric": metric,
+                "value": round(window_ms, 3),
                 "unit": "ms",
-                "vs_baseline": round(target_ms / p50_ms, 2),
+                "vs_baseline": round(TARGET_MS / window_ms, 2),
                 "detail": {
-                    "window_apps": window,
-                    "per_app_ms": round(p50_ms / window, 4),
-                    "decisions_per_s": round(window / (p50_ms / 1e3), 1),
-                    "admitted_of_1k": n_admitted,
+                    "window_apps": window_apps,
+                    "per_app_ms": round(per_app, 4),
+                    "decisions_per_s": round(window_apps / (window_ms / 1e3), 1),
                     "device": str(jax.devices()[0]),
+                    **(extra or {}),
                 },
             }
-        )
+        ),
+        flush=True,
     )
+
+
+def _windowed_chain(cluster, batches, fill, emax, num_zones):
+    import jax
+
+    from spark_scheduler_tpu.ops.batched import batched_fifo_pack
+
+    def chain(k):
+        c = cluster
+        admitted = []
+        for i in range(k):
+            out = batched_fifo_pack(
+                c, batches[i % len(batches)], fill=fill, emax=emax,
+                num_zones=num_zones,
+            )
+            c = dataclasses.replace(c, available=out.available_after)
+            admitted.append(out.admitted)
+        return np.asarray(jax.numpy.concatenate(admitted))  # forces the chain
+
+    return chain
+
+
+def bench_config1(rng):
+    """#1: 1 driver + 8 executors on 10 nodes, tightly-pack — the
+    examples/extender.yml smoke shape, timed as a B=1 admission window."""
+    cluster = _make_cluster(rng, 10, 4)
+    batches = _make_batches(rng, 12, 1, 8, exec_count=8)
+    chain = _windowed_chain(cluster, batches, "tightly-pack", 8, 4)
+    ms = _measure_marginal_ms(chain, len(batches))
+    _emit("config1_small_gang_service_ms_10_nodes", ms, 1, {"nodes": 10})
+
+
+def bench_config2(rng):
+    """#2: 100 FIFO drivers x 8 executors, 500 nodes, distribute-evenly,
+    skippable=False — strict-FIFO blocking engaged (resource.go:241-249)."""
+    cluster = _make_cluster(rng, 500, 4)
+    batches = _make_batches(rng, 1200, 100, 8, exec_count=8, skippable=False)
+    chain = _windowed_chain(cluster, batches, "distribute-evenly", 8, 4)
+    ms = _measure_marginal_ms(chain, len(batches))
+    _emit(
+        "config2_fifo100_window_service_ms_500_nodes",
+        ms,
+        100,
+        {"nodes": 500, "strict_fifo": True, "fill": "distribute-evenly"},
+    )
+
+
+def bench_config3(rng):
+    """#3: dynamic allocation min=2/max=32, 200 apps, 1k nodes. Gang
+    admission reserves min executors; the reservation shells are sized max,
+    so the kernel runs with emax=32 slot padding (sparkpods.go:110-138)."""
+    cluster = _make_cluster(rng, 1_000, 4)
+    batches = _make_batches(rng, 2_400, 200, 32, exec_count=2)
+    chain = _windowed_chain(cluster, batches, "tightly-pack", 32, 4)
+    ms = _measure_marginal_ms(chain, len(batches))
+    _emit(
+        "config3_dynalloc_window_service_ms_1k_nodes",
+        ms,
+        200,
+        {"nodes": 1000, "min_executors": 2, "max_executors": 32},
+    )
+
+
+def bench_config4(rng):
+    """#4: 5 instance-groups, heterogeneous node shapes, 5k nodes — one
+    vmapped grouped_fifo_pack over stacked per-group subproblems
+    (failover.go:276-313 grouping, SURVEY.md §5.7)."""
+    import jax
+
+    from spark_scheduler_tpu.parallel.mesh import make_solver_mesh
+    from spark_scheduler_tpu.parallel.solve import grouped_fifo_pack, stack_groups
+
+    shapes = [  # (cpu-range, mem-range, gpu-range) per group — heterogeneous
+        ((4, 16), (8, 32), (0, 1)),
+        ((8, 32), (32, 128), (0, 1)),
+        ((16, 96), (64, 512), (0, 2)),
+        ((8, 64), (16, 128), (1, 5)),
+        ((32, 128), (128, 1024), (0, 1)),
+    ]
+    clusters, app_batches = [], []
+    for cpu, mem, gpu in shapes:
+        clusters.append(
+            jax.device_get(_make_cluster(rng, 1_000, 4, cpu=cpu, mem=mem, gpu=gpu))
+        )
+        app_batches.append(_make_batches(rng, 40, 40, 8)[0])
+    stacked_cluster, stacked_apps = stack_groups(clusters, app_batches)
+    stacked_cluster = jax.device_put(stacked_cluster)
+    stacked_apps = jax.device_put(stacked_apps)
+    mesh = make_solver_mesh(n_groups=1)  # single chip: vmap carries the groups
+
+    def chain(k):
+        c = stacked_cluster
+        admitted = []
+        for _ in range(k):
+            out = grouped_fifo_pack(
+                mesh, c, stacked_apps, fill="tightly-pack", emax=8, num_zones=4
+            )
+            c = dataclasses.replace(c, available=out.available_after)
+            admitted.append(out.admitted)
+        return np.asarray(jax.numpy.concatenate(admitted))
+
+    ms = _measure_marginal_ms(chain, 1)
+    _emit(
+        "config4_5group_heterogeneous_window_service_ms_5k_nodes",
+        ms,
+        200,
+        {"nodes": 5000, "groups": 5, "apps_per_group_window": 40},
+    )
+
+
+def bench_config5(rng):
+    """#5 (north star): 10k nodes x 1k apps, windows of 100 —
+    the steady-state placement latency under 1k-concurrent load is the
+    per-window service time (see module docstring)."""
+    n_apps, window, emax = 1_000, 100, 8
+    cluster = _make_cluster(rng, 10_000, 4)
+    batches = _make_batches(rng, n_apps, window, emax)
+    chain = _windowed_chain(cluster, batches, "tightly-pack", emax, 4)
+    full = chain(len(batches))
+    n_admitted = int(full.sum())
+    ms = _measure_marginal_ms(chain, len(batches))
+    _emit(
+        "gang_placement_p50_window_service_ms_10k_nodes_1k_apps",
+        ms,
+        window,
+        {"nodes": 10_000, "admitted_of_1k": n_admitted},
+    )
+
+
+def bench_serving_http(rng):
+    """Wall-clock p50 of the SERVED path: POST /predicates -> extender ->
+    batched solver -> reservation write-back, over a 500-node cluster.
+    Includes host tensor deltas, device dispatch, and (on tunneled TPU)
+    the relay RPC — the end-to-end number a kube-scheduler client sees."""
+    import http.client
+
+    from spark_scheduler_tpu.server.app import build_scheduler_app
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+    from spark_scheduler_tpu.server.kube_io import node_to_k8s, pod_to_k8s
+    from spark_scheduler_tpu.store.backend import InMemoryBackend
+    from spark_scheduler_tpu.testing.harness import (
+        INSTANCE_GROUP_LABEL,
+        new_node,
+        static_allocation_spark_pods,
+    )
+
+    backend = InMemoryBackend()
+    node_names = []
+    for i in range(500):
+        n = new_node(f"bench-n{i}", zone=f"zone{i % 4}")
+        backend.add_node(n)
+        node_names.append(n.name)
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=True, sync_writes=True, instance_group_label=INSTANCE_GROUP_LABEL
+        ),
+    )
+    server = SchedulerHTTPServer(app, host="127.0.0.1", port=0)
+    server.start()
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
+    latencies_ms = []
+    n_requests, warmup = 40, 6
+    try:
+        for i in range(n_requests):
+            pods = static_allocation_spark_pods(f"bench-app-{i}", 8)
+            driver = pods[0]
+            backend.add_pod(driver)
+            body = json.dumps(
+                {"Pod": pod_to_k8s(driver), "NodeNames": node_names}
+            ).encode()
+            t0 = time.perf_counter()
+            conn.request("POST", "/predicates", body=body)
+            resp = json.loads(conn.getresponse().read())
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            if not resp.get("NodeNames"):
+                raise RuntimeError(f"bench request {i} failed: {resp}")
+            if i >= warmup:
+                latencies_ms.append(dt_ms)
+            backend.bind_pod(driver, resp["NodeNames"][0])
+    finally:
+        conn.close()
+        server.stop()
+    p50 = float(np.percentile(latencies_ms, 50))
+    _emit(
+        "serving_http_predicate_p50_ms_500_nodes",
+        p50,
+        1,
+        {
+            "nodes": 500,
+            "requests": len(latencies_ms),
+            "p95_ms": round(float(np.percentile(latencies_ms, 95)), 3),
+            "path": "HTTP /predicates -> batched admission -> write-back",
+            # One dispatch + one result fetch per request: on a tunneled
+            # device the floor is ~2 relay RTTs regardless of solve time
+            # (the kernel-side service time is the configN lines above).
+            "device_round_trips_per_request": 2,
+        },
+    )
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    bench_config1(rng)
+    bench_config2(rng)
+    bench_config3(rng)
+    bench_config4(rng)
+    bench_serving_http(rng)
+    bench_config5(rng)  # north star LAST — the headline line
 
 
 if __name__ == "__main__":

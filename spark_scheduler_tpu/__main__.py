@@ -126,17 +126,24 @@ def main(argv=None) -> int:
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)
     try:
-        server.start()
         if config.durable_store_path:
             # Restored WAL state must be reconciled against CURRENT cluster
-            # state: wait for watch-ingestion cache sync first so pods
-            # deleted during downtime don't spawn phantom reservations
-            # (WaitForCacheSync precedes failover recovery,
-            # cmd/server.go:140-147 then failover.go:35-72 — the restart IS
-            # a leader change).
+            # state BEFORE any /predicates request is served: wait for
+            # watch-ingestion cache sync (blocking until it succeeds — a
+            # half-populated cache would make reconciliation delete
+            # reservations for pods that merely haven't listed yet), then
+            # reconcile, then open the server (WaitForCacheSync precedes
+            # failover recovery: cmd/server.go:140-147 then
+            # failover.go:35-72 — the restart IS a leader change).
+            app.start_background()
             if app.ingestion is not None:
-                app.ingestion.wait_synced(timeout=300.0)
+                while not app.ingestion.wait_synced(timeout=30.0):
+                    print(
+                        "waiting for apiserver cache sync before reconcile...",
+                        file=sys.stderr,
+                    )
             app.reconciler.sync_resource_reservations_and_demands()
+        server.start()
         server.join()
     except KeyboardInterrupt:
         server.stop()

@@ -30,7 +30,7 @@ from spark_scheduler_tpu.models.kube import Node
 from spark_scheduler_tpu.models.resources import INT32_INF, NUM_DIMS, Resources
 from spark_scheduler_tpu.ops import BINPACK_FUNCTIONS
 from spark_scheduler_tpu.ops.batched import batched_fifo_pack, make_app_batch
-from spark_scheduler_tpu.ops.efficiency import avg_packing_efficiency
+from spark_scheduler_tpu.ops.efficiency import avg_packing_efficiency_np
 
 # Strategies expressible as the batched kernel's executor fill. The single-AZ
 # wrappers pack per zone with efficiency-scored zone selection, which the
@@ -227,16 +227,25 @@ class PlacementSolver:
             emax=emax,
             num_zones=self._num_zones_bucket(),
         )
-        eff = avg_packing_efficiency(
-            tensors,
-            packing.driver_node,
+        # ONE device->host transfer for the whole decision: on a tunneled
+        # TPU each scalar pull is a full RPC round-trip, so per-field
+        # int()/float() would cost ~8 RTTs per request (SURVEY.md §7
+        # latency budget). Efficiency reporting runs as pure numpy on the
+        # host-resident cluster arrays — zero extra dispatches.
+        import jax
+
+        packing = jax.device_get(packing)
+        eff = avg_packing_efficiency_np(
+            np.asarray(tensors.schedulable),
+            np.asarray(tensors.available),
+            int(packing.driver_node),
             packing.executor_nodes,
-            jnp.asarray(driver_resources.as_array()),
-            jnp.asarray(executor_resources.as_array()),
+            driver_resources.as_array(),
+            executor_resources.as_array(),
         )
         has_cap = bool(packing.has_capacity)
         driver_idx = int(packing.driver_node)
-        exec_idx = [int(x) for x in np.asarray(packing.executor_nodes) if int(x) >= 0]
+        exec_idx = [int(x) for x in packing.executor_nodes if int(x) >= 0]
         return HostPacking(
             driver_node=self.registry.name_of(driver_idx) if driver_idx >= 0 else None,
             executor_nodes=[self.registry.name_of(i) for i in exec_idx],
@@ -298,35 +307,41 @@ class PlacementSolver:
             num_zones=self._num_zones_bucket(),
         )
 
-        drivers = np.asarray(out.driver_node)
-        execs = np.asarray(out.executor_nodes)
-        admitted = np.asarray(out.admitted)
-        packed = np.asarray(out.packed)
+        # ONE device->host transfer for the decisions (tunneled-TPU RTTs:
+        # see pack()); available_after is pulled only on the efficiency
+        # branch below.
+        import jax
+
+        drivers, execs, admitted, packed = jax.device_get(
+            (out.driver_node, out.executor_nodes, out.admitted, out.packed)
+        )
 
         # Efficiency of the final row against the availability it packed
-        # into: reconstruct by adding the row's own usage back. Only computed
-        # on admission — the serving path reports efficiency solely for
-        # successful packs (resource.go:347-350), so rejections skip the
-        # device launch.
+        # into: reconstructed entirely on the host by subtracting the
+        # EARLIER admitted rows' placements from the pre-solve availability
+        # (all placements are already transferred) — no second device
+        # launch, no available_after pull. Only computed on admission: the
+        # serving path reports efficiency solely for successful packs
+        # (resource.go:347-350).
         last = b - 1
         eff = None
         if admitted[last]:
-            avail_before = np.array(out.available_after)
-            dreq = rows[last][0].as_array()
-            ereq = rows[last][1].as_array()
-            if drivers[last] >= 0:
-                avail_before[drivers[last]] += dreq
-            for e in execs[last]:
-                if e >= 0:
-                    avail_before[e] += ereq
-            import dataclasses as _dc
-
-            eff = avg_packing_efficiency(
-                _dc.replace(tensors, available=jnp.asarray(avail_before)),
-                jnp.int32(int(drivers[last])),
-                jnp.asarray(execs[last]),
-                jnp.asarray(dreq),
-                jnp.asarray(ereq),
+            avail_before = np.array(np.asarray(tensors.available), dtype=np.int64)
+            for i in range(last):
+                if not admitted[i]:
+                    continue
+                if drivers[i] >= 0:
+                    avail_before[drivers[i]] -= rows[i][0].as_array()
+                for e in execs[i]:
+                    if e >= 0:
+                        avail_before[e] -= rows[i][1].as_array()
+            eff = avg_packing_efficiency_np(
+                np.asarray(tensors.schedulable),
+                avail_before,
+                int(drivers[last]),
+                execs[last],
+                rows[last][0].as_array(),
+                rows[last][1].as_array(),
             )
 
         decisions = []

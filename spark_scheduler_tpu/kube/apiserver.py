@@ -386,10 +386,16 @@ class FakeKubeAPIServer:
                     expired_mid_stream = True
                 else:
                     for rv, resource, etype, obj in self._history:
-                        if rv <= last_sent or resource != col.resource:
+                        if rv <= last_sent:
                             continue
-                        if ns is not None and _obj_key(obj)[0] != ns:
-                            # Filtered events still advance the cursor.
+                        if resource != col.resource or (
+                            ns is not None and _obj_key(obj)[0] != ns
+                        ):
+                            # Filtered/foreign events still advance the
+                            # cursor — otherwise a watcher of a QUIET
+                            # collection trips the pruning check as soon as
+                            # a busy collection slides the shared history
+                            # window past it.
                             last_sent = rv
                             continue
                         batch.append((etype, obj))

@@ -50,6 +50,57 @@ def new_reservation_tensor(
     return out
 
 
+def avg_packing_efficiency_np(
+    schedulable,
+    available,
+    driver_node: int,
+    executor_nodes,
+    driver_req,
+    exec_req,
+) -> AvgEfficiency:
+    """Pure-numpy twin of `avg_packing_efficiency` for HOST-side reporting
+    (serving path, resource.go:347-350). The jnp version runs ~30 eager
+    device dispatches when called outside jit — on a tunneled TPU that is
+    ~30 RPC round-trips per request. Parity with the jnp kernel is pinned
+    by tests/test_packing_golden.py::test_efficiency_np_parity."""
+    import numpy as np
+
+    schedulable = np.asarray(schedulable)
+    new_res = np.zeros_like(schedulable)
+    dreq = np.asarray(driver_req)
+    ereq = np.asarray(exec_req)
+    if driver_node >= 0:
+        new_res[driver_node] += dreq
+    executor_nodes = np.asarray(executor_nodes)
+    for e in executor_nodes:
+        if e >= 0:
+            new_res[e] += ereq
+    reserved_total = (schedulable - np.asarray(available)) + new_res
+    denom = np.where(schedulable == 0, 1, schedulable).astype(np.float32)
+    eff = reserved_total.astype(np.float32) / denom
+    gpu_node = schedulable[:, GPU_DIM] != 0
+    eff_gpu = np.where(gpu_node, eff[:, GPU_DIM], 0.0)
+    node_max = np.maximum(eff_gpu, np.maximum(eff[:, CPU_DIM], eff[:, MEM_DIM]))
+
+    entries = np.concatenate([[driver_node], executor_nodes])
+    valid = entries >= 0
+    if not valid.any():
+        return AvgEfficiency(cpu=0.0, memory=0.0, gpu=0.0, max=0.0)
+    idx = np.clip(entries, 0, None)
+    cnt = float(valid.sum())
+    cpu_mean = float(np.where(valid, eff[idx, CPU_DIM], 0.0).sum() / cnt)
+    mem_mean = float(np.where(valid, eff[idx, MEM_DIM], 0.0).sum() / cnt)
+    gpu_valid = valid & gpu_node[idx]
+    gpu_cnt = int(gpu_valid.sum())
+    gpu_mean = (
+        1.0  # no GPU nodes among entries => 1 (efficiency.go:139-144)
+        if gpu_cnt == 0
+        else float(np.where(gpu_valid, eff_gpu[idx], 0.0).sum() / gpu_cnt)
+    )
+    max_mean = float(np.where(valid, node_max[idx], 0.0).sum() / cnt)
+    return AvgEfficiency(cpu=cpu_mean, memory=mem_mean, gpu=gpu_mean, max=max_mean)
+
+
 def avg_packing_efficiency(
     cluster: ClusterTensors,
     driver_node: jnp.ndarray,

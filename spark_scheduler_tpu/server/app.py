@@ -49,11 +49,17 @@ class SchedulerApp:
     unschedulable_marker: UnschedulablePodMarker
     demand_crd_watcher: LazyDemandCRDWatcher
     ingestion: object | None = None  # KubeIngestion when kube_api_url is set
+    _background_started: bool = False
 
     def start_background(self) -> None:
         """Async write-back workers + background loops (cmd/server.go:239-247).
         Ingestion reflectors start first so WaitForCacheSync-style readiness
-        can observe them (cmd/server.go:111-147)."""
+        can observe them (cmd/server.go:111-147). Idempotent: the CLI calls
+        it before reconciliation and SchedulerHTTPServer.start() calls it
+        again."""
+        if self._background_started:
+            return
+        self._background_started = True
         if self.ingestion is not None:
             self.ingestion.start()
         self.rr_cache.start()

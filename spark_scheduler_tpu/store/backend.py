@@ -81,6 +81,15 @@ class InMemoryBackend(ClusterBackend):
 
     # -- CRDs ---------------------------------------------------------------
 
+    def _on_committed(self, kind: str, verb: str, obj: Any) -> None:
+        """Hook invoked INSIDE the mutation lock, after the store changed
+        but before the lock releases. DurableBackend appends its WAL record
+        here so the log order cannot diverge from commit order under
+        concurrent writers (request threads + async write-back workers)."""
+
+    def _on_crd_committed(self, verb: str, name: str, definition) -> None:
+        """CRD-registry twin of _on_committed (also inside the lock)."""
+
     def register_crd(self, name: str, definition: Optional[dict] = None) -> None:
         """Create-or-upgrade: re-registering an existing CRD replaces its
         definition (the reference's EnsureResourceReservationsCRD update
@@ -89,6 +98,7 @@ class InMemoryBackend(ClusterBackend):
             self._crds.add(name)
             if definition is not None:
                 self._crd_definitions[name] = definition
+            self._on_crd_committed("register_crd", name, definition)
 
     def crd_exists(self, name: str) -> bool:
         with self._lock:
@@ -103,6 +113,7 @@ class InMemoryBackend(ClusterBackend):
         with self._lock:
             self._crds.discard(name)
             self._crd_definitions.pop(name, None)
+            self._on_crd_committed("unregister_crd", name, None)
 
     # -- event subscription -------------------------------------------------
 
@@ -154,6 +165,7 @@ class InMemoryBackend(ClusterBackend):
             if hasattr(obj, "resource_version"):
                 obj.resource_version = self._next_rv()
             self._objects[kind][k] = obj
+            self._on_committed(kind, "create", obj)
         self._fire(kind, "add", obj)
         return obj
 
@@ -172,6 +184,7 @@ class InMemoryBackend(ClusterBackend):
                 obj.resource_version = self._next_rv()
             old = cur
             self._objects[kind][k] = obj
+            self._on_committed(kind, "update", obj)
         self._fire(kind, "update", old, obj)
         return obj
 
@@ -181,6 +194,7 @@ class InMemoryBackend(ClusterBackend):
             cur = self._objects[kind].pop((namespace, name), None)
             if cur is None:
                 raise NotFoundError(f"{kind} {(namespace, name)}")
+            self._on_committed(kind, "delete", (namespace, name))
         self._fire(kind, "delete", cur)
 
     def get(self, kind: str, namespace: str, name: str) -> Optional[Any]:
@@ -237,5 +251,6 @@ class InMemoryBackend(ClusterBackend):
             old = Pod(**{f.name: getattr(cur, f.name) for f in cur.__dataclass_fields__.values()})  # type: ignore[attr-defined]
             cur.node_name = node_name
             cur.phase = phase
+            self._on_committed("pods", "update", cur)
         self._fire("pods", "update", old, cur)
         return cur

@@ -184,7 +184,8 @@ class DurableBackend(InMemoryBackend):
         """Rewrite the log to one create per live object + the CRD registry
         (atomic via rename)."""
         tmp = self.path + ".tmp"
-        with self._log_lock, self._lock:
+        # Same lock order as the mutation path (backend lock, then log lock).
+        with self._lock, self._log_lock:
             with open(tmp, "w", encoding="utf-8") as f:
                 for name in sorted(self._crds):
                     f.write(
@@ -229,71 +230,35 @@ class DurableBackend(InMemoryBackend):
                 self._file = None
 
     # -- logged mutations ----------------------------------------------------
+    # WAL records are appended from _on_committed / _on_crd_committed, which
+    # the base backend invokes INSIDE its mutation lock: log order therefore
+    # equals commit order even with concurrent writers (request threads +
+    # async write-back workers). Lock order is backend._lock -> _log_lock
+    # everywhere, including compact().
 
-    def create(self, kind: str, obj: Any):
-        created = super().create(kind, obj)
-        if kind in _CODECS:
-            encode = _CODECS[kind][0]
-            ns = getattr(created, "namespace", "")
-            self._append(
-                {
-                    "verb": "create",
-                    "kind": kind,
-                    "ns": ns,
-                    "name": created.name,
-                    "object": encode(created),
-                }
-            )
-        return created
-
-    def update(self, kind: str, obj: Any):
-        updated = super().update(kind, obj)
-        if kind in _CODECS:
-            encode = _CODECS[kind][0]
-            ns = getattr(updated, "namespace", "")
-            self._append(
-                {
-                    "verb": "update",
-                    "kind": kind,
-                    "ns": ns,
-                    "name": updated.name,
-                    "object": encode(updated),
-                }
-            )
-        return updated
-
-    def delete(self, kind: str, namespace: str, name: str) -> None:
-        super().delete(kind, namespace, name)
-        if kind in _CODECS:
-            self._append(
-                {"verb": "delete", "kind": kind, "ns": namespace, "name": name}
-            )
-
-    def bind_pod(self, pod: Pod, node_name: str, phase: str = "Running"):
-        bound = super().bind_pod(pod, node_name, phase)
+    def _on_committed(self, kind: str, verb: str, obj: Any) -> None:
+        if kind not in _CODECS:
+            return
+        if verb == "delete":
+            ns, name = obj
+            self._append({"verb": "delete", "kind": kind, "ns": ns, "name": name})
+            return
+        encode = _CODECS[kind][0]
         self._append(
             {
-                "verb": "update",
-                "kind": "pods",
-                "ns": bound.namespace,
-                "name": bound.name,
-                "object": _pod_to_record(bound),
+                "verb": verb,
+                "kind": kind,
+                "ns": getattr(obj, "namespace", ""),
+                "name": obj.name,
+                "object": encode(obj),
             }
         )
-        return bound
 
-    # -- CRD registry (persisted) --------------------------------------------
-
-    def register_crd(self, name: str, definition: Optional[dict] = None) -> None:
-        super().register_crd(name, definition)
+    def _on_crd_committed(self, verb: str, name: str, definition) -> None:
         self._append(
             {
-                "verb": "register_crd",
+                "verb": verb,
                 "name": name,
                 **({"definition": definition} if definition is not None else {}),
             }
         )
-
-    def unregister_crd(self, name: str) -> None:
-        super().unregister_crd(name)
-        self._append({"verb": "unregister_crd", "name": name})

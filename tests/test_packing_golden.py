@@ -252,3 +252,35 @@ def test_priority_order_matches_oracle():
             label_rank=np.asarray(c.label_rank_executor),
         )
         assert got == want
+
+
+def test_efficiency_np_parity():
+    """Host-side numpy efficiency (serving-path reporting) must match the
+    jnp kernel (used inside the single-AZ packers) bit-for-float."""
+    from spark_scheduler_tpu.ops.efficiency import (
+        avg_packing_efficiency,
+        avg_packing_efficiency_np,
+    )
+
+    rng = np.random.default_rng(7)
+    for trial in range(20):
+        c = random_cluster(rng, 40)
+        driver_node = int(rng.integers(-1, 40))
+        executor_nodes = rng.integers(-1, 40, size=8).astype(np.int32)
+        driver_req = rng.integers(0, 4, size=3).astype(np.int32)
+        exec_req = rng.integers(0, 4, size=3).astype(np.int32)
+        jnp_eff = avg_packing_efficiency(
+            c,
+            jnp.int32(driver_node),
+            jnp.asarray(executor_nodes),
+            jnp.asarray(driver_req),
+            jnp.asarray(exec_req),
+        )
+        np_eff = avg_packing_efficiency_np(
+            c.schedulable, c.available, driver_node, executor_nodes,
+            driver_req, exec_req,
+        )
+        for field in ("cpu", "memory", "gpu", "max"):
+            assert float(getattr(jnp_eff, field)) == pytest.approx(
+                float(getattr(np_eff, field)), abs=1e-5
+            ), (trial, field)
