@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Mock a Spark application launch: create a fully-annotated driver pod and
+# its executor pods directly with kubectl (no spark-submit needed) — the
+# reference's examples/submit-test-spark-app.sh flow.
+#
+#   examples/submit-test-spark-app.sh <app-id> [num-executors]
+set -euo pipefail
+
+APP_ID="${1:?usage: submit-test-spark-app.sh <app-id> [num-executors]}"
+NUM_EXECUTORS="${2:-2}"
+DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+render() { # file name app_id [executor-count]
+  sed -e "s/name: NAME/name: $2/" -e "s/APP_ID/$3/" \
+      ${4:+-e "s/spark-executor-count: \"8\"/spark-executor-count: \"$4\"/"} \
+      "$1"
+}
+
+render "$DIR/driver.template.yml" "$APP_ID-driver" "$APP_ID" "$NUM_EXECUTORS" \
+  | kubectl apply -f -
+
+# Executors normally launch after the driver runs; creating them up front
+# exercises the same reservation-binding path.
+for i in $(seq 1 "$NUM_EXECUTORS"); do
+  render "$DIR/executor.template.yml" "$APP_ID-exec-$i" "$APP_ID" \
+    | kubectl apply -f -
+done
+
+echo "submitted $APP_ID: 1 driver + $NUM_EXECUTORS executors"
+echo "watch: kubectl -n spark get pods -l spark-app-id=$APP_ID -o wide"
+echo "reservation: kubectl -n spark get resourcereservations $APP_ID -o yaml"

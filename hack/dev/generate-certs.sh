@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Generate a self-signed CA + server cert for the extender and emit the
+# `scheduler-secrets` Secret manifest on stdout (the reference's
+# hack/dev/generate-certs.sh flow):
+#
+#   hack/dev/generate-certs.sh | kubectl apply -f -
+set -euo pipefail
+
+DIR="$(mktemp -d)"
+trap 'rm -rf "$DIR"' EXIT
+CN="${1:-scheduler-service.spark.svc}"
+
+openssl req -x509 -newkey rsa:2048 -nodes -days 365 \
+  -keyout "$DIR/rootCA.key" -out "$DIR/rootCA.crt" \
+  -subj "/CN=spark-scheduler-dev-ca" 2>/dev/null
+
+openssl req -newkey rsa:2048 -nodes \
+  -keyout "$DIR/spark-scheduler.key" -out "$DIR/spark-scheduler.csr" \
+  -subj "/CN=$CN" 2>/dev/null
+
+openssl x509 -req -days 365 -in "$DIR/spark-scheduler.csr" \
+  -CA "$DIR/rootCA.crt" -CAkey "$DIR/rootCA.key" -CAcreateserial \
+  -out "$DIR/spark-scheduler.crt" \
+  -extfile <(printf "subjectAltName=DNS:%s,DNS:localhost,IP:127.0.0.1" "$CN") \
+  2>/dev/null
+
+b64() { base64 < "$1" | tr -d '\n'; }
+
+cat <<EOF
+apiVersion: v1
+kind: Secret
+metadata:
+  name: scheduler-secrets
+  namespace: spark
+type: Opaque
+data:
+  rootCA.crt: $(b64 "$DIR/rootCA.crt")
+  spark-scheduler.crt: $(b64 "$DIR/spark-scheduler.crt")
+  spark-scheduler.key: $(b64 "$DIR/spark-scheduler.key")
+EOF

@@ -1,0 +1,105 @@
+#!/usr/bin/env bash
+# Local dev loop without a cluster (the reference's run-in-minikube.sh
+# moral equivalent for this repo): start an in-process fake apiserver with
+# N nodes, run the scheduler against it with a durable WAL, submit a test
+# app through the apiserver, and show the resulting reservation.
+#
+#   hack/dev/run-local.sh [num-nodes] [num-executors]
+set -euo pipefail
+
+NUM_NODES="${1:-10}"
+NUM_EXECUTORS="${2:-4}"
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+
+exec python - "$NUM_NODES" "$NUM_EXECUTORS" <<PY
+import json, http.client, subprocess, sys, tempfile, time
+sys.path.insert(0, "$REPO")
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from spark_scheduler_tpu.kube.apiserver import FakeKubeAPIServer
+
+num_nodes, num_executors = int(sys.argv[1]), int(sys.argv[2])
+
+def k8s_node(name):
+    return {"kind": "Node", "apiVersion": "v1",
+            "metadata": {"name": name, "labels": {
+                "failure-domain.beta.kubernetes.io/zone": f"zone{hash(name) % 2}",
+                "instance-group": "batch-medium-priority"}},
+            "status": {"allocatable": {"cpu": "8", "memory": "8Gi"},
+                       "conditions": [{"type": "Ready", "status": "True"}]}}
+
+def spark_pod(name, app, role, execs=0):
+    ann = ({"spark-driver-cpu": "1", "spark-driver-mem": "1Gi",
+            "spark-executor-cpu": "1", "spark-executor-mem": "1Gi",
+            "spark-executor-count": str(execs)} if role == "driver" else {})
+    return {"kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": "spark",
+                         "labels": {"spark-role": role, "spark-app-id": app},
+                         "annotations": ann,
+                         "creationTimestamp": time.time()},
+            "spec": {"schedulerName": "spark-scheduler",
+                     "nodeSelector": {"instance-group": "batch-medium-priority"},
+                     "containers": [{"name": "c", "resources": {
+                         "requests": {"cpu": "1", "memory": "1Gi"}}}]},
+            "status": {"phase": "Pending"}}
+
+api = FakeKubeAPIServer()
+api.start()
+for i in range(num_nodes):
+    api.create("nodes", k8s_node(f"node-{i}"))
+print(f"fake apiserver on {api.base_url} with {num_nodes} nodes")
+
+wal = tempfile.mktemp(suffix=".jsonl")
+proc = subprocess.Popen(
+    [sys.executable, "-m", "spark_scheduler_tpu", "server",
+     "--host", "127.0.0.1", "--port", "8484",
+     "--kube-api-url", api.base_url, "--durable-store", wal],
+    env={"PYTHONPATH": "$REPO", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin:/usr/local/bin"})
+conn = None
+try:
+    for _ in range(120):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", 8484, timeout=2)
+            conn.request("GET", "/status/readiness")
+            if conn.getresponse().status == 200:
+                break
+        except OSError:
+            time.sleep(0.5)
+    else:
+        raise SystemExit("scheduler never became ready")
+    print("scheduler ready on :8484")
+
+    nodes = [f"node-{i}" for i in range(num_nodes)]
+    driver = spark_pod("demo-driver", "demo", "driver", num_executors)
+    api.create("pods", driver)
+    time.sleep(0.5)
+    conn.request("POST", "/predicates", body=json.dumps(
+        {"Pod": driver, "NodeNames": nodes}).encode())
+    result = json.loads(conn.getresponse().read())
+    print("driver ->", result["NodeNames"] or result["FailedNodes"])
+    bound = json.loads(json.dumps(driver))
+    bound["spec"]["nodeName"] = result["NodeNames"][0]
+    bound["status"]["phase"] = "Running"
+    api.update("pods", bound)
+    for i in range(num_executors):
+        ex = spark_pod(f"demo-exec-{i}", "demo", "executor")
+        api.create("pods", ex)
+        time.sleep(0.2)
+        conn.request("POST", "/predicates", body=json.dumps(
+            {"Pod": ex, "NodeNames": nodes}).encode())
+        r = json.loads(conn.getresponse().read())
+        print(f"executor {i} ->", r["NodeNames"] or r["FailedNodes"])
+    conn.request("GET", "/metrics")
+    metrics = json.loads(conn.getresponse().read())
+    sched = {k: v for k, v in metrics.items() if "schedule" in k}
+    print("schedule metrics:", json.dumps(sched, indent=2)[:400])
+    print("WAL at", wal)
+finally:
+    if conn:
+        conn.close()
+    proc.terminate()
+    proc.wait(timeout=10)
+    api.stop()
+PY

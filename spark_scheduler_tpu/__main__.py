@@ -35,20 +35,55 @@ def main(argv=None) -> int:
         default=None,
         help="apiserver base URL for list+watch ingestion (informer slot)",
     )
+    pc = sub.add_parser(
+        "print-crds",
+        help="emit the CustomResourceDefinition manifests as YAML "
+        "(kubectl apply -f -)",
+    )
+    pc.add_argument(
+        "--conversion-webhook-url",
+        default=None,
+        help="wire the webhook conversion strategy with this client URL",
+    )
     cw = sub.add_parser(
         "conversion-webhook", help="run the standalone CRD-conversion webhook"
     )
     cw.add_argument("--host", default="0.0.0.0")
     cw.add_argument("--port", type=int, default=8485)
+    cw.add_argument("--cert-file", default=None)
+    cw.add_argument("--key-file", default=None)
     args = parser.parse_args(argv)
 
     if args.command == "version":
         print(__version__)
         return 0
+    if args.command == "print-crds":
+        import yaml
+
+        from spark_scheduler_tpu.models.crds import demand_crd, resource_reservation_crd
+
+        print(
+            yaml.safe_dump_all(
+                [
+                    resource_reservation_crd(
+                        webhook_url=args.conversion_webhook_url
+                    ),
+                    demand_crd(),
+                ],
+                sort_keys=False,
+            ),
+            end="",
+        )
+        return 0
     if args.command == "conversion-webhook":
         from spark_scheduler_tpu.server.http import ConversionWebhookServer
 
-        server = ConversionWebhookServer(host=args.host, port=args.port)
+        server = ConversionWebhookServer(
+            host=args.host,
+            port=args.port,
+            cert_file=args.cert_file,
+            key_file=args.key_file,
+        )
         print(
             f"conversion webhook serving on {args.host}:{server.port}", file=sys.stderr
         )
@@ -122,7 +157,16 @@ def main(argv=None) -> int:
             _Cleanups(),
         ]
     )
-    server = SchedulerHTTPServer(app, registry, host=args.host, port=config.port)
+    server = SchedulerHTTPServer(
+        app,
+        registry,
+        host=args.host,
+        port=config.port,
+        cert_file=config.cert_file,
+        key_file=config.key_file,
+        client_ca_files=config.client_ca_files,
+        request_timeout_s=config.request_timeout_s,
+    )
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)
     try:

@@ -19,6 +19,7 @@ from spark_scheduler_tpu.kube.reflector import (
     BackendSyncTarget,
     KubeIngestion,
     Reflector,
+    in_cluster_ingestion,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "Reflector",
     "BackendSyncTarget",
     "KubeIngestion",
+    "in_cluster_ingestion",
 ]

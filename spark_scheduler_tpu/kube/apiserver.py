@@ -85,11 +85,24 @@ class FakeKubeAPIServer:
     compaction analog), which tests use to exercise the reflector's
     resync path."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, history_limit: int = 4096):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        history_limit: int = 4096,
+        cert_file: str | None = None,
+        key_file: str | None = None,
+        required_token: str | None = None,
+    ):
+        """`cert_file`/`key_file` serve HTTPS; `required_token` enforces
+        `Authorization: Bearer <token>` on every request (401 otherwise) —
+        together they emulate a real apiserver's serviceaccount auth for
+        testing the in-cluster reflector path."""
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._rv = 0
         self._closed = False
+        self._required_token = required_token
         self.collections: dict[str, _Collection] = {
             res: _Collection(res, namespaced, kind, prefix)
             for res, namespaced, kind, prefix in COLLECTIONS
@@ -110,20 +123,40 @@ class FakeKubeAPIServer:
             def log_message(self, *args):
                 pass
 
+            def _authorized(self) -> bool:
+                if outer._required_token is None:
+                    return True
+                header = self.headers.get("Authorization", "")
+                if header == f"Bearer {outer._required_token}":
+                    return True
+                FakeKubeAPIServer._write_json(
+                    self, 401, outer._status(401, "Unauthorized", "bad bearer token")
+                )
+                return False
+
             def do_GET(self):
-                outer._handle_get(self)
+                if self._authorized():
+                    outer._handle_get(self)
 
             def do_POST(self):
-                outer._handle_write(self, "create")
+                if self._authorized():
+                    outer._handle_write(self, "create")
 
             def do_PUT(self):
-                outer._handle_write(self, "update")
+                if self._authorized():
+                    outer._handle_write(self, "update")
 
             def do_DELETE(self):
-                outer._handle_write(self, "delete")
+                if self._authorized():
+                    outer._handle_write(self, "delete")
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
+        # Same per-connection TLS machinery as the real servers — one
+        # implementation to maintain (server/http.py _maybe_wrap_tls).
+        from spark_scheduler_tpu.server.http import _maybe_wrap_tls
+
+        self.tls = _maybe_wrap_tls(self._server, cert_file, key_file)
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -135,7 +168,8 @@ class FakeKubeAPIServer:
     @property
     def base_url(self) -> str:
         host, port = self._server.server_address[:2]
-        return f"http://{host}:{port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> None:
         self._thread = threading.Thread(

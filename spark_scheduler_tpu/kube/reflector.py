@@ -108,10 +108,24 @@ class Reflector:
         name: str = "",
         watch_timeout_s: float = WATCH_TIMEOUT_S,
         relist_backoff_s: float = RELIST_BACKOFF_S,
+        ca_file: Optional[str] = None,
+        token_file: Optional[str] = None,
+        insecure_skip_tls_verify: bool = False,
     ):
+        """`ca_file`/`token_file` enable in-cluster operation against a real
+        apiserver (https://kubernetes.default.svc with the serviceaccount CA
+        bundle + bearer token, the client-go rest.InClusterConfig slot).
+        The token file is re-read per connection: serviceaccount tokens are
+        rotated by the kubelet. https endpoints are ALWAYS verified
+        (against `ca_file` or the system CAs) unless
+        `insecure_skip_tls_verify` is explicitly set."""
         parsed = urlparse(base_url)
         self._host = parsed.hostname or "127.0.0.1"
-        self._port = parsed.port or 80
+        self._tls = parsed.scheme == "https"
+        self._port = parsed.port or (443 if self._tls else 80)
+        self._ca_file = ca_file
+        self._token_file = token_file
+        self._insecure = insecure_skip_tls_verify
         self._path = collection_path
         self._decode = decode
         self._target = target
@@ -190,10 +204,36 @@ class Reflector:
                 # without relisting (reflector resume semantics).
                 self._stop.wait(self._relist_backoff_s)
 
-    def _list(self) -> int:
-        conn = http.client.HTTPConnection(self._host, self._port, timeout=LIST_TIMEOUT_S)
+    def _connect(self, timeout: float) -> http.client.HTTPConnection:
+        if not self._tls:
+            return http.client.HTTPConnection(self._host, self._port, timeout=timeout)
+        import ssl
+
+        # Secure by default: ca_file if given, else the system trust store.
+        # Verification is only disabled on an EXPLICIT insecure opt-in — a
+        # missing CA must fail loudly, not silently accept any peer (the
+        # watch stream is the scheduler's entire world view).
+        ctx = ssl.create_default_context(cafile=self._ca_file)
+        if self._insecure:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return http.client.HTTPSConnection(
+            self._host, self._port, timeout=timeout, context=ctx
+        )
+
+    def _headers(self) -> dict[str, str]:
+        if not self._token_file:
+            return {}
         try:
-            conn.request("GET", self._path)
+            with open(self._token_file, "r", encoding="utf-8") as f:
+                return {"Authorization": f"Bearer {f.read().strip()}"}
+        except OSError:
+            return {}
+
+    def _list(self) -> int:
+        conn = self._connect(LIST_TIMEOUT_S)
+        try:
+            conn.request("GET", self._path, headers=self._headers())
             resp = conn.getresponse()
             if resp.status != 200:
                 raise http.client.HTTPException(f"list {self._path}: {resp.status}")
@@ -209,9 +249,7 @@ class Reflector:
             return 0
 
     def _watch_once(self) -> None:
-        conn = http.client.HTTPConnection(
-            self._host, self._port, timeout=self._watch_timeout_s + LIST_TIMEOUT_S
-        )
+        conn = self._connect(self._watch_timeout_s + LIST_TIMEOUT_S)
         with self._conn_lock:
             self._watch_conn = conn
         try:
@@ -220,6 +258,7 @@ class Reflector:
                 f"{self._path}?watch=true"
                 f"&resourceVersion={self.last_resource_version}"
                 f"&timeoutSeconds={self._watch_timeout_s:g}",
+                headers=self._headers(),
             )
             resp = conn.getresponse()
             if resp.status == 410:
@@ -280,6 +319,9 @@ class KubeIngestion:
         metrics=None,
         clock: Callable[[], float] = time.time,
         watch_timeout_s: float = WATCH_TIMEOUT_S,
+        ca_file: Optional[str] = None,
+        token_file: Optional[str] = None,
+        insecure_skip_tls_verify: bool = False,
     ):
         def on_pod_add(pod) -> None:
             if metrics is not None and pod.creation_timestamp:
@@ -293,6 +335,9 @@ class KubeIngestion:
             BackendSyncTarget(backend, "nodes"),
             name="nodes",
             watch_timeout_s=watch_timeout_s,
+            ca_file=ca_file,
+            token_file=token_file,
+            insecure_skip_tls_verify=insecure_skip_tls_verify,
         )
         self.pod_reflector = Reflector(
             base_url,
@@ -301,6 +346,9 @@ class KubeIngestion:
             BackendSyncTarget(backend, "pods", on_add=on_pod_add),
             name="pods",
             watch_timeout_s=watch_timeout_s,
+            ca_file=ca_file,
+            token_file=token_file,
+            insecure_skip_tls_verify=insecure_skip_tls_verify,
         )
         self.reflectors = [self.node_reflector, self.pod_reflector]
 
@@ -321,3 +369,24 @@ class KubeIngestion:
             if not r.wait_synced(remaining):
                 return False
         return True
+
+
+SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def in_cluster_ingestion(backend, metrics=None, **kw) -> KubeIngestion:
+    """KubeIngestion configured from the pod's serviceaccount — the
+    rest.InClusterConfig slot (what `kube-config-type: in-cluster` selects
+    in the reference, config/config.go + cmd/server.go:57-75)."""
+    import os
+
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    return KubeIngestion(
+        backend,
+        f"https://{host}:{port}",
+        metrics=metrics,
+        ca_file=f"{SERVICEACCOUNT_DIR}/ca.crt",
+        token_file=f"{SERVICEACCOUNT_DIR}/token",
+        **kw,
+    )

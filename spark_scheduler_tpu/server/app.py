@@ -216,11 +216,22 @@ def build_scheduler_app(
         clock=clock,
     )
     ingestion = None
-    if config.kube_api_url:
+    if config.kube_api_url == "in-cluster":
+        # Serviceaccount CA + rotating bearer token against
+        # https://kubernetes.default.svc (rest.InClusterConfig slot,
+        # cmd/server.go:57-75 "kube-config-type: in-cluster").
+        from spark_scheduler_tpu.kube.reflector import in_cluster_ingestion
+
+        ingestion = in_cluster_ingestion(backend, metrics=metrics, clock=clock)
+    elif config.kube_api_url:
         from spark_scheduler_tpu.kube.reflector import KubeIngestion
 
         ingestion = KubeIngestion(
-            backend, config.kube_api_url, metrics=metrics, clock=clock
+            backend,
+            config.kube_api_url,
+            metrics=metrics,
+            clock=clock,
+            insecure_skip_tls_verify=config.kube_api_insecure_skip_tls_verify,
         )
     # A pre-existing Demand CRD (registered before the app was built)
     # activates demand features synchronously; otherwise the background

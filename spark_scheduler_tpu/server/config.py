@@ -58,6 +58,20 @@ class InstallConfig:
     # JSONL write-ahead log path for the durable backend (the etcd slot);
     # used by the CLI to construct a DurableBackend. None = in-memory only.
     durable_store_path: Optional[str] = None
+    # TLS material (the witchcraft server slot: reference install config
+    # server.cert-file / key-file / client-ca-files, examples/extender.yml
+    # :75-80). Both cert+key set => serve HTTPS; client_ca_files (any
+    # number of CAs) additionally requires client certificates (mTLS).
+    cert_file: Optional[str] = None
+    key_file: Optional[str] = None
+    client_ca_files: list[str] = dataclasses.field(default_factory=list)
+    # Disable TLS verification of the kube-api-url endpoint (self-signed
+    # dev apiservers). NEVER the default: without it, https endpoints are
+    # verified against system CAs (or the serviceaccount CA in-cluster).
+    kube_api_insecure_skip_tls_verify: bool = False
+    # Per-connection socket read timeout (extender protocol budget is 30 s,
+    # examples/extender.yml:59).
+    request_timeout_s: float = 30.0
 
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
@@ -84,6 +98,10 @@ class InstallConfig:
                 ),
             )
 
+        # Reference nests TLS + port under a "server" block
+        # (examples/extender.yml:73-80); flat keys also accepted.
+        server_block = raw.get("server") or {}
+        ca_files = server_block.get("client-ca-files") or []
         return cls(
             fifo=bool(raw.get("fifo", False)),
             fifo_config=fifo_cfg,
@@ -101,12 +119,19 @@ class InstallConfig:
             ),
             driver_prioritized_node_label=label_prio("driver-prioritized-node-label"),
             executor_prioritized_node_label=label_prio("executor-prioritized-node-label"),
-            port=int(raw.get("port", 8484)),
+            port=int(server_block.get("port", raw.get("port", 8484))),
             batched_admission=bool(raw.get("batched-admission", True)),
             metrics_log=raw.get("metrics-log"),
             kube_api_url=raw.get("kube-api-url"),
             conversion_webhook_url=raw.get("conversion-webhook-url"),
             durable_store_path=raw.get("durable-store-path"),
+            cert_file=server_block.get("cert-file", raw.get("cert-file")),
+            key_file=server_block.get("key-file", raw.get("key-file")),
+            client_ca_files=list(ca_files),
+            kube_api_insecure_skip_tls_verify=bool(
+                raw.get("kube-api-insecure-skip-tls-verify", False)
+            ),
+            request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
         )
 
 

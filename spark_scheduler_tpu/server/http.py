@@ -76,8 +76,68 @@ def _run_threaded(server: ThreadingHTTPServer, name: str) -> threading.Thread:
     return thread
 
 
+def _maybe_wrap_tls(
+    server: ThreadingHTTPServer,
+    cert_file: str | None,
+    key_file: str | None,
+    client_ca_files=None,
+    handshake_timeout_s: float = 30.0,
+) -> bool:
+    """Serve HTTPS when a cert/key pair is configured — the witchcraft
+    server slot (reference config server.cert-file/key-file/client-ca-files,
+    examples/extender.yml:75-80). `client_ca_files` (str or list) requires
+    client certificates signed by ANY of the given CAs (mTLS). Returns True
+    if TLS was enabled.
+
+    The TLS handshake runs PER CONNECTION in the worker thread (via a
+    finish_request override), never in the accept loop: a client that
+    stalls mid-handshake ties up one bounded-timeout worker, not the whole
+    server."""
+    if not cert_file:
+        return False
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file or cert_file)
+    if isinstance(client_ca_files, str):
+        client_ca_files = [client_ca_files]
+    for ca in client_ca_files or []:
+        ctx.load_verify_locations(ca)
+    if client_ca_files:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+
+    orig_finish_request = server.finish_request
+
+    def finish_request(request, client_address):
+        # ThreadingMixIn calls finish_request from the per-connection worker
+        # thread; the handshake happens here under a timeout.
+        try:
+            request.settimeout(handshake_timeout_s)
+            tls_request = ctx.wrap_socket(request, server_side=True)
+        except (OSError, ssl.SSLError):
+            try:
+                request.close()
+            except OSError:
+                pass
+            return
+        orig_finish_request(tls_request, client_address)
+
+    server.finish_request = finish_request
+    return True
+
+
 class SchedulerHTTPServer:
-    def __init__(self, app, registry=None, host: str = "127.0.0.1", port: int = 8484):
+    def __init__(
+        self,
+        app,
+        registry=None,
+        host: str = "127.0.0.1",
+        port: int = 8484,
+        cert_file: str | None = None,
+        key_file: str | None = None,
+        client_ca_files=None,
+        request_timeout_s: float = 30.0,
+    ):
         self.app = app
         self.registry = registry
         self.ready = threading.Event()
@@ -167,7 +227,15 @@ class SchedulerHTTPServer:
                 except Exception as exc:  # e.g. concurrent-delete race
                     self._write(500, {"error": str(exc)})
 
+        # Socket read timeout per connection: a stalled client cannot pin a
+        # handler thread forever (the extender protocol budget is 30 s,
+        # examples/extender.yml:59).
+        Handler.timeout = request_timeout_s
         self._server = ThreadingHTTPServer((host, port), Handler)
+        self.tls = _maybe_wrap_tls(
+            self._server, cert_file, key_file, client_ca_files,
+            handshake_timeout_s=request_timeout_s,
+        )
         self._thread: threading.Thread | None = None
 
     @property
@@ -202,9 +270,13 @@ class SchedulerHTTPServer:
     def stop(self) -> None:
         self._shutdown.set()
         self.ready.clear()
-        self._server.shutdown()
+        # shutdown() blocks on serve_forever()'s exit handshake — only call
+        # it if serving actually started (Ctrl-C can land before start()
+        # finished, e.g. during the pre-start cache-sync wait).
         if self._thread is not None:
+            self._server.shutdown()
             self._thread.join(timeout=5)
+        self._server.server_close()
         self.app.stop()
 
     def join(self) -> None:
@@ -225,7 +297,15 @@ class ConversionWebhookServer:
     second binary: spark-scheduler-conversion-webhook/cmd/server.go:39-54).
     Serves only POST /convert + liveness; no scheduler state."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8485):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8485,
+        cert_file: str | None = None,
+        key_file: str | None = None,
+        client_ca_files=None,
+        request_timeout_s: float = 30.0,
+    ):
         class Handler(_JSONHandler):
             def do_GET(self):
                 if self.path == "/status/liveness":
@@ -239,7 +319,12 @@ class ConversionWebhookServer:
                 else:
                     self._write(404, {"error": "not found"})
 
+        Handler.timeout = request_timeout_s
         self._server = ThreadingHTTPServer((host, port), Handler)
+        self.tls = _maybe_wrap_tls(
+            self._server, cert_file, key_file, client_ca_files,
+            handshake_timeout_s=request_timeout_s,
+        )
         self._thread: threading.Thread | None = None
 
     @property
@@ -250,9 +335,10 @@ class ConversionWebhookServer:
         self._thread = _run_threaded(self._server, "conversion-http")
 
     def stop(self) -> None:
-        self._server.shutdown()
         if self._thread is not None:
+            self._server.shutdown()
             self._thread.join(timeout=5)
+        self._server.server_close()
 
     def serve_forever(self) -> None:
         self.start()

@@ -286,6 +286,63 @@ class TestResume:
             small.stop()
 
 
+class TestSecureAPIServer:
+    def test_reflector_over_tls_with_bearer_token(self, tmp_path):
+        """The in-cluster client shape: HTTPS apiserver + serviceaccount CA
+        + bearer token read from a (rotatable) file."""
+        import subprocess
+
+        cert, key = str(tmp_path / "api.crt"), str(tmp_path / "api.key")
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+                "-keyout", key, "-out", cert, "-days", "1",
+                "-subj", "/CN=127.0.0.1",
+                "-addext", "subjectAltName=IP:127.0.0.1",
+            ],
+            check=True,
+            capture_output=True,
+        )
+        token_file = tmp_path / "token"
+        token_file.write_text("sa-token-1\n")
+        api = FakeKubeAPIServer(
+            cert_file=cert, key_file=key, required_token="sa-token-1"
+        )
+        api.start()
+        try:
+            api.create("nodes", k8s_node("n1"))
+            backend = InMemoryBackend()
+            ingestion = KubeIngestion(
+                backend,
+                api.base_url,
+                watch_timeout_s=5.0,
+                ca_file=cert,
+                token_file=str(token_file),
+            )
+            ingestion.start()
+            try:
+                assert ingestion.wait_synced(timeout=5.0)
+                api.create("nodes", k8s_node("n2"))
+                assert wait_until(lambda: backend.get_node("n2") is not None)
+            finally:
+                ingestion.stop()
+
+            # wrong token is rejected outright
+            bad = Reflector(
+                api.base_url,
+                "/api/v1/nodes",
+                node_from_k8s,
+                BackendSyncTarget(InMemoryBackend(), "nodes"),
+                ca_file=cert,
+            )
+            import http.client as hc
+
+            with pytest.raises(hc.HTTPException):
+                bad._list()
+        finally:
+            api.stop()
+
+
 class TestEndToEnd:
     def test_scheduler_served_from_watch_stream(self, apiserver):
         """Full loop: cluster state arrives ONLY via the watch stream; gang

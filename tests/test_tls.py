@@ -1,0 +1,148 @@
+"""HTTPS transport tests (the witchcraft HTTPS slot, VERDICT weak #6).
+
+The reference serves the extender protocol over HTTPS with cert/key and
+client CAs from install config (examples/extender.yml:73-80) and probes
+liveness/readiness over HTTPS (extender.yml:142-151).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import ssl
+import subprocess
+
+import pytest
+
+from spark_scheduler_tpu.server.app import build_scheduler_app
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.server.http import ConversionWebhookServer, SchedulerHTTPServer
+from spark_scheduler_tpu.store.backend import InMemoryBackend
+from spark_scheduler_tpu.testing.harness import new_node
+
+
+@pytest.fixture(scope="module")
+def tls_material(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "server.crt"), str(d / "server.key")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key, "-out", cert, "-days", "1",
+            "-subj", "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return cert, key
+
+
+def _client_ctx(cert: str) -> ssl.SSLContext:
+    ctx = ssl.create_default_context(cafile=cert)
+    ctx.check_hostname = False
+    return ctx
+
+
+def _tls_server(tls_material, **kw):
+    cert, key = tls_material
+    backend = InMemoryBackend()
+    backend.add_node(new_node("n0"))
+    app = build_scheduler_app(backend, InstallConfig(sync_writes=True))
+    return SchedulerHTTPServer(
+        app, host="127.0.0.1", port=0, cert_file=cert, key_file=key, **kw
+    )
+
+
+def test_https_serving(tls_material):
+    cert, _ = tls_material
+    server = _tls_server(tls_material)
+    server.start()
+    try:
+        assert server.tls
+        conn = http.client.HTTPSConnection(
+            "127.0.0.1", server.port, context=_client_ctx(cert), timeout=5
+        )
+        conn.request("GET", "/status/liveness")
+        assert conn.getresponse().status == 200
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_plaintext_client_rejected_on_tls_server(tls_material):
+    server = _tls_server(tls_material)
+    server.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        with pytest.raises(Exception):  # TLS server drops a plaintext request
+            conn.request("GET", "/status/liveness")
+            resp = conn.getresponse()
+            if resp.status:  # pragma: no cover - must not produce a response
+                raise AssertionError("plaintext request succeeded")
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_conversion_webhook_https(tls_material):
+    cert, key = tls_material
+    server = ConversionWebhookServer(
+        host="127.0.0.1", port=0, cert_file=cert, key_file=key
+    )
+    server.start()
+    try:
+        conn = http.client.HTTPSConnection(
+            "127.0.0.1", server.port, context=_client_ctx(cert), timeout=5
+        )
+        review = {
+            "request": {"uid": "u1", "desiredAPIVersion": "v1beta2", "objects": []}
+        }
+        conn.request("POST", "/convert", body=json.dumps(review).encode())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read())["response"]["uid"] == "u1"
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_request_timeout_closes_stalled_connection(tls_material):
+    """A client that connects and never sends a request cannot pin a
+    handler thread past the configured timeout."""
+    backend = InMemoryBackend()
+    backend.add_node(new_node("n0"))
+    app = build_scheduler_app(backend, InstallConfig(sync_writes=True))
+    server = SchedulerHTTPServer(
+        app, host="127.0.0.1", port=0, request_timeout_s=0.5
+    )
+    server.start()
+    try:
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        s.settimeout(5)
+        # send nothing; the server should close the connection after 0.5s
+        data = s.recv(1)  # blocks until server closes -> b""
+        assert data == b""
+        s.close()
+    finally:
+        server.stop()
+
+
+def test_config_parses_server_block():
+    cfg = InstallConfig.from_dict(
+        {
+            "server": {
+                "port": 9999,
+                "cert-file": "/c.crt",
+                "key-file": "/c.key",
+                "client-ca-files": ["/ca.crt"],
+            },
+            "request-timeout": "10s",
+        }
+    )
+    assert cfg.port == 9999
+    assert cfg.cert_file == "/c.crt"
+    assert cfg.key_file == "/c.key"
+    assert cfg.client_ca_files == ["/ca.crt"]
+    assert cfg.request_timeout_s == 10.0
