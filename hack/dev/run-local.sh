@@ -66,7 +66,8 @@ try:
             if conn.getresponse().status == 200:
                 break
         except OSError:
-            time.sleep(0.5)
+            pass
+        time.sleep(0.5)  # also on 503: listening-but-not-synced
     else:
         raise SystemExit("scheduler never became ready")
     print("scheduler ready on :8484")

@@ -166,6 +166,7 @@ def main(argv=None) -> int:
         key_file=config.key_file,
         client_ca_files=config.client_ca_files,
         request_timeout_s=config.request_timeout_s,
+        debug_routes=config.debug_routes,
     )
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)

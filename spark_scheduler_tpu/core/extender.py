@@ -138,6 +138,8 @@ class SparkSchedulerExtender:
     # ------------------------------------------------------------------ API
 
     def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
+        from spark_scheduler_tpu.tracing import tracer
+
         pod = args.pod
         role = pod.labels.get(SPARK_ROLE_LABEL, "")
         timer_start = self._clock()
@@ -148,7 +150,11 @@ class SparkSchedulerExtender:
             return self._fail(args, FAILURE_INTERNAL, f"failed to reconcile: {exc}")
         self._rrm.compact_dynamic_allocation_applications()
 
-        node, outcome, message = self._select_node(role, pod, args.node_names)
+        with tracer().span(
+            "select-node", role=role or "unknown", pod=f"{pod.namespace}/{pod.name}"
+        ) as sp:
+            node, outcome, message = self._select_node(role, pod, args.node_names)
+            sp.tag("outcome", outcome)
 
         if self._metrics is not None:
             self._metrics.mark_schedule_outcome(
@@ -177,7 +183,10 @@ class SparkSchedulerExtender:
         now = self._clock()
         if now > self._last_request + LEADER_ELECTION_INTERVAL_S:
             if self._reconciler is not None:
-                self._reconciler.sync_resource_reservations_and_demands()
+                from spark_scheduler_tpu.tracing import tracer
+
+                with tracer().span("reconcile", reason="leader-election-gap"):
+                    self._reconciler.sync_resource_reservations_and_demands()
         self._last_request = now
 
     def _select_node(

@@ -211,30 +211,38 @@ class PlacementSolver:
         driver_candidate_names: Sequence[str],
         domain_mask: np.ndarray | None = None,
     ) -> HostPacking:
+        from spark_scheduler_tpu.tracing import tracer
+
         fn = BINPACK_FUNCTIONS[strategy]
         n = tensors.available.shape[0]
         driver_mask = self.candidate_mask(tensors, driver_candidate_names)
         if domain_mask is None:
             domain_mask = np.asarray(tensors.valid)
         emax = _bucket(max(executor_count, 1), 8)
-        packing = fn(
-            tensors,
-            jnp.asarray(driver_resources.as_array()),
-            jnp.asarray(executor_resources.as_array()),
-            jnp.int32(executor_count),
-            jnp.asarray(driver_mask),
-            jnp.asarray(domain_mask),
-            emax=emax,
-            num_zones=self._num_zones_bucket(),
-        )
-        # ONE device->host transfer for the whole decision: on a tunneled
-        # TPU each scalar pull is a full RPC round-trip, so per-field
-        # int()/float() would cost ~8 RTTs per request (SURVEY.md §7
-        # latency budget). Efficiency reporting runs as pure numpy on the
-        # host-resident cluster arrays — zero extra dispatches.
-        import jax
+        # The span covers dispatch AND the device->host transfer — the
+        # transfer is where the device work is actually awaited.
+        with tracer().span(
+            "solve", strategy=strategy, nodes=n, executors=executor_count
+        ):
+            packing = fn(
+                tensors,
+                jnp.asarray(driver_resources.as_array()),
+                jnp.asarray(executor_resources.as_array()),
+                jnp.int32(executor_count),
+                jnp.asarray(driver_mask),
+                jnp.asarray(domain_mask),
+                emax=emax,
+                num_zones=self._num_zones_bucket(),
+            )
+            # ONE device->host transfer for the whole decision: on a
+            # tunneled TPU each scalar pull is a full RPC round-trip, so
+            # per-field int()/float() would cost ~8 RTTs per request
+            # (SURVEY.md §7 latency budget). Efficiency reporting runs as
+            # pure numpy on the host-resident cluster arrays — zero extra
+            # dispatches.
+            import jax
 
-        packing = jax.device_get(packing)
+            packing = jax.device_get(packing)
         eff = avg_packing_efficiency_np(
             np.asarray(tensors.schedulable),
             np.asarray(tensors.available),
@@ -302,19 +310,24 @@ class PlacementSolver:
             driver_cand=np.broadcast_to(driver_mask, (b, n)),
             domain=np.broadcast_to(domain, (b, n)),
         )
-        out = batched_fifo_pack(
-            tensors, apps, fill=strategy, emax=emax,
-            num_zones=self._num_zones_bucket(),
-        )
+        from spark_scheduler_tpu.tracing import tracer
 
-        # ONE device->host transfer for the decisions (tunneled-TPU RTTs:
-        # see pack()); available_after is pulled only on the efficiency
-        # branch below.
-        import jax
+        with tracer().span(
+            "solve", strategy=strategy, nodes=n, queue_rows=b, batched=True
+        ):
+            out = batched_fifo_pack(
+                tensors, apps, fill=strategy, emax=emax,
+                num_zones=self._num_zones_bucket(),
+            )
 
-        drivers, execs, admitted, packed = jax.device_get(
-            (out.driver_node, out.executor_nodes, out.admitted, out.packed)
-        )
+            # ONE device->host transfer for the decisions (tunneled-TPU
+            # RTTs: see pack()); available_after is pulled only on the
+            # efficiency branch below.
+            import jax
+
+            drivers, execs, admitted, packed = jax.device_get(
+                (out.driver_node, out.executor_nodes, out.admitted, out.packed)
+            )
 
         # Efficiency of the final row against the availability it packed
         # into: reconstructed entirely on the host by subtracting the

@@ -126,6 +126,7 @@ class Reflector:
         self._ca_file = ca_file
         self._token_file = token_file
         self._insecure = insecure_skip_tls_verify
+        self._token_error_logged = False
         self._path = collection_path
         self._decode = decode
         self._target = target
@@ -227,7 +228,21 @@ class Reflector:
         try:
             with open(self._token_file, "r", encoding="utf-8") as f:
                 return {"Authorization": f"Bearer {f.read().strip()}"}
-        except OSError:
+        except OSError as exc:
+            # A configured-but-unreadable token means every request will be
+            # rejected 401 — say so once instead of silently retrying
+            # unauthenticated forever.
+            if not self._token_error_logged:
+                self._token_error_logged = True
+                from spark_scheduler_tpu.tracing import svc1log
+
+                svc1log().warn(
+                    "serviceaccount token unreadable; requests go out "
+                    "unauthenticated",
+                    tokenFile=self._token_file,
+                    error=repr(exc),
+                    reflector=self.name,
+                )
             return {}
 
     def _list(self) -> int:
@@ -382,6 +397,8 @@ def in_cluster_ingestion(backend, metrics=None, **kw) -> KubeIngestion:
 
     host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    if ":" in host and not host.startswith("["):
+        host = f"[{host}]"  # IPv6 literal needs brackets in a URL
     return KubeIngestion(
         backend,
         f"https://{host}:{port}",

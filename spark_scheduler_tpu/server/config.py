@@ -72,6 +72,9 @@ class InstallConfig:
     # Per-connection socket read timeout (extender protocol budget is 30 s,
     # examples/extender.yml:59).
     request_timeout_s: float = 30.0
+    # Expose /debug/* (trace dump + JAX profiler control). Off by default:
+    # on the cluster-exposed port these routes are unauthenticated.
+    debug_routes: bool = False
 
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
@@ -132,6 +135,7 @@ class InstallConfig:
                 raw.get("kube-api-insecure-skip-tls-verify", False)
             ),
             request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
+            debug_routes=bool(raw.get("debug-routes", False)),
         )
 
 

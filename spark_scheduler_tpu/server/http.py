@@ -137,9 +137,14 @@ class SchedulerHTTPServer:
         key_file: str | None = None,
         client_ca_files=None,
         request_timeout_s: float = 30.0,
+        debug_routes: bool = False,
     ):
         self.app = app
         self.registry = registry
+        # /debug/* (trace dump, JAX profiler control) is an explicit opt-in:
+        # on the cluster-exposed extender port it would let any peer start
+        # profiler writes to server-side paths.
+        self.debug_routes = debug_routes
         self.ready = threading.Event()
         self._shutdown = threading.Event()
         # One predicate at a time — the serialization point for mutable
@@ -157,34 +162,83 @@ class SchedulerHTTPServer:
                 elif self.path == "/metrics":
                     snap = outer.registry.snapshot() if outer.registry else {}
                     self._write(200, snap)
+                elif self.path == "/debug/traces" and outer.debug_routes:
+                    from spark_scheduler_tpu.tracing import tracer
+
+                    self._write(200, {"spans": tracer().finished_spans()})
                 else:
                     self._write(404, {"error": "not found"})
 
             def do_POST(self):
                 if self.path == "/predicates":
+                    from spark_scheduler_tpu.tracing import (
+                        pod_safe_params,
+                        svc1log,
+                        tracer,
+                    )
+
                     try:
                         pod, node_names = extender_args_from_k8s(self._body())
                     except Exception as exc:
                         self._write(500, {"Error": str(exc)})
                         return
-                    try:
-                        with outer._predicate_lock:
-                            result = outer.app.extender.predicate(
-                                ExtenderArgs(pod=pod, node_names=node_names)
+                    # Root span continues the caller's b3 trace context
+                    # (the witchcraft tracing middleware slot).
+                    with tracer().root_from_headers(
+                        self.headers, "predicate", pod=f"{pod.namespace}/{pod.name}"
+                    ) as root:
+                        try:
+                            with outer._predicate_lock:
+                                result = outer.app.extender.predicate(
+                                    ExtenderArgs(pod=pod, node_names=node_names)
+                                )
+                        except Exception as exc:
+                            # Internal errors ride the protocol's Error
+                            # channel (ExtenderFilterResult.Error) so
+                            # kube-scheduler gets a well-formed response
+                            # instead of a dropped connection.
+                            root.tag("outcome", "failure-internal")
+                            svc1log().error(
+                                "predicate failed",
+                                error=repr(exc),
+                                **pod_safe_params(pod),
                             )
-                    except Exception as exc:
-                        # Internal errors ride the protocol's Error channel
-                        # (ExtenderFilterResult.Error) so kube-scheduler gets
-                        # a well-formed response instead of a dropped
-                        # connection.
-                        self._write(
-                            200,
-                            {"NodeNames": [], "FailedNodes": {}, "Error": str(exc)},
+                            self._write(
+                                200,
+                                {"NodeNames": [], "FailedNodes": {}, "Error": str(exc)},
+                            )
+                            return
+                        root.tag("outcome", result.outcome)
+                        svc1log().info(
+                            "predicate",
+                            outcome=result.outcome,
+                            nodes=list(result.node_names),
+                            **pod_safe_params(pod),
                         )
-                        return
                     self._write(200, filter_result_to_k8s(result))
                 elif self.path == "/convert":
                     self._handle_convert()
+                elif self.path == "/debug/profile/start" and outer.debug_routes:
+                    from spark_scheduler_tpu.tracing import start_jax_profile
+
+                    try:
+                        body = self._body()
+                    except Exception:
+                        body = {}
+                    log_dir = body.get("dir") or "/tmp/spark-scheduler-jax-trace"
+                    started = start_jax_profile(log_dir)
+                    self._write(
+                        200 if started else 409,
+                        {"profiling": started, "dir": log_dir},
+                    )
+                elif self.path == "/debug/profile/stop" and outer.debug_routes:
+                    from spark_scheduler_tpu.tracing import stop_jax_profile
+
+                    out_dir = stop_jax_profile()
+                    self._write(
+                        200 if out_dir else 409,
+                        {"profiling": False, "dir": out_dir},
+                    )
                 else:
                     self._write(404, {"error": "not found"})
 

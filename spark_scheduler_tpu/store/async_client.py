@@ -118,17 +118,24 @@ class AsyncClient:
     # -- request processing -------------------------------------------------
 
     def process(self, req: Request) -> None:
-        try:
-            if req.type == RequestType.CREATE:
-                self._do_create(req)
-            elif req.type == RequestType.UPDATE:
-                self._do_update(req)
-            else:
-                self._do_delete(req)
-        except NamespaceTerminatingError:
-            self.metrics.mark_dropped()  # not retryable (async.go:88-96)
-        except Exception as exc:  # bounded retry (async.go:139-154)
-            self._maybe_retry(req, exc)
+        from spark_scheduler_tpu.tracing import tracer
+
+        with tracer().span(
+            "write-back",
+            verb=req.type.name.lower(),
+            key=f"{req.key[0]}/{req.key[1]}",
+        ):
+            try:
+                if req.type == RequestType.CREATE:
+                    self._do_create(req)
+                elif req.type == RequestType.UPDATE:
+                    self._do_update(req)
+                else:
+                    self._do_delete(req)
+            except NamespaceTerminatingError:
+                self.metrics.mark_dropped()  # not retryable (async.go:88-96)
+            except Exception as exc:  # bounded retry (async.go:139-154)
+                self._maybe_retry(req, exc)
 
     def _do_create(self, req: Request) -> None:
         obj = self._store.get(*req.key)
