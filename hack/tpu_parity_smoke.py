@@ -1,0 +1,101 @@
+"""On-device parity smoke: golden oracle checks on the REAL default backend.
+
+The pytest suite pins JAX to a virtual CPU mesh (tests/conftest.py), so the
+golden parity proofs normally never execute on TPU silicon. This script
+runs a reduced randomized sweep of THE SAME checks — it imports
+`random_cluster` / `check_case` straight from tests/test_packing_golden.py,
+so the on-device smoke and the CPU golden suite are provably the same
+assertions — on whatever backend JAX resolves (the TPU chip under the axon
+tunnel, a Cloud TPU VM, or CPU as fallback). One shape bucket keeps the
+compile count low.
+
+Run directly (prints one JSON verdict line):
+    python hack/tpu_parity_smoke.py
+or through pytest when a chip is available:
+    SPARK_SCHEDULER_TPU_SMOKE=1 python -m pytest tests/test_tpu_parity.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+N_NODES = 64  # one shape bucket: a single compile per (fill, program)
+TRIALS = 12
+
+
+def main() -> int:
+    import jax
+
+    from tests import greedy_oracle as G
+    from tests import test_packing_golden as TG
+    from spark_scheduler_tpu.ops.batched import batched_fifo_pack, make_app_batch
+
+    emax, num_zones = TG.EMAX, TG.NUM_ZONES
+    device = str(jax.devices()[0])
+    rng = np.random.default_rng(1234)
+    checked = 0
+
+    # -- single-app kernels vs oracle, on device: the golden suite's own
+    #    fixtures and slot-exact assertions (test_packing_golden.check_case)
+    for fill in ("tightly-pack", "distribute-evenly", "minimal-fragmentation"):
+        for trial in range(TRIALS):
+            c = TG.random_cluster(rng, N_NODES, with_labels=trial % 3 == 0)
+            driver_req = rng.integers(0, 12, size=3).astype(np.int32)
+            exec_req = rng.integers(0, 10, size=3).astype(np.int32)
+            count = int(rng.integers(0, emax + 1))
+            driver_mask = rng.random(N_NODES) < 0.7
+            domain = rng.random(N_NODES) < 0.9
+            TG.check_case(c, driver_req, exec_req, count, driver_mask, domain, fill)
+            checked += 1
+
+    # -- batched FIFO program: admitted rows equal the sequential oracle
+    #    threading availability (queue-mode eligibility: valid & schedulable
+    #    & ready for drivers too, ops/batched.py queue mode)
+    for _ in range(TRIALS // 2):
+        c = TG.random_cluster(rng, N_NODES)
+        b = 6
+        drivers = rng.integers(1, 6, size=(b, 3)).astype(np.int32)
+        execs = rng.integers(1, 6, size=(b, 3)).astype(np.int32)
+        counts = rng.integers(0, emax + 1, size=b).astype(np.int32)
+        apps = make_app_batch(drivers, execs, counts, skippable=np.ones(b, bool))
+        out = jax.device_get(
+            batched_fifo_pack(c, apps, fill="tightly-pack", emax=emax, num_zones=num_zones)
+        )
+        avail = np.asarray(c.available).astype(np.int64).copy()
+        dom = np.asarray(c.valid)
+        e_elig = dom & ~np.asarray(c.unschedulable) & np.asarray(c.ready)
+        d_order = G.greedy_priority_order(
+            np.asarray(c.available), np.asarray(c.zone_id), np.asarray(c.name_rank),
+            e_elig, domain=dom, label_rank=np.asarray(c.label_rank_driver),
+        )
+        e_order = G.greedy_priority_order(
+            np.asarray(c.available), np.asarray(c.zone_id), np.asarray(c.name_rank),
+            e_elig, domain=dom, label_rank=np.asarray(c.label_rank_executor),
+        )
+        for i in range(b):
+            g_driver, g_execs, g_ok, _ = G.greedy_spark_bin_pack(
+                avail, drivers[i].astype(np.int64), execs[i].astype(np.int64),
+                int(counts[i]), d_order, e_order, "tightly-pack",
+            )
+            assert bool(out.admitted[i]) == g_ok, (i, device)
+            if g_ok:
+                assert int(out.driver_node[i]) == g_driver, (i, device)
+                got_execs = [int(x) for x in out.executor_nodes[i] if x >= 0]
+                assert got_execs == list(g_execs), (i, device)
+                avail[g_driver] -= drivers[i]
+                for e in g_execs:
+                    avail[e] -= execs[i]
+        checked += 1
+
+    print(json.dumps({"device": device, "cases_checked": checked, "parity": "ok"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

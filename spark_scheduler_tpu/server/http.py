@@ -225,8 +225,14 @@ class SchedulerHTTPServer:
                         body = self._body()
                     except Exception:
                         body = {}
+                    if not isinstance(body, dict):
+                        body = {}
                     log_dir = body.get("dir") or "/tmp/spark-scheduler-jax-trace"
-                    started = start_jax_profile(log_dir)
+                    try:
+                        started = start_jax_profile(log_dir)
+                    except Exception as exc:  # unwritable dir etc.
+                        self._write(500, {"profiling": False, "error": str(exc)})
+                        return
                     self._write(
                         200 if started else 409,
                         {"profiling": started, "dir": log_dir},
@@ -234,7 +240,11 @@ class SchedulerHTTPServer:
                 elif self.path == "/debug/profile/stop" and outer.debug_routes:
                     from spark_scheduler_tpu.tracing import stop_jax_profile
 
-                    out_dir = stop_jax_profile()
+                    try:
+                        out_dir = stop_jax_profile()
+                    except Exception as exc:
+                        self._write(500, {"profiling": False, "error": str(exc)})
+                        return
                     self._write(
                         200 if out_dir else 409,
                         {"profiling": False, "dir": out_dir},

@@ -152,6 +152,9 @@ class Tracer:
         single = get("b3") or get("B3")
         if single and not trace_id:
             parts = single.split("-")
+            if len(parts) == 1:
+                # lone sampling decision: "b3: 0" (deny) / "1" / "d"
+                sampled_raw = parts[0]
             if len(parts) >= 2:
                 trace_id, parent_id = parts[0], parts[1]
             if len(parts) >= 3:
@@ -160,7 +163,8 @@ class Tracer:
         if trace_id:
             s = Span(name, trace_id, _new_id(), parent_id, sampled)
         else:
-            s = Span(name, _new_id(128), _new_id(), None)
+            # New root — the sampling decision still applies (lone "b3: 0").
+            s = Span(name, _new_id(128), _new_id(), None, sampled)
         s.tags.update(tags)
         return _SpanContext(self, s)
 
@@ -229,8 +233,22 @@ def stop_jax_profile() -> Optional[str]:
     with _profile_lock:
         if _profile_dir is None:
             return None
-        jax.profiler.stop_trace()
         out, _profile_dir = _profile_dir, None
+        # Flag cleared BEFORE stop_trace, and jax's internal profile state
+        # force-reset if the flush fails (deleted/unwritable dir): stop_trace
+        # skips its own reset() on exception, which would otherwise wedge
+        # every future start with "Profile has already been started".
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            try:
+                from jax._src import profiler as _jax_profiler
+
+                with _jax_profiler._profile_state.lock:
+                    _jax_profiler._profile_state.reset()
+            except Exception:
+                pass
+            raise
         return out
 
 

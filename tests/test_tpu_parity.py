@@ -1,0 +1,44 @@
+"""On-TPU parity smoke gate (VERDICT r1 weak #5).
+
+The main suite runs on a forced-CPU virtual mesh; this test executes the
+golden oracle sweep on the REAL default backend by spawning a fresh
+process without the CPU override. Opt-in (slow: remote-TPU compiles):
+
+    SPARK_SCHEDULER_TPU_SMOKE=1 python -m pytest tests/test_tpu_parity.py -q
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(
+    os.environ.get("SPARK_SCHEDULER_TPU_SMOKE") != "1",
+    reason="set SPARK_SCHEDULER_TPU_SMOKE=1 to run the on-device parity smoke",
+)
+def test_parity_on_default_backend():
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # drop the suite's CPU pin so the child resolves the real backend
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "hack", "tpu_parity_smoke.py")],
+        capture_output=True,
+        text=True,
+        timeout=1200,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["parity"] == "ok"
+    assert verdict["cases_checked"] > 0

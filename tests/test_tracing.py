@@ -59,6 +59,10 @@ class TestTracer:
         with t.root_from_headers({"b3": "aa-bb-0"}, "srv"):
             pass
         assert t.finished_spans() == []
+        # lone deny form "b3: 0" also suppresses recording
+        with t.root_from_headers({"b3": "0"}, "srv"):
+            pass
+        assert t.finished_spans() == []
 
     def test_error_tagged(self):
         t = Tracer()
@@ -228,6 +232,21 @@ class TestSafeParams:
 
 
 class TestJaxProfiler:
+    def test_failed_flush_does_not_wedge_profiler(self, tmp_path):
+        """stop_trace raising (unwritable dir) must not leave jax's internal
+        profile state 'started' — the next capture must work end to end."""
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        assert start_jax_profile("/proc/nonexistent-dir/x")
+        (jnp.ones((4, 4)) @ jnp.ones((4, 4))).block_until_ready()
+        with _pytest.raises(Exception):
+            stop_jax_profile()
+        good = str(tmp_path / "recovered")
+        assert start_jax_profile(good), "profiler wedged after failed flush"
+        (jnp.ones((4, 4)) @ jnp.ones((4, 4))).block_until_ready()
+        assert stop_jax_profile() == good
+
     def test_profile_capture_produces_artifact(self, tmp_path):
         import jax.numpy as jnp
 
