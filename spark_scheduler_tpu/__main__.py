@@ -126,13 +126,41 @@ def main(argv=None) -> int:
     metrics = SchedulerMetrics(registry, config.instance_group_label)
     events = EventEmitter(instance_group_label=config.instance_group_label)
     waste = WasteReporter(registry, config.instance_group_label)
+    kube_backend = False
     if config.durable_store_path:
         from spark_scheduler_tpu.store.durable import DurableBackend
 
         backend = DurableBackend(config.durable_store_path)
+    elif config.kube_api_url:
+        # Reservations/demands persist as CRs in the apiserver — the
+        # reference's actual deployment mode (CRDs ARE the durable store,
+        # SURVEY.md §5.4). A durable-store path overrides this with a
+        # local WAL instead.
+        from spark_scheduler_tpu.kube.backend import KubeBackend
+
+        if config.kube_api_url == "in-cluster":
+            from spark_scheduler_tpu.kube.reflector import in_cluster_config
+
+            base_url, ca_file, token_file = in_cluster_config()
+        else:
+            base_url, ca_file, token_file = config.kube_api_url, None, None
+        backend = KubeBackend(
+            base_url,
+            qps=config.kube_api_qps,
+            burst=config.kube_api_burst,
+            ca_file=ca_file,
+            token_file=token_file,
+            insecure_skip_tls_verify=config.kube_api_insecure_skip_tls_verify,
+        )
+        backend.start()  # initial CR list + watch
+        kube_backend = True
     else:
         backend = InMemoryBackend()
-    backend.register_crd(DEMAND_CRD)
+    if not kube_backend:
+        # On a real cluster the Demand CRD belongs to the external
+        # autoscaler (demand_informer.go); locally we provide it so demand
+        # features are exercisable.
+        backend.register_crd(DEMAND_CRD)
     app = build_scheduler_app(
         backend, config, metrics=metrics, events=events, waste=waste
     )
@@ -171,20 +199,27 @@ def main(argv=None) -> int:
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)
     try:
-        if config.durable_store_path:
-            # Restored WAL state must be reconciled against CURRENT cluster
-            # state BEFORE any /predicates request is served: wait for
-            # watch-ingestion cache sync (blocking until it succeeds — a
-            # half-populated cache would make reconciliation delete
-            # reservations for pods that merely haven't listed yet), then
-            # reconcile, then open the server (WaitForCacheSync precedes
-            # failover recovery: cmd/server.go:140-147 then
-            # failover.go:35-72 — the restart IS a leader change).
+        if config.durable_store_path or kube_backend:
+            # Restored state (WAL replay or apiserver CR list) must be
+            # reconciled against CURRENT cluster state BEFORE any
+            # /predicates request is served: wait for watch-ingestion cache
+            # sync (blocking until it succeeds — a half-populated cache
+            # would make reconciliation delete reservations for pods that
+            # merely haven't listed yet), then reconcile, then open the
+            # server (WaitForCacheSync precedes failover recovery:
+            # cmd/server.go:140-147 then failover.go:35-72 — a restart IS
+            # a leader change).
             app.start_background()
             if app.ingestion is not None:
                 while not app.ingestion.wait_synced(timeout=30.0):
                     print(
                         "waiting for apiserver cache sync before reconcile...",
+                        file=sys.stderr,
+                    )
+            if kube_backend:
+                while not backend.wait_synced(timeout=30.0):
+                    print(
+                        "waiting for reservation/demand cache sync...",
                         file=sys.stderr,
                     )
             app.reconciler.sync_resource_reservations_and_demands()

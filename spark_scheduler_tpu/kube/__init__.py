@@ -15,6 +15,7 @@ clientsets for tests. This package provides the same boundary natively:
 """
 
 from spark_scheduler_tpu.kube.apiserver import FakeKubeAPIServer
+from spark_scheduler_tpu.kube.backend import KubeBackend, RestClient, TokenBucket
 from spark_scheduler_tpu.kube.reflector import (
     BackendSyncTarget,
     KubeIngestion,
@@ -24,6 +25,9 @@ from spark_scheduler_tpu.kube.reflector import (
 
 __all__ = [
     "FakeKubeAPIServer",
+    "KubeBackend",
+    "RestClient",
+    "TokenBucket",
     "Reflector",
     "BackendSyncTarget",
     "KubeIngestion",

@@ -63,6 +63,12 @@ COLLECTIONS = (
         "/apis/sparkscheduler.palantir.com/v1beta2",
     ),
     ("demands", True, "DemandList", "/apis/scaler.palantir.com/v1alpha2"),
+    (
+        "customresourcedefinitions",
+        False,
+        "CustomResourceDefinitionList",
+        "/apis/apiextensions.k8s.io/v1",
+    ),
 )
 
 
@@ -196,13 +202,29 @@ class FakeKubeAPIServer:
     def _validate(self, resource: str, obj: dict) -> None:
         with self._lock:
             crd = self._crds.get(resource)
-        if crd is None:
+        # Validation only applies to CRDs whose manifest carries schemas
+        # (a minimally-registered CRD behaves like preserveUnknownFields).
+        if crd is None or not (crd.get("spec") or {}).get("versions"):
             return
         from spark_scheduler_tpu.models.crds import validate_custom_resource
 
         errors = validate_custom_resource(crd, obj)
         if errors:
             raise ValidationError("; ".join(errors))
+
+    def _maybe_track_crd(self, resource: str, obj: dict, deleted: bool = False) -> None:
+        """CRDs created/updated THROUGH the API register their schemas for
+        validation, like the real apiserver establishing a CRD."""
+        if resource != "customresourcedefinitions":
+            return
+        plural = ((obj.get("spec") or {}).get("names") or {}).get("plural")
+        if not plural:
+            return
+        with self._lock:
+            if deleted:
+                self._crds.pop(plural, None)
+            else:
+                self._crds[plural] = obj
 
     # -- state mutation (also the test-driver API) --------------------------
 
@@ -221,6 +243,7 @@ class FakeKubeAPIServer:
             col.objects[key] = snapshot
             self._history.append((self._rv, resource, "ADDED", snapshot))
             self._cond.notify_all()
+        self._maybe_track_crd(resource, snapshot)
         return obj
 
     def create_many(self, resource: str, objs: list[dict]) -> None:
@@ -230,6 +253,7 @@ class FakeKubeAPIServer:
         col = self.collections[resource]
         for obj in objs:
             self._validate(resource, obj)
+        snapshots = []
         with self._cond:
             for obj in objs:
                 key = _obj_key(obj)
@@ -240,7 +264,10 @@ class FakeKubeAPIServer:
                 snapshot = json.loads(json.dumps(obj))
                 col.objects[key] = snapshot
                 self._history.append((self._rv, resource, "ADDED", snapshot))
+                snapshots.append(snapshot)
             self._cond.notify_all()
+        for snapshot in snapshots:
+            self._maybe_track_crd(resource, snapshot)
 
     def update(self, resource: str, obj: dict, check_rv: bool = False) -> dict:
         col = self.collections[resource]
@@ -262,6 +289,7 @@ class FakeKubeAPIServer:
             col.objects[key] = snapshot
             self._history.append((self._rv, resource, "MODIFIED", snapshot))
             self._cond.notify_all()
+        self._maybe_track_crd(resource, snapshot)
         return obj
 
     def delete(self, resource: str, namespace: str, name: str) -> None:
@@ -277,6 +305,7 @@ class FakeKubeAPIServer:
             _meta(final)["resourceVersion"] = str(self._rv)
             self._history.append((self._rv, resource, "DELETED", final))
             self._cond.notify_all()
+        self._maybe_track_crd(resource, cur, deleted=True)
 
     def current_rv(self) -> int:
         with self._lock:

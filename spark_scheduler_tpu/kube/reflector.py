@@ -42,6 +42,12 @@ class GoneError(Exception):
     """Watch history expired (HTTP 410 / ERROR event) — relist required."""
 
 
+class CollectionAbsentError(Exception):
+    """404 on a tolerate_absent collection (CRD not installed yet) — sync
+    as empty, poll slowly until the CRD appears (demand_informer.go:75-97
+    semantics: the Demand CRD belongs to the external autoscaler)."""
+
+
 class BackendSyncTarget:
     """Applies decoded watch events to a ClusterBackend kind, diffing
     wholesale relists into the add/update/delete stream subscribers expect
@@ -111,6 +117,8 @@ class Reflector:
         ca_file: Optional[str] = None,
         token_file: Optional[str] = None,
         insecure_skip_tls_verify: bool = False,
+        tolerate_absent: bool = False,
+        absent_poll_s: float = 60.0,
     ):
         """`ca_file`/`token_file` enable in-cluster operation against a real
         apiserver (https://kubernetes.default.svc with the serviceaccount CA
@@ -127,6 +135,8 @@ class Reflector:
         self._token_file = token_file
         self._insecure = insecure_skip_tls_verify
         self._token_error_logged = False
+        self._tolerate_absent = tolerate_absent
+        self._absent_poll_s = absent_poll_s
         self._path = collection_path
         self._decode = decode
         self._target = target
@@ -184,6 +194,11 @@ class Reflector:
                 self._list_and_watch()
             except GoneError:
                 continue  # relist immediately
+            except CollectionAbsentError:
+                # Synced-as-empty; poll slowly for the CRD to appear —
+                # never hammer the apiserver over a missing collection.
+                self._synced.set()
+                self._stop.wait(self._absent_poll_s)
             except Exception:
                 if self._stop.is_set():
                     return
@@ -196,7 +211,7 @@ class Reflector:
         while not self._stop.is_set():
             try:
                 self._watch_once()
-            except GoneError:
+            except (GoneError, CollectionAbsentError):
                 raise
             except (OSError, http.client.HTTPException):
                 if self._stop.is_set():
@@ -250,6 +265,11 @@ class Reflector:
         try:
             conn.request("GET", self._path, headers=self._headers())
             resp = conn.getresponse()
+            if resp.status == 404 and self._tolerate_absent:
+                resp.read()
+                self.relist_count += 1
+                self._target.replace([])
+                raise CollectionAbsentError(self._path)
             if resp.status != 200:
                 raise http.client.HTTPException(f"list {self._path}: {resp.status}")
             body = json.loads(resp.read())
@@ -278,6 +298,8 @@ class Reflector:
             resp = conn.getresponse()
             if resp.status == 410:
                 raise GoneError()
+            if resp.status == 404 and self._tolerate_absent:
+                raise CollectionAbsentError(self._path)
             if resp.status != 200:
                 raise http.client.HTTPException(f"watch {self._path}: {resp.status}")
             while not self._stop.is_set():
@@ -389,21 +411,30 @@ class KubeIngestion:
 SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
-def in_cluster_ingestion(backend, metrics=None, **kw) -> KubeIngestion:
-    """KubeIngestion configured from the pod's serviceaccount — the
-    rest.InClusterConfig slot (what `kube-config-type: in-cluster` selects
-    in the reference, config/config.go + cmd/server.go:57-75)."""
+def in_cluster_config() -> tuple[str, str, str]:
+    """(base_url, ca_file, token_file) from the pod's serviceaccount — the
+    rest.InClusterConfig slot (cmd/server.go:57-75 "in-cluster")."""
     import os
 
     host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
     port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
     if ":" in host and not host.startswith("["):
         host = f"[{host}]"  # IPv6 literal needs brackets in a URL
+    return (
+        f"https://{host}:{port}",
+        f"{SERVICEACCOUNT_DIR}/ca.crt",
+        f"{SERVICEACCOUNT_DIR}/token",
+    )
+
+
+def in_cluster_ingestion(backend, metrics=None, **kw) -> KubeIngestion:
+    """KubeIngestion configured from the pod's serviceaccount."""
+    base_url, ca_file, token_file = in_cluster_config()
     return KubeIngestion(
         backend,
-        f"https://{host}:{port}",
+        base_url,
         metrics=metrics,
-        ca_file=f"{SERVICEACCOUNT_DIR}/ca.crt",
-        token_file=f"{SERVICEACCOUNT_DIR}/token",
+        ca_file=ca_file,
+        token_file=token_file,
         **kw,
     )

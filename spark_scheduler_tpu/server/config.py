@@ -69,6 +69,10 @@ class InstallConfig:
     # dev apiservers). NEVER the default: without it, https endpoints are
     # verified against system CAs (or the serviceaccount CA in-cluster).
     kube_api_insecure_skip_tls_verify: bool = False
+    # Client-side rate limit for apiserver writes/reads (reference config
+    # qps/burst, config/config.go:30-31).
+    kube_api_qps: float = 5.0
+    kube_api_burst: int = 10
     # Per-connection socket read timeout (extender protocol budget is 30 s,
     # examples/extender.yml:59).
     request_timeout_s: float = 30.0
@@ -134,6 +138,8 @@ class InstallConfig:
             kube_api_insecure_skip_tls_verify=bool(
                 raw.get("kube-api-insecure-skip-tls-verify", False)
             ),
+            kube_api_qps=float(raw.get("qps", 5.0)),
+            kube_api_burst=int(raw.get("burst", 10)),
             request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
             debug_routes=bool(raw.get("debug-routes", False)),
         )
