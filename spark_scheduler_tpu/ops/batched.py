@@ -83,7 +83,7 @@ class BatchedPacking(NamedTuple):
     available_after: jnp.ndarray  # [N, 3] i32 — availability after all admits
 
 
-@partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
+@partial(jax.jit, static_argnames=("fill", "emax", "num_zones", "unroll"))
 def batched_fifo_pack(
     cluster: ClusterTensors,
     apps: AppBatch,
@@ -91,6 +91,7 @@ def batched_fifo_pack(
     fill: str = "tightly-pack",
     emax: int,
     num_zones: int,
+    unroll: int = 2,
 ) -> BatchedPacking:
     """Admit a FIFO queue of gang requests in one compiled program.
 
@@ -201,6 +202,13 @@ def batched_fifo_pack(
             apps.skippable,
         )
         + extra,
+        # The step body is tiny relative to loop-trip overhead at 10k nodes
+        # (~100 us/step, overhead-bound); unroll=2 lets XLA fuse step pairs
+        # for a measurably lower window service time on TPU v5e. Higher
+        # unrolls regress, and so does unrolling under vmap (grouped_fifo_pack
+        # passes unroll=1). Results are unchanged — unrolling only
+        # restructures the loop.
+        unroll=unroll,
     )
     return BatchedPacking(
         driver_node=drivers,
