@@ -151,6 +151,7 @@ def main(argv=None) -> int:
             ca_file=ca_file,
             token_file=token_file,
             insecure_skip_tls_verify=config.kube_api_insecure_skip_tls_verify,
+            metrics=registry,
         )
         backend.start()  # initial CR list + watch
         kube_backend = True

@@ -88,7 +88,12 @@ class RestClient:
         token_file: Optional[str] = None,
         insecure_skip_tls_verify: bool = False,
         timeout_s: float = 10.0,
+        metrics=None,
     ):
+        # `metrics`: a MetricRegistry; each request records a latency
+        # histogram tagged by verb + outcome family (the reference's
+        # client-latency adapters, internal/metrics/metrics.go:253-297).
+        self._metrics = metrics
         parsed = urlparse(base_url)
         self._host = parsed.hostname or "127.0.0.1"
         self._tls = parsed.scheme == "https"
@@ -127,6 +132,8 @@ class RestClient:
     def request(self, method: str, path: str, payload: Optional[dict] = None):
         if self._limiter is not None:
             self._limiter.acquire()
+        start = time.perf_counter()
+        status = 0
         conn = self._connect()
         try:
             conn.request(
@@ -138,9 +145,16 @@ class RestClient:
             resp = conn.getresponse()
             raw = resp.read()
             body = json.loads(raw) if raw else {}
-            return resp.status, body
+            status = resp.status
+            return status, body
         finally:
             conn.close()
+            if self._metrics is not None:
+                self._metrics.histogram(
+                    "foundry.spark.scheduler.kubeclient.request",
+                    verb=method,
+                    family=f"{status // 100}xx" if status else "error",
+                ).update(time.perf_counter() - start)
 
 
 def _raise_for_status(status: int, body: dict, context: str) -> None:
@@ -169,6 +183,7 @@ class KubeBackend(InMemoryBackend):
         insecure_skip_tls_verify: bool = False,
         watch: bool = True,
         watch_timeout_s: float = 30.0,
+        metrics=None,
     ):
         super().__init__()
         self._crds.clear()  # the apiserver's CRD registry is authoritative
@@ -179,6 +194,7 @@ class KubeBackend(InMemoryBackend):
             ca_file=ca_file,
             token_file=token_file,
             insecure_skip_tls_verify=insecure_skip_tls_verify,
+            metrics=metrics,
         )
         self._base_url = base_url
         self._watch = watch
