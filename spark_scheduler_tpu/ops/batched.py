@@ -43,8 +43,19 @@ from spark_scheduler_tpu.ops.packing import (
     _check_cumsum_bound,
     _rank_of_position,
     pack_one_app,
+    pack_one_app_single_az,
+    single_az_orders,
 )
 from spark_scheduler_tpu.ops.sorting import priority_order, zone_ranks
+
+# Single-AZ strategies run the per-zone pack + efficiency-scored zone pick
+# inside the scan step; az-aware additionally computes the plain fallback
+# (az_aware_pack_tightly.go:27-38). Values are the inner executor fill.
+_SINGLE_AZ_INNER = {
+    "single-az-tightly-pack": "tightly-pack",
+    "single-az-minimal-fragmentation": "minimal-fragmentation",
+    "az-aware-tightly-pack": "tightly-pack",
+}
 
 
 class AppBatch(NamedTuple):
@@ -71,6 +82,19 @@ class AppBatch(NamedTuple):
     skippable: jnp.ndarray  # [B] bool — FIFO age-based skip (resource.go:260-270)
     driver_cand: jnp.ndarray | None = None  # [B, N] bool — kube candidate list
     domain: jnp.ndarray | None = None  # [B, N] bool — node-affinity domain
+    # Segmented WINDOW mode (both set together; core/solver.py pack_window
+    # is the caller): each serving request is a segment of rows (its
+    # FIFO-earlier drivers, then itself). `reset` marks a segment's first
+    # row — availability rewinds to the committed base; `commit` marks the
+    # request row — its admission persists into the base. Hypothetical
+    # (non-commit) rows subtract only within their segment, replicating the
+    # reference's fitEarlierDrivers exactly — INCLUDING its double-count of
+    # an admitted-but-still-unbound earlier driver (usage already carries
+    # its reservation AND it is re-packed hypothetically,
+    # resource.go:221-258 + GetReservedResources) — so windowed == solo
+    # serving, decision for decision.
+    commit: jnp.ndarray | None = None  # [B] bool
+    reset: jnp.ndarray | None = None  # [B] bool
 
 
 class BatchedPacking(NamedTuple):
@@ -100,11 +124,20 @@ def batched_fifo_pack(
     non-skippable valid app fails to pack, every later app is rejected
     (`failure-earlier-driver`, resource.go:241-249) but its hypothetical
     packing is still reported in `packed` for demand creation.
+
+    All six strategies batch: the single-AZ wrappers run their per-zone
+    pack + efficiency-scored zone pick (single_az.go:23-97) INSIDE the scan
+    step (VERDICT r2 #2), with the zone efficiencies always computed against
+    the then-current availability.
     """
-    fill_fn = _FILLS[fill]
+    single_az = fill in _SINGLE_AZ_INNER
+    az_fallback = fill == "az-aware-tightly-pack"
+    fill_fn = _FILLS[_SINGLE_AZ_INNER.get(fill, fill)]
+    include_exec_in_reserved = _SINGLE_AZ_INNER.get(fill) != "minimal-fragmentation"
     n = cluster.available.shape[0]
     _check_cumsum_bound(n, emax)
 
+    segmented = apps.commit is not None
     masked = apps.driver_cand is not None or apps.domain is not None
     if not masked:
         # Queue mode: shared eligibility, orders fixed from the starting
@@ -121,6 +154,10 @@ def batched_fifo_pack(
             cluster, exec_elig0, zrank0, cluster.label_rank_executor
         )
         d_rank0 = _rank_of_position(d_order0)
+        if single_az:
+            zone_orders0 = single_az_orders(
+                cluster, driver_elig0, exec_elig0, zrank0, num_zones
+            )
 
     if masked:
         b = apps.driver_req.shape[0]
@@ -133,8 +170,17 @@ def batched_fifo_pack(
         extra = ()
 
     def step(carry, app):
-        avail, blocked = carry
-        driver_req, exec_req, count, valid, skippable, *masks = app
+        if segmented:
+            base, avail, blocked = carry
+            (driver_req, exec_req, count, valid, skippable,
+             commit, reset, *masks) = app
+            # Segment boundary: rewind to the committed base; FIFO blocking
+            # is segment-local (each request's solo solve starts unblocked).
+            avail = jnp.where(reset, base, avail)
+            blocked = jnp.where(reset, jnp.bool_(False), blocked)
+        else:
+            avail, blocked = carry
+            driver_req, exec_req, count, valid, skippable, *masks = app
         cand_i, dom_i = masks if masked else (None, None)
         # A gang larger than the static slot padding cannot be represented —
         # reject it outright rather than silently truncating it. Callers
@@ -160,14 +206,40 @@ def batched_fifo_pack(
                 available=avail,
             )
             d_rank = _rank_of_position(d_order)
+            if single_az:
+                zone_orders = single_az_orders(
+                    cluster, driver_elig, exec_elig, zrank, num_zones,
+                    available=avail,
+                )
         else:
             driver_elig, exec_elig = driver_elig0, exec_elig0
             d_order, d_rank, e_order = d_order0, d_rank0, e_order0
+            if single_az:
+                zone_orders = zone_orders0
 
-        driver_node, one_hot, exec_nodes, ok = pack_one_app(
-            avail, exec_elig, driver_elig, d_order, d_rank, e_order,
-            driver_req, exec_req, count, fill_fn, emax,
-        )
+        if single_az:
+            driver_node, one_hot, exec_nodes, ok = pack_one_app_single_az(
+                cluster.zone_id, cluster.schedulable, avail,
+                driver_elig, exec_elig, d_rank, *zone_orders,
+                driver_req, exec_req, count, fill_fn, emax, num_zones,
+                include_executors_in_reserved=include_exec_in_reserved,
+            )
+            if az_fallback:
+                # az-aware: plain tightly-pack when no single zone fits
+                # (az_aware_pack_tightly.go:27-38).
+                p_driver, p_hot, p_execs, p_ok = pack_one_app(
+                    avail, exec_elig, driver_elig, d_order, d_rank, e_order,
+                    driver_req, exec_req, count, fill_fn, emax,
+                )
+                driver_node = jnp.where(ok, driver_node, p_driver)
+                one_hot = jnp.where(ok, one_hot, p_hot)
+                exec_nodes = jnp.where(ok, exec_nodes, p_execs)
+                ok = ok | p_ok
+        else:
+            driver_node, one_hot, exec_nodes, ok = pack_one_app(
+                avail, exec_elig, driver_elig, d_order, d_rank, e_order,
+                driver_req, exec_req, count, fill_fn, emax,
+            )
 
         packed = ok & valid & ~too_big
         admitted = packed & ~blocked
@@ -181,7 +253,7 @@ def batched_fifo_pack(
         delta = exec_counts[:, None] * exec_req[None, :] + jnp.where(
             one_hot, driver_req[None, :], 0
         )
-        avail = jnp.where(admitted, avail - delta.astype(avail.dtype), avail)
+        new_avail = jnp.where(admitted, avail - delta.astype(avail.dtype), avail)
 
         # Strict FIFO: a non-skippable valid failure blocks the rest
         # (resource.go:241-249).
@@ -189,19 +261,31 @@ def batched_fifo_pack(
 
         out_driver = jnp.where(admitted, driver_node, -1).astype(jnp.int32)
         out_execs = jnp.where(admitted, exec_nodes, -1).astype(jnp.int32)
-        return (avail, blocked), (out_driver, out_execs, admitted, packed)
+        if segmented:
+            base = jnp.where(
+                admitted & commit, base - delta.astype(base.dtype), base
+            )
+            new_carry = (base, new_avail, blocked)
+        else:
+            new_carry = (new_avail, blocked)
+        return new_carry, (out_driver, out_execs, admitted, packed)
 
-    (avail_after, _), (drivers, execs, admitted, packed) = jax.lax.scan(
+    xs = (
+        apps.driver_req,
+        apps.exec_req,
+        apps.exec_count,
+        apps.app_valid,
+        apps.skippable,
+    )
+    if segmented:
+        xs = xs + (apps.commit, apps.reset)
+        init = (cluster.available, cluster.available, jnp.bool_(False))
+    else:
+        init = (cluster.available, jnp.bool_(False))
+    final_carry, (drivers, execs, admitted, packed) = jax.lax.scan(
         step,
-        (cluster.available, jnp.bool_(False)),
-        (
-            apps.driver_req,
-            apps.exec_req,
-            apps.exec_count,
-            apps.app_valid,
-            apps.skippable,
-        )
-        + extra,
+        init,
+        xs + extra,
         # The step body is tiny relative to loop-trip overhead at 10k nodes
         # (~100 us/step, overhead-bound); unroll=2 lets XLA fuse step pairs
         # for a measurably lower window service time on TPU v5e. Higher
@@ -210,6 +294,7 @@ def batched_fifo_pack(
         # restructures the loop.
         unroll=unroll,
     )
+    avail_after = final_carry[0]
     return BatchedPacking(
         driver_node=drivers,
         executor_nodes=execs,
@@ -228,6 +313,8 @@ def make_app_batch(
     skippable=None,
     driver_cand=None,  # [B,N] bool — per-app kube candidate masks
     domain=None,  # [B,N] bool — per-app node-affinity domains
+    commit=None,  # [B] bool — window mode: request rows (persist into base)
+    reset=None,  # [B] bool — window mode: segment-start rows
 ) -> AppBatch:
     """Host helper: pad a queue to a bucketed batch size. Padding rows get
     all-False masks (they are already app_valid=False)."""
@@ -251,6 +338,17 @@ def make_app_batch(
         m = np.asarray(m, bool)
         return np.pad(m, ((0, pad - b), (0, 0)))
 
+    def _pad_vec(v, fill=0, dtype=None):
+        if v is None:
+            return None
+        v = np.asarray(v, dtype)
+        return np.pad(v, (0, pad - b), constant_values=fill)
+
+    window = commit is not None or reset is not None
+    if window and (commit is None or reset is None):
+        # Partial window args would silently mis-default (a commit default of
+        # True on hypothetical rows would double-subtract them) — refuse.
+        raise ValueError("window mode requires commit AND reset together")
     return AppBatch(
         driver_req=np.pad(driver_reqs, ((0, pad - b), (0, 0))),
         exec_req=np.pad(exec_reqs, ((0, pad - b), (0, 0))),
@@ -259,4 +357,6 @@ def make_app_batch(
         skippable=np.pad(skippable, (0, pad - b)),
         driver_cand=_pad_mask(driver_cand),
         domain=_pad_mask(domain),
+        commit=_pad_vec(commit, fill=False, dtype=bool),
+        reset=_pad_vec(reset, fill=False, dtype=bool),
     )

@@ -117,21 +117,42 @@ def avg_packing_efficiency(
     single-AZ zone selection — only see the driver's tentative reservation
     for that strategy. The ENTRIES averaged over are still driver + one per
     executor occurrence (single_az.go:84-97) in both modes."""
-    n = cluster.available.shape[0]
+    return avg_packing_efficiency_arrays(
+        cluster.schedulable,
+        cluster.available,
+        driver_node,
+        executor_nodes,
+        driver_req,
+        exec_req,
+        include_executors_in_reserved=include_executors_in_reserved,
+    )
+
+
+def avg_packing_efficiency_arrays(
+    schedulable: jnp.ndarray,  # [N,3] i32
+    available: jnp.ndarray,  # [N,3] i32 — CURRENT availability
+    driver_node: jnp.ndarray,
+    executor_nodes: jnp.ndarray,
+    driver_req: jnp.ndarray,
+    exec_req: jnp.ndarray,
+    *,
+    include_executors_in_reserved: bool = True,
+) -> AvgEfficiency:
+    """Array-based core of `avg_packing_efficiency`: callers that thread a
+    mutated availability (the batched FIFO scan admits apps between zone
+    scorings) pass it directly instead of rebuilding ClusterTensors."""
     new_res = new_reservation_tensor(
-        n,
+        schedulable.shape[0],
         driver_node,
         jnp.where(include_executors_in_reserved, executor_nodes, -1),
         driver_req,
         exec_req,
     )
     # schedulable - available = current reservation usage (efficiency.go:85-92).
-    reserved_total = (cluster.schedulable - cluster.available) + new_res
-    denom = jnp.where(cluster.schedulable == 0, 1, cluster.schedulable).astype(
-        jnp.float32
-    )
+    reserved_total = (schedulable - available) + new_res
+    denom = jnp.where(schedulable == 0, 1, schedulable).astype(jnp.float32)
     eff = reserved_total.astype(jnp.float32) / denom  # [N,3]
-    gpu_node = cluster.schedulable[:, GPU_DIM] != 0
+    gpu_node = schedulable[:, GPU_DIM] != 0
     eff_gpu = jnp.where(gpu_node, eff[:, GPU_DIM], 0.0)
     node_max = jnp.maximum(eff_gpu, jnp.maximum(eff[:, CPU_DIM], eff[:, MEM_DIM]))
 

@@ -277,6 +277,111 @@ def spark_bin_pack(
     )
 
 
+def single_az_orders(
+    cluster,
+    driver_elig: jnp.ndarray,  # [N] bool
+    exec_elig: jnp.ndarray,  # [N] bool
+    zrank: jnp.ndarray,  # [num_zones] i32
+    num_zones: int,
+    available: jnp.ndarray | None = None,
+):
+    """Per-zone priority orders for the single-AZ packers: restrict each
+    eligibility vector to one zone and sort (what spark_bin_pack does
+    internally when called with zone-masked masks, single_az.go:44-56).
+    Returns ([Z,N] driver orders, [Z,N] driver ranks, [Z,N] exec orders)."""
+    zmask_all = cluster.zone_id[None, :] == jnp.arange(num_zones, dtype=jnp.int32)[:, None]
+    d_elig_z = driver_elig[None, :] & zmask_all
+    e_elig_z = exec_elig[None, :] & zmask_all
+    d_order_z = jax.vmap(
+        lambda e: priority_order(
+            cluster, e, zrank, cluster.label_rank_driver, available=available
+        )[0]
+    )(d_elig_z)
+    e_order_z = jax.vmap(
+        lambda e: priority_order(
+            cluster, e, zrank, cluster.label_rank_executor, available=available
+        )[0]
+    )(e_elig_z)
+    d_rank_z = jax.vmap(_rank_of_position)(d_order_z)
+    return d_elig_z, e_elig_z, d_order_z, d_rank_z, e_order_z
+
+
+def pack_one_app_single_az(
+    zone_id: jnp.ndarray,  # [N] i32
+    schedulable: jnp.ndarray,  # [N,3] i32
+    avail: jnp.ndarray,  # [N,3] i32 — CURRENT availability
+    driver_elig: jnp.ndarray,  # [N] bool (domain & candidates & valid)
+    exec_elig: jnp.ndarray,  # [N] bool
+    d_rank_global: jnp.ndarray,  # [N] i32 — rank in the FULL driver order
+    d_elig_z,  # [Z,N] bool
+    e_elig_z,  # [Z,N] bool
+    d_order_z,  # [Z,N] i32
+    d_rank_z,  # [Z,N] i32
+    e_order_z,  # [Z,N] i32
+    driver_req: jnp.ndarray,  # [3] i32
+    exec_req: jnp.ndarray,  # [3] i32
+    count: jnp.ndarray,  # i32 scalar
+    fill_fn,
+    emax: int,
+    num_zones: int,
+    include_executors_in_reserved: bool,
+):
+    """Single-AZ gang pack against a given availability (single_az.go:23-97):
+    pack every zone (vmapped pack_one_app over zone-restricted orders), keep
+    feasible zones, pick the best average packing efficiency — strictly-
+    greater replacement, so the earliest zone (by first appearance in driver
+    priority order) wins ties. Shared by the standalone `_single_az_pack`
+    and the batched FIFO scan body (ops/batched.py) so their semantics
+    cannot diverge.
+
+    Returns (driver_node, driver_one_hot[N,1], exec_nodes[Emax], ok)."""
+    # Zone first-appearance rank in driver priority order (single_az.go:58-73).
+    zone_first = jnp.full(num_zones, INT32_INF, jnp.int32).at[zone_id].min(
+        jnp.where(driver_elig, d_rank_global, INT32_INF)
+    )
+    # Zones with no executor-order nodes are skipped (single_az.go:40-43).
+    zone_has_exec = jnp.zeros(num_zones, jnp.bool_).at[zone_id].max(exec_elig)
+
+    def one(d_e, e_e, d_o, d_r, e_o):
+        return pack_one_app(
+            avail, e_e, d_e, d_o, d_r, e_o, driver_req, exec_req, count,
+            fill_fn, emax,
+        )
+
+    drivers, one_hots, exec_nodes, oks = jax.vmap(one)(
+        d_elig_z, e_elig_z, d_order_z, d_rank_z, e_order_z
+    )
+
+    effs = jax.vmap(
+        lambda dn, en: eff_ops.avg_packing_efficiency_arrays(
+            schedulable,
+            avail,
+            dn,
+            en,
+            driver_req,
+            exec_req,
+            # minimalFragmentation never adds executors to reservedResources
+            # in the reference, so its zone scores are driver-only (see
+            # efficiency.avg_packing_efficiency docstring).
+            include_executors_in_reserved=include_executors_in_reserved,
+        ).max
+    )(drivers, exec_nodes)
+    valid_zone = oks & (zone_first < INT32_INF) & zone_has_exec
+    effs = jnp.where(valid_zone, effs, -jnp.inf)
+    best_eff = jnp.max(effs)
+    # chooseBestResult starts from WorstAvgPackingEfficiency (Max=0.0) and
+    # replaces only on strictly-greater, so a zone whose best efficiency is
+    # exactly 0.0 is rejected entirely (single_az.go:84-97).
+    any_valid = jnp.any(valid_zone) & (best_eff > 0.0)
+    tie = valid_zone & (effs == best_eff)
+    best_zone = jnp.argmin(jnp.where(tie, zone_first, INT32_INF))
+
+    driver_node = jnp.where(any_valid, drivers[best_zone], -1).astype(jnp.int32)
+    execs = jnp.where(any_valid, exec_nodes[best_zone], -1).astype(jnp.int32)
+    one_hot = one_hots[best_zone] & any_valid
+    return driver_node, one_hot, execs, any_valid
+
+
 @partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
 def _single_az_pack(
     cluster,
@@ -299,63 +404,30 @@ def _single_az_pack(
     d_order, _ = priority_order(cluster, driver_elig, zrank, cluster.label_rank_driver)
     d_rank = _rank_of_position(d_order)
 
-    # Zone first-appearance rank in driver priority order (single_az.go:58-73).
-    zone_first = jnp.full(num_zones, INT32_INF, jnp.int32).at[cluster.zone_id].min(
-        jnp.where(driver_elig, d_rank, INT32_INF)
+    d_elig_z, e_elig_z, d_order_z, d_rank_z, e_order_z = single_az_orders(
+        cluster, driver_elig, exec_elig, zrank, num_zones
     )
-    # Zones with no executor-order nodes are skipped (single_az.go:40-43).
-    zone_has_exec = jnp.zeros(num_zones, jnp.bool_).at[cluster.zone_id].max(exec_elig)
-
-    def pack_zone(z):
-        zmask = cluster.zone_id == z
-        return spark_bin_pack(
-            cluster,
-            driver_req,
-            exec_req,
-            count,
-            driver_candidate_mask & zmask,
-            domain_mask & zmask,
-            fill=fill,
-            emax=emax,
-            num_zones=num_zones,
-            zrank=zrank,
-        )
-
-    packs = jax.vmap(pack_zone)(jnp.arange(num_zones, dtype=jnp.int32))
-
-    effs = jax.vmap(
-        lambda p: eff_ops.avg_packing_efficiency(
-            cluster,
-            p.driver_node,
-            p.executor_nodes,
-            driver_req,
-            exec_req,
-            # minimalFragmentation never adds executors to reservedResources
-            # in the reference, so its zone scores are driver-only (see
-            # efficiency.avg_packing_efficiency docstring).
-            include_executors_in_reserved=(fill != "minimal-fragmentation"),
-        ).max
-    )(packs)
-    valid_zone = packs.has_capacity & (zone_first < INT32_INF) & zone_has_exec
-    effs = jnp.where(valid_zone, effs, -jnp.inf)
-    best_eff = jnp.max(effs)
-    # chooseBestResult starts from WorstAvgPackingEfficiency (Max=0.0) and
-    # replaces only on strictly-greater, so a zone whose best efficiency is
-    # exactly 0.0 is rejected entirely (single_az.go:84-97).
-    any_valid = jnp.any(valid_zone) & (best_eff > 0.0)
-    # Strictly-greater replacement in the reference => earliest zone (by
-    # first appearance in driver order) wins ties (single_az.go:84-97).
-    tie = valid_zone & (effs == best_eff)
-    best_zone = jnp.argmin(jnp.where(tie, zone_first, INT32_INF))
-
-    chosen = jax.tree_util.tree_map(lambda x: x[best_zone], packs)
-    return Packing(
-        driver_node=jnp.where(any_valid, chosen.driver_node, -1).astype(jnp.int32),
-        executor_nodes=jnp.where(any_valid, chosen.executor_nodes, -1).astype(
-            jnp.int32
-        ),
-        has_capacity=any_valid & chosen.has_capacity,
+    driver_node, _, execs, ok = pack_one_app_single_az(
+        cluster.zone_id,
+        cluster.schedulable,
+        cluster.available,
+        driver_elig,
+        exec_elig,
+        d_rank,
+        d_elig_z,
+        e_elig_z,
+        d_order_z,
+        d_rank_z,
+        e_order_z,
+        driver_req,
+        exec_req,
+        count,
+        _FILLS[fill],
+        emax,
+        num_zones,
+        include_executors_in_reserved=(fill != "minimal-fragmentation"),
     )
+    return Packing(driver_node=driver_node, executor_nodes=execs, has_capacity=ok)
 
 
 # ---------------------------------------------------------------------------

@@ -93,6 +93,26 @@ def _new_id(bits: int = 64) -> str:
     return secrets.token_hex(bits // 8)
 
 
+class _AttachedContext:
+    """Adopt an EXISTING span as another thread's current span: children
+    created inside join its trace; the span itself is NOT finished on exit
+    (its owner finishes it). Used by the predicate batcher to carry the
+    handler thread's b3 context onto the dispatcher thread."""
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> "_AttachedContext":
+        self._tracer._push(self.span)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self.span:
+            stack.pop()
+
+
 class Tracer:
     """Thread-local span stack + bounded finished-span ring buffer."""
 
@@ -140,6 +160,10 @@ class Tracer:
             s = Span(name, _new_id(128), _new_id(), None)
         s.tags.update(tags)
         return _SpanContext(self, s)
+
+    def attach(self, span: Span) -> _AttachedContext:
+        """Adopt `span` as this thread's current span (see _AttachedContext)."""
+        return _AttachedContext(self, span)
 
     def root_from_headers(self, headers, name: str, **tags) -> _SpanContext:
         """Continue a b3-propagated trace (witchcraft middleware slot).

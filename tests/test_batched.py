@@ -173,6 +173,185 @@ def test_masked_batch_matches_sequential_spark_bin_pack(fill, seed):
     )
 
 
+from spark_scheduler_tpu.ops.batched import _SINGLE_AZ_INNER as _AZ_INNER
+
+AZ_STRATEGIES = sorted(_AZ_INNER)
+
+
+def greedy_single_az_candidates(
+    avail, sched, zone, d_order, e_order, dreq, ereq, count, strategy
+):
+    """All reference-acceptable single-AZ outcomes for one app against the
+    given availability and FIXED priority orders (single_az.go:23-97): the
+    per-zone greedy results whose avg efficiency is within float32 tie
+    distance of the best. Returns (acceptable [(driver, execs)], packed)."""
+    inner = _AZ_INNER[strategy]
+    zones_in_order = []
+    for i in d_order:
+        if zone[i] not in zones_in_order:
+            zones_in_order.append(zone[i])
+    results = []
+    for z in zones_in_order:
+        d_o = [i for i in d_order if zone[i] == z]
+        e_o = [i for i in e_order if zone[i] == z]
+        if not e_o:
+            continue
+        d, ex, ok, _ = G.greedy_spark_bin_pack(
+            avail, dreq, ereq, count, d_o, e_o, inner
+        )
+        if not ok:
+            continue
+        eff = G.greedy_avg_efficiency(
+            avail, sched, d, ex, dreq, ereq,
+            include_executors_in_reserved=(inner != "minimal-fragmentation"),
+        )
+        if eff > 0.0:
+            results.append((eff, d, list(ex)))
+    if results:
+        best = max(r[0] for r in results)
+        acceptable = [(d, ex) for eff, d, ex in results if eff >= best - 1e-5]
+        return acceptable, True
+    if strategy == "az-aware-tightly-pack":
+        d, ex, ok, _ = G.greedy_spark_bin_pack(
+            avail, dreq, ereq, count, d_order, e_order, "tightly-pack"
+        )
+        if ok:
+            return [(d, list(ex))], True
+    return [], False
+
+
+@pytest.mark.parametrize("strategy", AZ_STRATEGIES)
+@pytest.mark.parametrize("seed", [0, 1])
+def test_batched_single_az_matches_sequential_oracle(strategy, seed):
+    """Queue mode: the batched single-AZ scan must per-step produce a
+    reference-acceptable zone pick against the mutating availability, with
+    orders fixed from the start (fitEarlierDrivers semantics). On float32
+    efficiency near-ties, the oracle follows the kernel's choice."""
+    rng = np.random.default_rng(seed)
+    c = random_cluster(rng, 40)
+    apps = random_apps(rng, 12, pad_to=16)
+    got = batched_fifo_pack(c, apps, fill=strategy, emax=EMAX, num_zones=NUM_ZONES)
+
+    avail = np.asarray(c.available).astype(np.int64).copy()
+    sched = np.asarray(c.schedulable).astype(np.int64)
+    zone = np.asarray(c.zone_id)
+    valid = np.asarray(c.valid)
+    e_elig = valid & ~np.asarray(c.unschedulable) & np.asarray(c.ready)
+    d_order, e_order = oracle_orders(c, e_elig, valid)
+    blocked = False
+    for i in range(len(apps.app_valid)):
+        dreq = np.asarray(apps.driver_req[i], np.int64)
+        ereq = np.asarray(apps.exec_req[i], np.int64)
+        too_big = int(apps.exec_count[i]) > EMAX
+        count = int(min(apps.exec_count[i], EMAX))
+        acceptable, ok = greedy_single_az_candidates(
+            avail, sched, zone, d_order, e_order, dreq, ereq, count, strategy
+        )
+        packed = ok and bool(apps.app_valid[i]) and not too_big
+        admitted = packed and not blocked
+        assert bool(got.packed[i]) == packed, f"app {i} packed"
+        assert bool(got.admitted[i]) == admitted, f"app {i} admitted"
+        drv = int(got.driver_node[i])
+        execs = [int(x) for x in np.asarray(got.executor_nodes[i]) if x >= 0]
+        if admitted:
+            assert (drv, execs) in acceptable, (
+                f"app {i}: kernel pick {(drv, execs)} not reference-acceptable "
+                f"{acceptable}"
+            )
+            avail[drv] -= dreq
+            for nd in execs:
+                avail[nd] -= ereq
+        else:
+            assert drv == -1 and not execs, f"app {i} must be unplaced"
+        if bool(apps.app_valid[i]) and not packed and not bool(apps.skippable[i]):
+            blocked = True
+    live = np.asarray(c.valid)
+    np.testing.assert_array_equal(
+        np.asarray(got.available_after)[live], avail.astype(np.int32)[live]
+    )
+
+
+@pytest.mark.parametrize("strategy", AZ_STRATEGIES)
+def test_masked_batch_single_az_matches_standalone(strategy):
+    """Masked (serving) mode: each row of the batched single-AZ solve must
+    match a standalone BINPACK_FUNCTIONS[strategy] call with the same masks
+    against the then-current availability (float32 efficiency near-ties
+    resolved in the kernel's favor, as test_single_az_matches_oracle)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from spark_scheduler_tpu.ops import BINPACK_FUNCTIONS
+
+    rng = np.random.default_rng(9)
+    c = random_cluster(rng, 40)
+    n = np.asarray(c.available).shape[0]
+    b = 10
+    driver = rng.integers(1, 6, size=(b, 3)).astype(np.int32)
+    driver[:, 2] = rng.integers(0, 2, size=b)
+    execs = rng.integers(1, 8, size=(b, 3)).astype(np.int32)
+    execs[:, 2] = rng.integers(0, 2, size=b)
+    counts = rng.integers(0, EMAX + 1, size=b).astype(np.int32)
+    skip = rng.random(b) < 0.3
+    dcand, dom = random_masks(rng, b, n)
+    apps = make_app_batch(
+        driver, execs, counts, skippable=skip, driver_cand=dcand, domain=dom,
+        pad_to=16,
+    )
+    got = batched_fifo_pack(c, apps, fill=strategy, emax=EMAX, num_zones=NUM_ZONES)
+
+    avail = np.asarray(c.available).copy()
+    sched = np.asarray(c.schedulable).astype(np.int64)
+    zone = np.asarray(c.zone_id)
+    blocked = False
+    for i in range(b):
+        ci = dataclasses.replace(c, available=jnp.asarray(avail))
+        p = BINPACK_FUNCTIONS[strategy](
+            ci,
+            jnp.asarray(apps.driver_req[i]),
+            jnp.asarray(apps.exec_req[i]),
+            jnp.int32(int(apps.exec_count[i])),
+            jnp.asarray(apps.driver_cand[i]),
+            jnp.asarray(apps.domain[i]),
+            emax=EMAX,
+            num_zones=NUM_ZONES,
+        )
+        packed = bool(p.has_capacity)
+        admitted = packed and not blocked
+        assert bool(got.packed[i]) == packed, f"app {i} packed"
+        assert bool(got.admitted[i]) == admitted, f"app {i} admitted"
+        drv = int(got.driver_node[i])
+        got_execs = [int(x) for x in np.asarray(got.executor_nodes[i]) if x >= 0]
+        if admitted:
+            want_drv = int(p.driver_node)
+            want_execs = [int(x) for x in np.asarray(p.executor_nodes) if x >= 0]
+            if (drv, got_execs) != (want_drv, want_execs):
+                # Different zone on a float32 efficiency tie: both picks must
+                # score within tolerance.
+                inner = _AZ_INNER[strategy]
+                incl = inner != "minimal-fragmentation"
+                eff_got = G.greedy_avg_efficiency(
+                    avail.astype(np.int64), sched, drv, got_execs,
+                    np.asarray(apps.driver_req[i], np.int64),
+                    np.asarray(apps.exec_req[i], np.int64),
+                    include_executors_in_reserved=incl,
+                )
+                eff_want = G.greedy_avg_efficiency(
+                    avail.astype(np.int64), sched, want_drv, want_execs,
+                    np.asarray(apps.driver_req[i], np.int64),
+                    np.asarray(apps.exec_req[i], np.int64),
+                    include_executors_in_reserved=incl,
+                )
+                assert abs(eff_got - eff_want) < 1e-5, (
+                    f"app {i}: {(drv, got_execs)} vs {(want_drv, want_execs)}"
+                )
+            avail[drv] -= np.asarray(apps.driver_req[i])
+            for nd in got_execs:
+                avail[nd] -= np.asarray(apps.exec_req[i])
+        if bool(apps.app_valid[i]) and not packed and not bool(apps.skippable[i]):
+            blocked = True
+
+
 def test_masked_sharded_matches_unsharded():
     """Per-step sorts + masks must survive GSPMD node-axis sharding."""
     rng = np.random.default_rng(17)

@@ -1,0 +1,335 @@
+"""Windowed serving (VERDICT r2 #1): coalesced /predicates windows must make
+exactly the decisions sequential serving makes.
+
+Three layers:
+  - ops: the segmented scan (commit/reset/dup rows) vs per-segment masked
+    solves threaded host-side;
+  - extender: predicate_batch vs predicate-one-at-a-time on identical
+    clusters, including FIFO blocking, failures, and single-AZ strategies;
+  - server: concurrent HTTP clients are actually batched (window > 1) and
+    produce a consistent reservation state.
+"""
+
+import copy
+import dataclasses
+import json
+import http.client
+import threading
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.core.extender import ExtenderArgs
+from spark_scheduler_tpu.ops.batched import batched_fifo_pack, make_app_batch
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+from tests.test_packing_golden import random_cluster
+
+EMAX = 8
+NUM_ZONES = 4
+
+
+# --------------------------------------------------------------------- ops
+
+
+def _random_segments(rng, n_requests, n):
+    """Synthesized window: each request has 0-3 hypothetical earlier rows
+    plus its own (committing) row."""
+    segments = []
+    for _ in range(n_requests):
+        rows = []
+        for _ in range(int(rng.integers(0, 4))):
+            rows.append(
+                (
+                    rng.integers(1, 4, size=3).astype(np.int32),
+                    rng.integers(1, 5, size=3).astype(np.int32),
+                    int(rng.integers(1, EMAX + 1)),
+                    bool(rng.random() < 0.3),
+                )
+            )
+        rows.append(
+            (
+                rng.integers(1, 4, size=3).astype(np.int32),
+                rng.integers(1, 5, size=3).astype(np.int32),
+                int(rng.integers(1, EMAX + 1)),
+                False,
+            )
+        )
+        cand = rng.random(n) < 0.8
+        dom = rng.random(n) < 0.9
+        segments.append({"rows": rows, "cand": cand, "dom": dom})
+    return segments
+
+
+def _flatten_segments(segments, n):
+    flat, commit, reset, cands, doms = [], [], [], [], []
+    real_row_of = []
+    for seg in segments:
+        for j, row in enumerate(seg["rows"]):
+            flat.append(row)
+            commit.append(j == len(seg["rows"]) - 1)
+            reset.append(j == 0)
+            cands.append(seg["cand"])
+            doms.append(seg["dom"])
+        real_row_of.append(len(flat) - 1)
+    return flat, commit, reset, cands, doms, real_row_of
+
+
+@pytest.mark.parametrize("fill", ["tightly-pack", "az-aware-tightly-pack"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_segmented_scan_matches_per_segment_solves(fill, seed):
+    """The segmented window scan == solving each segment as its own masked
+    batch against the threaded base availability (exactly the sequential
+    serving semantics pack_window encodes)."""
+    rng = np.random.default_rng(seed)
+    c = random_cluster(rng, 32)
+    n = 32
+    segments = _random_segments(rng, 5, n)
+    flat, commit, reset, cands, doms, real_row_of = _flatten_segments(
+        segments, n
+    )
+    apps = make_app_batch(
+        np.stack([r[0] for r in flat]),
+        np.stack([r[1] for r in flat]),
+        np.asarray([r[2] for r in flat], np.int32),
+        skippable=[r[3] for r in flat],
+        driver_cand=np.stack(cands),
+        domain=np.stack(doms),
+        commit=commit,
+        reset=reset,
+    )
+    got = batched_fifo_pack(c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
+
+    # Oracle: per-segment masked batches threaded host-side.
+    base = np.asarray(c.available).copy()
+    for s_idx, seg in enumerate(segments):
+        rows = list(seg["rows"])
+        sub = make_app_batch(
+            np.stack([r[0] for r in rows]),
+            np.stack([r[1] for r in rows]),
+            np.asarray([r[2] for r in rows], np.int32),
+            skippable=[r[3] for r in rows],
+            driver_cand=np.broadcast_to(seg["cand"], (len(rows), n)),
+            domain=np.broadcast_to(seg["dom"], (len(rows), n)),
+        )
+        ci = dataclasses.replace(c, available=base.astype(np.int32))
+        want = batched_fifo_pack(ci, sub, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
+        last = len(rows) - 1
+        real = real_row_of[s_idx]
+        assert bool(got.admitted[real]) == bool(want.admitted[last]), (
+            f"segment {s_idx} admitted"
+        )
+        assert int(got.driver_node[real]) == int(want.driver_node[last]), (
+            f"segment {s_idx} driver"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.executor_nodes[real]),
+            np.asarray(want.executor_nodes[last]),
+            err_msg=f"segment {s_idx} executors",
+        )
+        if bool(want.admitted[last]):
+            drv = int(want.driver_node[last])
+            base[drv] -= np.asarray(rows[last][0])
+            for e in np.asarray(want.executor_nodes[last]):
+                if e >= 0:
+                    base[e] -= np.asarray(rows[last][1])
+    live = np.asarray(c.valid)
+    np.testing.assert_array_equal(
+        np.asarray(got.available_after)[live], base[live]
+    )
+
+
+# ----------------------------------------------------------------- extender
+
+
+def _make_harness(strategy, fifo, n_nodes, zones=2):
+    h = Harness(binpack_algo=strategy, fifo=fifo)
+    h.add_nodes(
+        *[new_node(f"n{i}", zone=f"zone{i % zones}") for i in range(n_nodes)]
+    )
+    return h
+
+
+@pytest.mark.parametrize("strategy", ["tightly-pack", "az-aware-tightly-pack"])
+@pytest.mark.parametrize("fifo", [True, False])
+def test_predicate_batch_matches_sequential(strategy, fifo):
+    """predicate_batch on a window of concurrent driver requests ==
+    predicate() one at a time in the same order, including failures (the
+    cluster is sized so later gangs do not fit)."""
+    pods_sets = [static_allocation_spark_pods(f"w-{strategy}-{fifo}-{i}", 4) for i in range(6)]
+    drivers = [ps[0] for ps in pods_sets]
+    names = [f"n{i}" for i in range(6)]
+
+    h_seq = _make_harness(strategy, fifo, 6)
+    seq_drivers = copy.deepcopy(drivers)
+    for d in seq_drivers:
+        h_seq.add_pods(d)
+    seq_results = [
+        h_seq.extender.predicate(ExtenderArgs(pod=d, node_names=list(names)))
+        for d in seq_drivers
+    ]
+
+    h_win = _make_harness(strategy, fifo, 6)
+    win_drivers = copy.deepcopy(drivers)
+    for d in win_drivers:
+        h_win.add_pods(d)
+    win_results = h_win.extender.predicate_batch(
+        [ExtenderArgs(pod=d, node_names=list(names)) for d in win_drivers]
+    )
+
+    assert len(seq_results) == len(win_results)
+    for i, (s, w) in enumerate(zip(seq_results, win_results)):
+        assert s.outcome == w.outcome, f"request {i}: {s.outcome} != {w.outcome}"
+        assert s.node_names == w.node_names, f"request {i} node"
+    # Reservation state (executor placements) must also match.
+    for d in drivers:
+        app_id = d.labels["spark-app-id"]
+        rr_s = h_seq.get_reservation(d.namespace, app_id)
+        rr_w = h_win.get_reservation(d.namespace, app_id)
+        assert (rr_s is None) == (rr_w is None), app_id
+        if rr_s is not None:
+            assert {
+                k: (v.node) for k, v in rr_s.spec.reservations.items()
+            } == {k: (v.node) for k, v in rr_w.spec.reservations.items()}, app_id
+
+
+def test_predicate_batch_mixed_roles_and_idempotent_retry():
+    """A window mixing an already-reserved driver (idempotent retry), fresh
+    drivers, an executor of a reserved app, and a non-spark pod."""
+    h = _make_harness("tightly-pack", True, 8)
+    names = [f"n{i}" for i in range(8)]
+
+    first = static_allocation_spark_pods("mix-first", 2)
+    h.schedule(first[0], names)  # reserve app mix-first
+
+    fresh = [static_allocation_spark_pods(f"mix-{i}", 2) for i in range(2)]
+    from spark_scheduler_tpu.models.kube import Container, Pod
+    from spark_scheduler_tpu.models.resources import Resources
+
+    non_spark = Pod(
+        name="plain-pod",
+        namespace="namespace",
+        containers=[Container(requests=Resources.from_quantities("1", "1Gi"))],
+    )
+    batch = [
+        ExtenderArgs(pod=first[0], node_names=list(names)),  # retry
+        ExtenderArgs(pod=fresh[0][0], node_names=list(names)),
+        ExtenderArgs(pod=first[1], node_names=list(names)),  # executor
+        ExtenderArgs(pod=fresh[1][0], node_names=list(names)),
+        ExtenderArgs(pod=non_spark, node_names=list(names)),
+    ]
+    for args in batch:
+        h.add_pods(args.pod)
+    results = h.extender.predicate_batch(batch)
+    assert results[0].outcome == "success" and results[0].node_names
+    assert results[1].outcome == "success"
+    # executor binds onto one of mix-first's unbound reservation nodes
+    assert results[2].outcome in ("success", "success-already-bound")
+    assert results[3].outcome == "success"
+    assert results[4].outcome == "failure-non-spark-pod"
+    # retry returned the original reserved node
+    rr = h.get_reservation("namespace", "mix-first")
+    assert results[0].node_names[0] == rr.spec.reservations["driver"].node
+
+
+def test_predicate_batch_duplicate_driver_submission():
+    """The same driver pod submitted twice in one window (client retry):
+    both answers must name the ONE reserved node, exactly as solo
+    serialization's idempotent-retry branch would (resource.go:273-286)."""
+    h = _make_harness("tightly-pack", True, 8)
+    names = [f"n{i}" for i in range(8)]
+    driver = static_allocation_spark_pods("dup-app", 2)[0]
+    h.add_pods(driver)
+    results = h.extender.predicate_batch(
+        [
+            ExtenderArgs(pod=driver, node_names=list(names)),
+            ExtenderArgs(pod=copy.deepcopy(driver), node_names=list(names)),
+            ExtenderArgs(pod=copy.deepcopy(driver), node_names=list(names)),
+        ]
+    )
+    assert all(r.outcome == "success" for r in results)
+    rr = h.get_reservation("namespace", "dup-app")
+    reserved = rr.spec.reservations["driver"].node
+    assert all(r.node_names == [reserved] for r in results)
+
+
+def test_predicate_batch_fifo_blocking_window():
+    """A window where an impossible earlier gang blocks later ones exactly
+    as sequential FIFO would (resource.go:241-249)."""
+    huge = static_allocation_spark_pods("huge", 500)[0]
+    small = static_allocation_spark_pods("small", 1)[0]
+    names = [f"n{i}" for i in range(4)]
+
+    h_seq = _make_harness("tightly-pack", True, 4)
+    h_seq.add_pods(copy.deepcopy(huge), copy.deepcopy(small))
+    seq = [
+        h_seq.extender.predicate(ExtenderArgs(pod=p, node_names=list(names)))
+        for p in (copy.deepcopy(huge), copy.deepcopy(small))
+    ]
+    h_win = _make_harness("tightly-pack", True, 4)
+    h_win.add_pods(copy.deepcopy(huge), copy.deepcopy(small))
+    win = h_win.extender.predicate_batch(
+        [
+            ExtenderArgs(pod=copy.deepcopy(huge), node_names=list(names)),
+            ExtenderArgs(pod=copy.deepcopy(small), node_names=list(names)),
+        ]
+    )
+    assert [r.outcome for r in win] == [r.outcome for r in seq]
+    assert win[0].outcome == "failure-fit"
+    assert win[1].outcome == "failure-earlier-driver"
+
+
+# ------------------------------------------------------------------- server
+
+
+def test_http_concurrent_requests_are_batched():
+    """Concurrent POST /predicates calls coalesce into windows (>1 request
+    per solve) and every gang lands with a consistent reservation state."""
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+
+    h = _make_harness("tightly-pack", True, 24)
+    names = [f"n{i}" for i in range(24)]
+    server = SchedulerHTTPServer(h.app, host="127.0.0.1", port=0)
+    server.start()
+    n_clients = 12
+    results = [None] * n_clients
+    errors = []
+
+    def run_client(i):
+        try:
+            pods = static_allocation_spark_pods(f"conc-{i}", 2)
+            h.backend.add_pod(pods[0])
+            conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
+            body = json.dumps(
+                {"Pod": pod_to_k8s(pods[0]), "NodeNames": names}
+            ).encode()
+            conn.request("POST", "/predicates", body=body)
+            results[i] = json.loads(conn.getresponse().read())
+            conn.close()
+        except Exception as exc:  # surface in the main thread
+            errors.append(exc)
+
+    try:
+        threads = [
+            threading.Thread(target=run_client, args=(i,)) for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for i, r in enumerate(results):
+            assert r and r.get("NodeNames"), (i, r)
+        stats = server.batcher.stats()
+        assert stats["requests_served"] == n_clients
+        # every app got its gang reserved
+        for i in range(n_clients):
+            rr = h.get_reservation("namespace", f"conc-{i}")
+            assert rr is not None and len(rr.spec.reservations) == 3
+    finally:
+        server.stop()
