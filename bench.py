@@ -334,6 +334,7 @@ def bench_serving_http(rng):
             backend.bind_pod(driver, resp["NodeNames"][0])
     finally:
         conn.close()
+        dev_stats = dict(app.solver.device_state_stats)
         server.stop()
     p50 = float(np.percentile(latencies_ms, 50))
     _emit(
@@ -345,10 +346,11 @@ def bench_serving_http(rng):
             "requests": len(latencies_ms),
             "p95_ms": round(float(np.percentile(latencies_ms, 95)), 3),
             "path": "HTTP /predicates -> batched admission -> write-back",
-            # One dispatch + one result fetch per request: on a tunneled
-            # device the floor is ~2 relay RTTs regardless of solve time
-            # (the kernel-side service time is the configN lines above).
-            "device_round_trips_per_request": 2,
+            # Cluster state is device-resident (delta row scatter rides the
+            # async dispatch); the one BLOCKING round trip per request is
+            # the decision pull (VERDICT r2 #3).
+            "device_round_trips_per_request": 1,
+            "device_state": dev_stats,
             "r02_ms": 119.68,
         },
     )
@@ -411,6 +413,7 @@ def bench_serving_http_concurrent(rng):
         lats, wall_s = run_phase("run", per_client)
     finally:
         stats = server.batcher.stats()
+        dev_stats = dict(app.solver.device_state_stats)
         server.stop()
     total = n_clients * per_client
     p50 = float(np.percentile(lats, 50))
@@ -426,6 +429,7 @@ def bench_serving_http_concurrent(rng):
             "decisions_per_s_measured": round(total / wall_s, 1),
             "mean_window": stats["mean_window"],
             "max_window_seen": stats["max_window_seen"],
+            "device_state": dev_stats,
             "path": "concurrent HTTP /predicates -> windowed pack_window solve",
             "r02": "unbatched serving: 8.4 decisions/s, p50 119.7 ms",
         },

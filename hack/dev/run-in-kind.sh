@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# Cluster-in-a-box dev loop + gang-scheduling smoke (VERDICT r2 #9; the
+# reference's hack/dev/run-in-minikube.sh + spark-submit-test.sh slot):
+#
+#   1. create (or reuse) a kind cluster
+#   2. build docker/Dockerfile and load it into the cluster
+#   3. apply examples/{namespace,crds,extender}.yml and wait for rollout
+#   4. submit a mock Spark app (examples/submit-test-spark-app.sh)
+#   5. assert the gang landed: every pod of the app is Scheduled on a node
+#      recorded in the app's ResourceReservation
+#
+#   hack/dev/run-in-kind.sh [app-id] [num-executors]
+#
+# Requires: kind, kubectl, docker. Tear down with:
+#   kind delete cluster --name spark-scheduler-tpu
+set -euo pipefail
+
+APP_ID="${1:-kind-smoke-$RANDOM}"
+NUM_EXECUTORS="${2:-2}"
+CLUSTER="spark-scheduler-tpu"
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
+IMG="spark-scheduler-tpu:latest"
+
+say() { echo ">>> $*"; }
+
+# 1. cluster ---------------------------------------------------------------
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER"; then
+  say "creating kind cluster $CLUSTER (1 control plane + 2 workers)"
+  kind create cluster --name "$CLUSTER" --wait 120s --config=- <<'YAML'
+kind: Cluster
+apiVersion: kind.x-k8s.io/v1alpha4
+nodes:
+  - role: control-plane
+  - role: worker
+  - role: worker
+YAML
+else
+  say "reusing kind cluster $CLUSTER"
+fi
+kubectl config use-context "kind-$CLUSTER" >/dev/null
+
+# The scheduler sorts and filters by zone + instance-group labels.
+for node in $(kubectl get nodes -l '!node-role.kubernetes.io/control-plane' -o name); do
+  kubectl label --overwrite "$node" \
+    topology.kubernetes.io/zone=zone1 instance-group=batch-medium-priority >/dev/null
+done
+
+# 2. image -----------------------------------------------------------------
+say "building $IMG"
+docker build -q -f "$REPO/docker/Dockerfile" -t "$IMG" "$REPO"
+say "loading image into kind"
+kind load docker-image --name "$CLUSTER" "$IMG"
+
+# 3. deploy ----------------------------------------------------------------
+say "applying manifests"
+kubectl apply -f "$REPO/examples/namespace.yml"
+kubectl apply -f "$REPO/examples/crds.yml"
+kubectl apply -f "$REPO/examples/extender.yml"
+say "waiting for the scheduler rollout"
+kubectl -n spark rollout status deployment/spark-scheduler-tpu --timeout=180s
+
+# 4. submit ----------------------------------------------------------------
+say "submitting mock spark app $APP_ID (1 driver + $NUM_EXECUTORS executors)"
+"$REPO/examples/submit-test-spark-app.sh" "$APP_ID" "$NUM_EXECUTORS"
+
+# 5. assert the gang landed on reserved nodes ------------------------------
+say "waiting for the gang to schedule"
+deadline=$(( $(date +%s) + 180 ))
+want=$(( NUM_EXECUTORS + 1 ))
+while true; do
+  scheduled=$(kubectl -n spark get pods -l "spark-app-id=$APP_ID" \
+    -o jsonpath='{range .items[*]}{.spec.nodeName}{"\n"}{end}' | grep -c . || true)
+  [ "$scheduled" -ge "$want" ] && break
+  if [ "$(date +%s)" -gt "$deadline" ]; then
+    say "FAIL: only $scheduled/$want pods scheduled"
+    kubectl -n spark get pods -l "spark-app-id=$APP_ID" -o wide
+    kubectl -n spark logs deployment/spark-scheduler-tpu -c spark-scheduler-extender --tail=50
+    exit 1
+  fi
+  sleep 2
+done
+
+say "verifying pods landed on the reserved nodes"
+reserved_nodes=$(kubectl -n spark get resourcereservation "$APP_ID" \
+  -o jsonpath='{range .spec.reservations.*}{.node}{"\n"}{end}' | sort -u)
+pod_nodes=$(kubectl -n spark get pods -l "spark-app-id=$APP_ID" \
+  -o jsonpath='{range .items[*]}{.spec.nodeName}{"\n"}{end}' | sort -u)
+say "reserved: $(echo $reserved_nodes)  landed: $(echo $pod_nodes)"
+for n in $pod_nodes; do
+  if ! grep -qx "$n" <<<"$reserved_nodes"; then
+    say "FAIL: pod landed on $n which holds no reservation for $APP_ID"
+    kubectl -n spark get resourcereservation "$APP_ID" -o yaml
+    exit 1
+  fi
+done
+
+say "OK: gang of $want pods scheduled on reserved nodes"

@@ -99,7 +99,7 @@ class ExtenderConfig:
     # One batched device solve per driver request (FIFO prefix + current app)
     # instead of a pack per earlier driver. Decisions are identical either
     # way (solver.pack_queue docstring); False forces the sequential loop.
-    # Single-AZ binpack strategies always use the sequential path.
+    # All six binpack strategies batch (solver.BATCHABLE_STRATEGIES).
     batched_admission: bool = True
 
 
@@ -269,17 +269,15 @@ class SparkSchedulerExtender:
             return
 
         all_nodes = self._backend.list_nodes()
-        union: dict[str, object] = {}
+        by_name = {n.name: n for n in all_nodes}
         domains: dict[int, list[str]] = {}
         for i, pod, res, args in window:
-            nodes_i = [n for n in all_nodes if pod_matches_node(pod, n)]
-            domains[i] = [n.name for n in nodes_i]
-            for n in nodes_i:
-                union[n.name] = n
-        union_nodes = list(union.values())
+            domains[i] = [n.name for n in all_nodes if pod_matches_node(pod, n)]
         usage = self._rrm.reserved_usage()
-        overhead = self._overhead.get_overhead(union_nodes)
-        tensors = self._solver.build_tensors(union_nodes, usage, overhead)
+        overhead = self._overhead.get_overhead(all_nodes)
+        # Device-resident state: full node list, per-request affinity via
+        # each request's domain mask (VERDICT r2 #3).
+        tensors = self._solver.build_tensors_cached(all_nodes, usage, overhead)
 
         requests: list[WindowRequest] = []
         for i, pod, res, args in window:
@@ -339,7 +337,7 @@ class SparkSchedulerExtender:
                 self._metrics.report_cross_zone(
                     packing.driver_node,
                     packing.executor_nodes,
-                    [union[nm] for nm in domains[i]],
+                    [by_name[nm] for nm in domains[i]],
                 )
             self._demands.delete_demand_if_exists(pod)
             try:
@@ -347,6 +345,12 @@ class SparkSchedulerExtender:
                     pod, res, packing.driver_node, packing.executor_nodes
                 )
             except ReservationError as exc:
+                # No rollback of the window's committed base: later window
+                # decisions stand even though this app holds nothing. That
+                # is the reference's own durability stance — reservation
+                # writes are fire-and-forget and "some writes will be lost
+                # on leader change" (failover.go:35-41); the failed app
+                # retries, and failover reconciliation repairs drift.
                 self._mark_outcome(pod, ROLE_DRIVER, FAILURE_INTERNAL, timer_start)
                 results[i] = self._fail(args, FAILURE_INTERNAL, str(exc))
                 continue
@@ -412,12 +416,9 @@ class SparkSchedulerExtender:
             # absent from the candidate list (resource.go:273-286).
             return rr.spec.reservations[DRIVER_RESERVATION].node, SUCCESS, ""
 
-        available_nodes = [
-            n for n in self._backend.list_nodes() if pod_matches_node(driver, n)
-        ]
+        all_nodes = self._backend.list_nodes()
+        available_nodes = [n for n in all_nodes if pod_matches_node(driver, n)]
         usage = self._rrm.reserved_usage()
-        overhead = self._overhead.get_overhead(available_nodes)
-        tensors = self._solver.build_tensors(available_nodes, usage, overhead)
 
         try:
             app_resources = spark_resources(driver)
@@ -435,15 +436,24 @@ class SparkSchedulerExtender:
             # (SURVEY.md §2d row 1) — replaces fitEarlierDrivers' per-driver
             # re-pack loop (resource.go:221-258) AND the final pack with a
             # single batched solve. Decisions are identical to the sequential
-            # path (pack_queue docstring).
+            # path (pack_queue docstring). Cluster state is device-resident:
+            # full node list + delta upload, affinity filtering via the
+            # domain mask (VERDICT r2 #3).
+            overhead = self._overhead.get_overhead(all_nodes)
+            tensors = self._solver.build_tensors_cached(all_nodes, usage, overhead)
+            domain = self._solver.candidate_mask(
+                tensors, [n.name for n in available_nodes]
+            )
             packing, outcome, message = self._admit_driver_batched(
-                driver, app_resources, earlier, tensors, node_names
+                driver, app_resources, earlier, tensors, node_names, domain
             )
             if packing is None:
                 self._demands.create_demand_for_application(driver, app_resources)
                 return None, outcome, message
         else:
-            # Sequential fallback: single-AZ strategies, or batching disabled.
+            # Sequential fallback (batching disabled by config).
+            overhead = self._overhead.get_overhead(available_nodes)
+            tensors = self._solver.build_tensors(available_nodes, usage, overhead)
             if earlier:
                 tensors, ok = self._fit_earlier_drivers(earlier, tensors, node_names)
                 if not ok:
@@ -490,6 +500,7 @@ class SparkSchedulerExtender:
         earlier: Sequence[Pod],
         tensors,
         node_names: list[str],
+        domain_mask=None,
     ):
         """Batched FIFO admission: earlier drivers + the current driver as
         rows of one `pack_queue` solve. Returns (packing|None, outcome,
@@ -518,7 +529,7 @@ class SparkSchedulerExtender:
             )
         )
         decisions = self._solver.pack_queue(
-            self.binpacker.name, tensors, rows, node_names
+            self.binpacker.name, tensors, rows, node_names, domain_mask=domain_mask
         )
         final = decisions[-1]
         if final.admitted:
@@ -659,8 +670,10 @@ class SparkSchedulerExtender:
                 single_az_zone = zone
 
         usage = self._rrm.reserved_usage()
-        overhead = self._overhead.get_overhead(nodes)
-        tensors = self._solver.build_tensors(nodes, usage, overhead)
+        all_nodes = self._backend.list_nodes()
+        overhead = self._overhead.get_overhead(all_nodes)
+        tensors = self._solver.build_tensors_cached(all_nodes, usage, overhead)
+        domain = self._solver.candidate_mask(tensors, [n.name for n in nodes])
         # A 1-executor gang with no driver = "first sorted node with room".
         packing = self._solver.pack(
             "tightly-pack",
@@ -669,6 +682,7 @@ class SparkSchedulerExtender:
             exec_res,
             1,
             [n.name for n in nodes],
+            domain_mask=domain,
         )
         if packing.has_capacity and packing.executor_nodes:
             outcome = SUCCESS_SCHEDULED_EXTRA_EXECUTOR if is_extra else SUCCESS_RESCHEDULED

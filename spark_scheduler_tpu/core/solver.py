@@ -15,9 +15,11 @@ This replaces the reference's per-request map-building + sort + greedy loops
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from spark_scheduler_tpu import native
@@ -45,6 +47,36 @@ def _bucket(n: int, minimum: int) -> int:
     while out < n:
         out *= 2
     return out
+
+
+def _host_view(tensors) -> ClusterTensors:
+    """Host-resident numpy view of cluster tensors. Device-cached tensors
+    (build_tensors_cached) carry their numpy source as `.host`; using it for
+    host-side math (efficiency, masks, reconstruction) avoids pulling full
+    arrays back over a tunneled device link."""
+    return getattr(tensors, "host", tensors)
+
+
+# Fields that force a full re-upload when they change (node topology /
+# attribute changes — rare next to availability churn).
+_STATIC_FIELDS = (
+    "schedulable",
+    "zone_id",
+    "name_rank",
+    "label_rank_driver",
+    "label_rank_executor",
+    "unschedulable",
+    "ready",
+    "valid",
+)
+
+
+@jax.jit
+def _scatter_rows(avail, idx, rows):
+    """Jitted row update for the device-resident availability tensor.
+    Duplicate indices carry identical rows (bucketing pads by repeating a
+    dirty row), so .set is deterministic."""
+    return avail.at[idx].set(rows)
 
 
 class HostPacking(NamedTuple):
@@ -110,6 +142,16 @@ class PlacementSolver:
         self._rank_epoch = -1
         if use_native and native.available():
             self._arena = native.ClusterArena()
+        # Device-resident cluster state (VERDICT r2 #3): the last uploaded
+        # tensors + their numpy source. build_tensors_cached diffs against
+        # the mirror and ships only changed availability rows.
+        self._dev: dict | None = None
+        self.device_state_stats = {
+            "full_uploads": 0,
+            "delta_uploads": 0,
+            "delta_rows": 0,
+            "reuse_hits": 0,
+        }
 
     @property
     def uses_native_arena(self) -> bool:
@@ -139,6 +181,73 @@ class PlacementSolver:
             executor_label_priority=self._executor_label_priority,
             pad_to=pad,
         )
+
+    def build_tensors_cached(
+        self,
+        nodes: Sequence[Node],
+        usage,
+        overhead,
+    ) -> ClusterTensors:
+        """Device-resident cluster state with delta updates (VERDICT r2 #3).
+
+        Builds the host tensors exactly like `build_tensors`, then keeps the
+        device copy ALIVE between requests: when only availability rows
+        changed since the previous call (reservation deltas, overhead
+        drift), a jitted row-scatter ships just those rows; unchanged state
+        re-uses the resident arrays outright; topology/attribute changes
+        (any non-availability field) trigger a full upload. The numpy source
+        rides along as `.host` so host-side consumers (efficiency, masks)
+        never pull arrays back off the device.
+
+        Callers should pass the FULL current node list and express
+        per-request affinity/candidate filtering through the kernels'
+        domain/candidate masks — that keeps the cached topology stable
+        across requests (SURVEY.md §7 "persistent device state + small
+        delta updates")."""
+        host = self.build_tensors(nodes, usage, overhead)
+        stats = self.device_state_stats
+        dev = self._dev
+        tensors = None
+        if dev is not None and dev["host"].available.shape == host.available.shape:
+            prev = dev["host"]
+            if all(
+                np.array_equal(getattr(prev, f), getattr(host, f))
+                for f in _STATIC_FIELDS
+            ):
+                dirty = np.flatnonzero(
+                    np.any(prev.available != host.available, axis=1)
+                )
+                k = len(dirty)
+                if k == 0:
+                    tensors = dev["tensors"]
+                    stats["reuse_hits"] += 1
+                elif k <= max(32, host.available.shape[0] // 8):
+                    # Bucket the row count so the scatter program compiles
+                    # once per bucket; padding repeats dirty rows (set with
+                    # identical values — deterministic).
+                    idx = np.resize(dirty, _bucket(k, 16))
+                    rows = host.available[idx]
+                    new_avail = _scatter_rows(
+                        dev["tensors"].available,
+                        jnp.asarray(idx.astype(np.int32)),
+                        jnp.asarray(rows),
+                    )
+                    tensors = dataclasses.replace(
+                        dev["tensors"], available=new_avail
+                    )
+                    stats["delta_uploads"] += 1
+                    stats["delta_rows"] += k
+                else:
+                    tensors = dataclasses.replace(
+                        dev["tensors"], available=jax.device_put(host.available)
+                    )
+                    stats["full_uploads"] += 1
+        if tensors is None:
+            tensors = jax.device_put(host)
+            stats["full_uploads"] += 1
+        tensors.host = host
+        self._dev = {"host": host, "tensors": tensors}
+        return tensors
 
     def _label_rank(self, node: Node, prio) -> int:
         if prio is None:
@@ -241,9 +350,10 @@ class PlacementSolver:
 
         fn = BINPACK_FUNCTIONS[strategy]
         n = tensors.available.shape[0]
+        host = _host_view(tensors)
         driver_mask = self.candidate_mask(tensors, driver_candidate_names)
         if domain_mask is None:
-            domain_mask = np.asarray(tensors.valid)
+            domain_mask = np.asarray(host.valid)
         emax = _bucket(max(executor_count, 1), 8)
         # The span covers dispatch AND the device->host transfer — the
         # transfer is where the device work is actually awaited.
@@ -266,12 +376,11 @@ class PlacementSolver:
             # (SURVEY.md §7 latency budget). Efficiency reporting runs as
             # pure numpy on the host-resident cluster arrays — zero extra
             # dispatches.
-            import jax
 
             packing = jax.device_get(packing)
         eff = avg_packing_efficiency_np(
-            np.asarray(tensors.schedulable),
-            np.asarray(tensors.available),
+            np.asarray(host.schedulable),
+            np.asarray(host.available),
             int(packing.driver_node),
             packing.executor_nodes,
             driver_resources.as_array(),
@@ -320,9 +429,10 @@ class PlacementSolver:
         if not rows:
             return []
         n = tensors.available.shape[0]
+        host = _host_view(tensors)
         driver_mask = self.candidate_mask(tensors, driver_candidate_names)
         domain = (
-            np.asarray(tensors.valid) if domain_mask is None else np.asarray(domain_mask)
+            np.asarray(host.valid) if domain_mask is None else np.asarray(domain_mask)
         )
         b = len(rows)
         counts = [int(r[2]) for r in rows]
@@ -349,7 +459,6 @@ class PlacementSolver:
             # ONE device->host transfer for the decisions (tunneled-TPU
             # RTTs: see pack()); available_after is pulled only on the
             # efficiency branch below.
-            import jax
 
             drivers, execs, admitted, packed = jax.device_get(
                 (out.driver_node, out.executor_nodes, out.admitted, out.packed)
@@ -365,7 +474,7 @@ class PlacementSolver:
         last = b - 1
         eff = None
         if admitted[last]:
-            avail_before = np.array(np.asarray(tensors.available), dtype=np.int64)
+            avail_before = np.array(np.asarray(host.available), dtype=np.int64)
             for i in range(last):
                 if not admitted[i]:
                     continue
@@ -375,7 +484,7 @@ class PlacementSolver:
                     if e >= 0:
                         avail_before[e] -= rows[i][1].as_array()
             eff = avg_packing_efficiency_np(
-                np.asarray(tensors.schedulable),
+                np.asarray(host.schedulable),
                 avail_before,
                 int(drivers[last]),
                 execs[last],
@@ -435,7 +544,8 @@ class PlacementSolver:
         if not requests:
             return []
         n = tensors.available.shape[0]
-        valid_np = np.asarray(tensors.valid)
+        host = _host_view(tensors)
+        valid_np = np.asarray(host.valid)
 
         flat_rows: list[tuple] = []
         commit: list[bool] = []
@@ -483,7 +593,6 @@ class PlacementSolver:
                 tensors, apps, fill=strategy, emax=emax,
                 num_zones=self._num_zones_bucket(),
             )
-            import jax
 
             drivers, execs, admitted, packed = jax.device_get(
                 (out.driver_node, out.executor_nodes, out.admitted, out.packed)
@@ -494,7 +603,7 @@ class PlacementSolver:
         # - committed placements of earlier segments
         # - in-segment admitted hypothetical placements.
         decisions: list[WindowDecision] = []
-        base = np.array(np.asarray(tensors.available), dtype=np.int64)
+        base = np.array(np.asarray(host.available), dtype=np.int64)
         row = 0
         for r, req in enumerate(requests):
             seg_rows = list(range(row, row + len(req.rows)))
@@ -515,7 +624,7 @@ class PlacementSolver:
             eff = None
             if req_admitted:
                 eff = avg_packing_efficiency_np(
-                    np.asarray(tensors.schedulable),
+                    np.asarray(host.schedulable),
                     seg_avail,
                     int(drivers[real]),
                     execs[real],

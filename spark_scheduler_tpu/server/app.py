@@ -49,6 +49,7 @@ class SchedulerApp:
     unschedulable_marker: UnschedulablePodMarker
     demand_crd_watcher: LazyDemandCRDWatcher
     ingestion: object | None = None  # KubeIngestion when kube_api_url is set
+    runtime_manager: object | None = None  # RuntimeConfigManager when configured
     _background_started: bool = False
 
     def start_background(self) -> None:
@@ -65,8 +66,12 @@ class SchedulerApp:
         self.rr_cache.start()
         self.unschedulable_marker.start()
         self.demand_crd_watcher.start()
+        if self.runtime_manager is not None:
+            self.runtime_manager.start()
 
     def stop(self) -> None:
+        if self.runtime_manager is not None:
+            self.runtime_manager.stop()
         if self.ingestion is not None:
             self.ingestion.stop()
         self.demand_crd_watcher.stop()
@@ -237,7 +242,7 @@ def build_scheduler_app(
     # activates demand features synchronously; otherwise the background
     # poll in start_background() picks it up.
     demand_crd_watcher.check_now()
-    return SchedulerApp(
+    app = SchedulerApp(
         backend=backend,
         config=config,
         rr_cache=rr_cache,
@@ -254,3 +259,8 @@ def build_scheduler_app(
         demand_crd_watcher=demand_crd_watcher,
         ingestion=ingestion,
     )
+    if config.runtime_config_path:
+        from spark_scheduler_tpu.server.runtime import RuntimeConfigManager
+
+        app.runtime_manager = RuntimeConfigManager(app, config.runtime_config_path)
+    return app

@@ -79,6 +79,11 @@ class InstallConfig:
     # Expose /debug/* (trace dump + JAX profiler control). Off by default:
     # on the cluster-exposed port these routes are unauthenticated.
     debug_routes: bool = False
+    # Path to the REFRESHABLE runtime-config YAML (the witchcraft Runtime
+    # embed, config.go:24-47): log level, fifo, batched-admission, and the
+    # async retry budget reload live on file change or SIGHUP
+    # (server/runtime.py). None = no runtime reloads.
+    runtime_config_path: Optional[str] = None
 
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
@@ -142,6 +147,7 @@ class InstallConfig:
             kube_api_burst=int(raw.get("burst", 10)),
             request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
             debug_routes=bool(raw.get("debug-routes", False)),
+            runtime_config_path=raw.get("runtime-config-path"),
         )
 
 

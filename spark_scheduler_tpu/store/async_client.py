@@ -78,6 +78,12 @@ class AsyncClient:
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
 
+    def set_max_retries(self, n: int) -> None:
+        """Live retry-budget change (runtime config reload). Read by workers
+        without a lock: int assignment is atomic, and an in-flight request
+        observing either budget is acceptable."""
+        self._max_retries = int(n)
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> None:

@@ -73,6 +73,10 @@ class WriteThroughCache:
         """fn(old, new); see __init__ note. Must be fast and non-blocking."""
         self._mutation_listeners.append(fn)
 
+    def set_max_retries(self, n: int) -> None:
+        """Live write-back retry-budget change (runtime config reload)."""
+        self.client.set_max_retries(n)
+
     def _notify(self, old: Any, new: Any) -> None:
         for fn in self._mutation_listeners:
             fn(old, new)
@@ -165,6 +169,11 @@ class SafeDemandCache:
             self._cache.start()
             return True
         return False
+
+    def set_max_retries(self, n: int) -> None:
+        self._kw["max_retries"] = int(n)  # applies if the cache appears later
+        if self._cache is not None:
+            self._cache.set_max_retries(n)
 
     def get(self, namespace: str, name: str):
         return self._cache.get(namespace, name) if self.crd_exists() else None

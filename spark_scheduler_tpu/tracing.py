@@ -317,13 +317,36 @@ class Svc1Logger:
     reference's logging discipline; every entry carries the active trace
     context so logs and traces join."""
 
-    def __init__(self, stream=None, origin: str = "spark-scheduler-tpu", clock=time.time):
+    LEVELS = {"DEBUG": 0, "INFO": 1, "WARN": 2, "ERROR": 3}
+
+    def __init__(
+        self,
+        stream=None,
+        origin: str = "spark-scheduler-tpu",
+        clock=time.time,
+        level: str = "INFO",
+    ):
         self._stream = stream if stream is not None else sys.stderr
         self._origin = origin
         self._clock = clock
         self._lock = threading.Lock()
+        self._min_level = self.LEVELS.get(str(level).upper(), 1)
+
+    def set_level(self, level: str) -> None:
+        """Live log-level change — the witchcraft runtime-config reload slot
+        (config/config.go:24-47 Runtime embed)."""
+        self._min_level = self.LEVELS.get(str(level).upper(), self._min_level)
+
+    @property
+    def level(self) -> str:
+        for name, rank in self.LEVELS.items():
+            if rank == self._min_level:
+                return name
+        return "INFO"
 
     def _log(self, level: str, message: str, params: dict | None) -> None:
+        if self.LEVELS.get(level, 1) < self._min_level:
+            return
         entry = {
             "type": "service.1",
             "level": level,
@@ -338,6 +361,9 @@ class Svc1Logger:
             entry["spanId"] = cur.span_id
         with self._lock:
             self._stream.write(json.dumps(entry) + "\n")
+
+    def debug(self, message: str, **params) -> None:
+        self._log("DEBUG", message, params)
 
     def info(self, message: str, **params) -> None:
         self._log("INFO", message, params)
