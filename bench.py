@@ -293,7 +293,11 @@ def _serving_fixture(n_nodes=500):
             fifo=True, sync_writes=True, instance_group_label=INSTANCE_GROUP_LABEL
         ),
     )
-    server = SchedulerHTTPServer(app, host="127.0.0.1", port=0)
+    # Generous request budget: the first window of each row-count bucket
+    # pays an XLA compile (~tens of seconds on a remote TPU).
+    server = SchedulerHTTPServer(
+        app, host="127.0.0.1", port=0, request_timeout_s=600.0
+    )
     server.start()
     return backend, app, server, node_names
 
@@ -368,7 +372,7 @@ def bench_serving_http_concurrent(rng):
     from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
 
     backend, app, server, node_names = _serving_fixture()
-    n_clients, per_client, warmup_rounds = 16, 8, 5
+    n_clients, per_client, warmup_rounds = 32, 8, 5
     lat_lock = threading.Lock()
 
     def run_phase(phase, rounds):
@@ -378,7 +382,7 @@ def bench_serving_http_concurrent(rng):
         def client(ci):
             try:
                 conn = http.client.HTTPConnection(
-                    "127.0.0.1", server.port, timeout=120
+                    "127.0.0.1", server.port, timeout=600
                 )
                 for r in range(rounds):
                     driver = static_allocation_spark_pods(
@@ -408,8 +412,30 @@ def bench_serving_http_concurrent(rng):
             raise errs[0]
         return lats, wall_s
 
+    def precompile_window_buckets():
+        """Force the XLA compiles for every pack_window row bucket the run
+        can hit, so measurement never stalls on a fresh compile (a real
+        deployment pre-warms the same way)."""
+        from spark_scheduler_tpu.core.solver import WindowRequest
+        from spark_scheduler_tpu.models.resources import Resources
+
+        solver = app.solver
+        tensors = solver.build_tensors_cached(backend.list_nodes(), {}, {})
+        one = Resources.from_quantities("1", "1Gi")
+        for rows_total in (32, 64, 128, 256, 512, 1024, 2048):
+            per_req = max(1, rows_total // n_clients)
+            reqs = [
+                WindowRequest(
+                    rows=[(one, one, 8, False)] * per_req,
+                    driver_candidate_names=node_names,
+                )
+                for _ in range(min(n_clients, rows_total))
+            ]
+            solver.pack_window("tightly-pack", tensors, reqs)
+
     try:
-        run_phase("warm", warmup_rounds)  # compile the window-size buckets
+        precompile_window_buckets()
+        run_phase("warm", warmup_rounds)  # warm the serving path end to end
         lats, wall_s = run_phase("run", per_client)
     finally:
         stats = server.batcher.stats()
@@ -417,22 +443,51 @@ def bench_serving_http_concurrent(rng):
         server.stop()
     total = n_clients * per_client
     p50 = float(np.percentile(lats, 50))
-    _emit(
-        "serving_http_concurrent_p50_ms_500_nodes",
-        p50,
-        1,
-        {
-            "nodes": 500,
-            "concurrent_clients": n_clients,
-            "requests": total,
-            "p95_ms": round(float(np.percentile(lats, 95)), 3),
-            "decisions_per_s_measured": round(total / wall_s, 1),
-            "mean_window": stats["mean_window"],
-            "max_window_seen": stats["max_window_seen"],
-            "device_state": dev_stats,
-            "path": "concurrent HTTP /predicates -> windowed pack_window solve",
-            "r02": "unbatched serving: 8.4 decisions/s, p50 119.7 ms",
-        },
+
+    # Transport floor evidence: one minimal device round trip (dispatch +
+    # pull a scalar). Over this environment's tunneled TPU this alone
+    # exceeds the 50 ms latency target — per-request latency is
+    # transport-bound; THROUGHPUT is what windowing buys.
+    import jax
+    import jax.numpy as jnp
+
+    floor_samples = []
+    x = jax.device_put(jnp.zeros(1, jnp.int32))
+    for _ in range(7):
+        t0 = time.perf_counter()
+        np.asarray(x + 1)
+        floor_samples.append((time.perf_counter() - t0) * 1e3)
+    rtt_floor_ms = round(float(np.percentile(floor_samples, 50)), 2)
+
+    detail = {
+        "nodes": 500,
+        "concurrent_clients": n_clients,
+        "requests": total,
+        "p50_ms": round(p50, 3),
+        "p95_ms": round(float(np.percentile(lats, 95)), 3),
+        "decisions_per_s_measured": round(total / wall_s, 1),
+        "mean_window": stats["mean_window"],
+        "max_window_seen": stats["max_window_seen"],
+        "device_state": dev_stats,
+        "device_rtt_floor_ms": rtt_floor_ms,
+        "path": "concurrent HTTP /predicates -> windowed pack_window solve",
+        "r02": "unbatched serving: 8.4 decisions/s, p50 119.7 ms",
+    }
+    _emit("serving_http_concurrent_p50_ms_500_nodes", p50, 1, detail)
+    # The windowing headline: decisions/s under concurrent load
+    # (vs_baseline > 1 = beats the 100 decisions/s target).
+    dps = total / wall_s
+    print(
+        json.dumps(
+            {
+                "metric": "serving_http_concurrent_decisions_per_s_500_nodes",
+                "value": round(dps, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(dps / 100.0, 2),
+                "detail": detail,
+            }
+        ),
+        flush=True,
     )
 
 

@@ -79,6 +79,41 @@ def _scatter_rows(avail, idx, rows):
     return avail.at[idx].set(rows)
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
+def _window_blob(cluster, apps, *, fill, emax, num_zones):
+    """batched_fifo_pack with every per-row output packed into ONE int32
+    array [B, 3+Emax]: (driver, admitted, packed, exec slots...). On a
+    tunneled device each fetched array is its own RPC round trip, so the
+    serving path pulls a single blob instead of four arrays."""
+    out = batched_fifo_pack(
+        cluster, apps, fill=fill, emax=emax, num_zones=num_zones
+    )
+    return jnp.concatenate(
+        [
+            out.driver_node[:, None],
+            out.admitted[:, None].astype(jnp.int32),
+            out.packed[:, None].astype(jnp.int32),
+            out.executor_nodes,
+        ],
+        axis=1,
+    )
+
+
+@_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
+def _pack_blob(cluster, dreq, ereq, count, dmask, dom, *, fill, emax, num_zones):
+    """Single-app pack with the Packing flattened to one int32 [2+Emax]
+    array: (driver, has_capacity, exec slots...) — one device fetch."""
+    p = BINPACK_FUNCTIONS[fill](
+        cluster, dreq, ereq, count, dmask, dom, emax=emax, num_zones=num_zones
+    )
+    return jnp.concatenate(
+        [p.driver_node[None], p.has_capacity.astype(jnp.int32)[None], p.executor_nodes]
+    )
+
+
 class HostPacking(NamedTuple):
     driver_node: Optional[str]
     executor_nodes: list[str]
@@ -87,14 +122,6 @@ class HostPacking(NamedTuple):
     efficiency_cpu: float
     efficiency_memory: float
     efficiency_gpu: float
-
-
-class QueueDecision(NamedTuple):
-    """One row of a batched FIFO solve (see PlacementSolver.pack_queue)."""
-
-    packing: HostPacking
-    packed: bool  # would fit, ignoring FIFO blocking
-    admitted: bool  # packed AND not blocked by an earlier non-skippable failure
 
 
 class WindowRequest(NamedTuple):
@@ -110,6 +137,7 @@ class WindowRequest(NamedTuple):
     rows: Sequence[tuple]
     driver_candidate_names: Sequence[str]
     domain_node_names: Sequence[str] | None = None  # None = all valid nodes
+    domain_mask: "np.ndarray | None" = None  # precomputed [N] bool override
 
 
 class WindowDecision(NamedTuple):
@@ -348,7 +376,6 @@ class PlacementSolver:
     ) -> HostPacking:
         from spark_scheduler_tpu.tracing import tracer
 
-        fn = BINPACK_FUNCTIONS[strategy]
         n = tensors.available.shape[0]
         host = _host_view(tensors)
         driver_mask = self.candidate_mask(tensors, driver_candidate_names)
@@ -360,35 +387,36 @@ class PlacementSolver:
         with tracer().span(
             "solve", strategy=strategy, nodes=n, executors=executor_count
         ):
-            packing = fn(
-                tensors,
-                jnp.asarray(driver_resources.as_array()),
-                jnp.asarray(executor_resources.as_array()),
-                jnp.int32(executor_count),
-                jnp.asarray(driver_mask),
-                jnp.asarray(domain_mask),
-                emax=emax,
-                num_zones=self._num_zones_bucket(),
+            # ONE device->host transfer (one flat int32 blob) for the whole
+            # decision: on a tunneled TPU every fetched array is a full RPC
+            # round-trip (SURVEY.md §7 latency budget). Efficiency reporting
+            # runs as pure numpy on the host-resident cluster arrays — zero
+            # extra pulls.
+            blob = jax.device_get(
+                _pack_blob(
+                    tensors,
+                    jnp.asarray(driver_resources.as_array()),
+                    jnp.asarray(executor_resources.as_array()),
+                    jnp.int32(executor_count),
+                    jnp.asarray(driver_mask),
+                    jnp.asarray(domain_mask),
+                    fill=strategy,
+                    emax=emax,
+                    num_zones=self._num_zones_bucket(),
+                )
             )
-            # ONE device->host transfer for the whole decision: on a
-            # tunneled TPU each scalar pull is a full RPC round-trip, so
-            # per-field int()/float() would cost ~8 RTTs per request
-            # (SURVEY.md §7 latency budget). Efficiency reporting runs as
-            # pure numpy on the host-resident cluster arrays — zero extra
-            # dispatches.
-
-            packing = jax.device_get(packing)
+        driver_idx = int(blob[0])
+        has_cap = bool(blob[1])
+        executor_nodes = blob[2:]
         eff = avg_packing_efficiency_np(
             np.asarray(host.schedulable),
             np.asarray(host.available),
-            int(packing.driver_node),
-            packing.executor_nodes,
+            driver_idx,
+            executor_nodes,
             driver_resources.as_array(),
             executor_resources.as_array(),
         )
-        has_cap = bool(packing.has_capacity)
-        driver_idx = int(packing.driver_node)
-        exec_idx = [int(x) for x in packing.executor_nodes if int(x) >= 0]
+        exec_idx = [int(x) for x in executor_nodes if int(x) >= 0]
         return HostPacking(
             driver_node=self.registry.name_of(driver_idx) if driver_idx >= 0 else None,
             executor_nodes=[self.registry.name_of(i) for i in exec_idx],
@@ -401,121 +429,6 @@ class PlacementSolver:
 
     def can_batch(self, strategy: str) -> bool:
         return strategy in BATCHABLE_STRATEGIES
-
-    def pack_queue(
-        self,
-        strategy: str,
-        tensors,
-        rows: Sequence[tuple[Resources, Resources, int, bool]],
-        driver_candidate_names: Sequence[str],
-        domain_mask: np.ndarray | None = None,
-    ) -> list["QueueDecision"]:
-        """Admit a FIFO queue of gang requests in ONE device program.
-
-        `rows` is [(driver_resources, executor_resources, executor_count,
-        skippable)] in FIFO order; the LAST row is the app being served.
-        Decisions are bit-identical to calling `pack` per row against the
-        post-admission availability (the masked-batch parity property,
-        tests/test_batched.py::test_masked_batch_matches_sequential_spark_bin_pack),
-        replacing the reference's per-earlier-driver greedy re-pack loop
-        (fitEarlierDrivers, resource.go:221-258) with one `lax.scan`.
-
-        Packing efficiencies are computed for the final row only (the one
-        the serving path reports, resource.go:347-350); earlier rows carry
-        zeros.
-        """
-        if strategy not in BATCHABLE_STRATEGIES:
-            raise ValueError(f"strategy {strategy!r} is not batchable")
-        if not rows:
-            return []
-        n = tensors.available.shape[0]
-        host = _host_view(tensors)
-        driver_mask = self.candidate_mask(tensors, driver_candidate_names)
-        domain = (
-            np.asarray(host.valid) if domain_mask is None else np.asarray(domain_mask)
-        )
-        b = len(rows)
-        counts = [int(r[2]) for r in rows]
-        emax = _bucket(max(max(counts), 1), 8)
-        apps = make_app_batch(
-            np.stack([r[0].as_array() for r in rows]),
-            np.stack([r[1].as_array() for r in rows]),
-            np.asarray(counts, np.int32),
-            skippable=[bool(r[3]) for r in rows],
-            pad_to=_bucket(b, 4),
-            driver_cand=np.broadcast_to(driver_mask, (b, n)),
-            domain=np.broadcast_to(domain, (b, n)),
-        )
-        from spark_scheduler_tpu.tracing import tracer
-
-        with tracer().span(
-            "solve", strategy=strategy, nodes=n, queue_rows=b, batched=True
-        ):
-            out = batched_fifo_pack(
-                tensors, apps, fill=strategy, emax=emax,
-                num_zones=self._num_zones_bucket(),
-            )
-
-            # ONE device->host transfer for the decisions (tunneled-TPU
-            # RTTs: see pack()); available_after is pulled only on the
-            # efficiency branch below.
-
-            drivers, execs, admitted, packed = jax.device_get(
-                (out.driver_node, out.executor_nodes, out.admitted, out.packed)
-            )
-
-        # Efficiency of the final row against the availability it packed
-        # into: reconstructed entirely on the host by subtracting the
-        # EARLIER admitted rows' placements from the pre-solve availability
-        # (all placements are already transferred) — no second device
-        # launch, no available_after pull. Only computed on admission: the
-        # serving path reports efficiency solely for successful packs
-        # (resource.go:347-350).
-        last = b - 1
-        eff = None
-        if admitted[last]:
-            avail_before = np.array(np.asarray(host.available), dtype=np.int64)
-            for i in range(last):
-                if not admitted[i]:
-                    continue
-                if drivers[i] >= 0:
-                    avail_before[drivers[i]] -= rows[i][0].as_array()
-                for e in execs[i]:
-                    if e >= 0:
-                        avail_before[e] -= rows[i][1].as_array()
-            eff = avg_packing_efficiency_np(
-                np.asarray(host.schedulable),
-                avail_before,
-                int(drivers[last]),
-                execs[last],
-                rows[last][0].as_array(),
-                rows[last][1].as_array(),
-            )
-
-        decisions = []
-        for i in range(b):
-            exec_idx = [int(x) for x in execs[i] if int(x) >= 0]
-            with_eff = eff is not None and i == last
-            decisions.append(
-                QueueDecision(
-                    packing=HostPacking(
-                        driver_node=(
-                            self.registry.name_of(int(drivers[i]))
-                            if drivers[i] >= 0
-                            else None
-                        ),
-                        executor_nodes=[self.registry.name_of(x) for x in exec_idx],
-                        has_capacity=bool(packed[i]),
-                        efficiency_max=float(eff.max) if with_eff else 0.0,
-                        efficiency_cpu=float(eff.cpu) if with_eff else 0.0,
-                        efficiency_memory=float(eff.memory) if with_eff else 0.0,
-                        efficiency_gpu=float(eff.gpu) if with_eff else 0.0,
-                    ),
-                    packed=bool(packed[i]),
-                    admitted=bool(admitted[i]),
-                )
-            )
-        return decisions
 
     def pack_window(
         self,
@@ -530,9 +443,13 @@ class PlacementSolver:
         drivers (hypothetical rows) followed by its own application (the
         committing row). Availability rewinds to a threaded base between
         segments, so each segment sees exactly what that request's solo
-        `pack_queue` call would have seen — decisions are identical to
-        serving the requests one at a time in window order, including the
-        FIFO earlier-driver semantics (resource.go:221-258).
+        solve would have seen — decisions are identical to serving the
+        requests one at a time in window order, including the FIFO
+        earlier-driver semantics (resource.go:221-258). Within a segment
+        the priority orders are computed ONCE from the segment-start
+        availability, exactly as the reference sorts once per request
+        (resource.go:299) and reuses the orders while only availability
+        mutates.
 
         Replaces the reference's one-pod-per-call extender protocol
         limitation (cmd/endpoints.go:28-42, SURVEY.md §2d row 1): the
@@ -554,11 +471,12 @@ class PlacementSolver:
         dom_rows: list[np.ndarray] = []
         for req in requests:
             cand = self.candidate_mask(tensors, req.driver_candidate_names)
-            dom = (
-                valid_np
-                if req.domain_node_names is None
-                else self.candidate_mask(tensors, req.domain_node_names) & valid_np
-            )
+            if req.domain_mask is not None:
+                dom = np.asarray(req.domain_mask) & valid_np
+            elif req.domain_node_names is not None:
+                dom = self.candidate_mask(tensors, req.domain_node_names) & valid_np
+            else:
+                dom = valid_np
             for j, row in enumerate(req.rows):
                 flat_rows.append(row)
                 commit.append(j == len(req.rows) - 1)
@@ -589,14 +507,16 @@ class PlacementSolver:
             "solve", strategy=strategy, nodes=n, window_requests=len(requests),
             window_rows=b, batched=True,
         ):
-            out = batched_fifo_pack(
-                tensors, apps, fill=strategy, emax=emax,
-                num_zones=self._num_zones_bucket(),
+            blob = jax.device_get(
+                _window_blob(
+                    tensors, apps, fill=strategy, emax=emax,
+                    num_zones=self._num_zones_bucket(),
+                )
             )
-
-            drivers, execs, admitted, packed = jax.device_get(
-                (out.driver_node, out.executor_nodes, out.admitted, out.packed)
-            )
+            drivers = blob[:, 0]
+            admitted = blob[:, 1].astype(bool)
+            packed = blob[:, 2].astype(bool)
+            execs = blob[:, 3:]
 
         # Host-side reconstruction for per-request packing efficiency: the
         # availability each admitted request's final pack saw = start

@@ -55,7 +55,28 @@ class SparkApplicationResources:
 def spark_resources(pod: Pod) -> SparkApplicationResources:
     """Parse the driver's annotation set (sparkpods.go:79-138), with the same
     validation: ExecutorCount required iff static allocation; DA min/max
-    required iff dynamic; GPUs optional."""
+    required iff dynamic; GPUs optional.
+
+    Memoized per pod OBJECT: the FIFO path re-parses every pending earlier
+    driver on every request (quadratic in queue depth), and exact-decimal
+    quantity parsing is the host hot spot under windowed serving. Updated
+    pods arrive as fresh objects (the backend replaces, never mutates), so
+    object identity is a safe cache key."""
+    cached = pod.__dict__.get("_spark_resources_cache")
+    if cached is not None:
+        if isinstance(cached, SparkPodError):
+            raise cached
+        return cached
+    try:
+        out = _parse_spark_resources(pod)
+    except SparkPodError as exc:
+        pod.__dict__["_spark_resources_cache"] = exc
+        raise
+    pod.__dict__["_spark_resources_cache"] = out
+    return out
+
+
+def _parse_spark_resources(pod: Pod) -> SparkApplicationResources:
     ann = pod.annotations
     da_raw = ann.get(DYNAMIC_ALLOCATION_ENABLED)
     dynamic = False

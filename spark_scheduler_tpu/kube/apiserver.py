@@ -109,6 +109,17 @@ class FakeKubeAPIServer:
         self._rv = 0
         self._closed = False
         self._required_token = required_token
+        # Fault injection (chaos soak, VERDICT r2 #8). All default off:
+        #   chaos_conflict_rate      spurious 409 Conflict on create/update
+        #   chaos_drop_rate          connection closed mid-request
+        #   terminating_namespaces   creates rejected 403 NamespaceTerminating
+        import random as _random
+
+        self.chaos_conflict_rate = 0.0
+        self.chaos_drop_rate = 0.0
+        self.terminating_namespaces: set[str] = set()
+        self._chaos_rng = _random.Random(0)
+        self.chaos_injected = {"conflicts": 0, "drops": 0, "ns_terminating": 0}
         self.collections: dict[str, _Collection] = {
             res: _Collection(res, namespaced, kind, prefix)
             for res, namespaced, kind, prefix in COLLECTIONS
@@ -359,6 +370,8 @@ class FakeKubeAPIServer:
             self._write_json(handler, 404, self._status(404, "NotFound", parsed.path))
             return
         col, ns, name = resolved
+        if self._drop_connection(handler):
+            return
         query = parse_qs(parsed.query)
         if name:
             with self._lock:
@@ -502,6 +515,18 @@ class FakeKubeAPIServer:
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
 
+    def _drop_connection(self, handler) -> bool:
+        """Chaos: abruptly close the socket (client sees a reset/short read)."""
+        if self.chaos_drop_rate and self._chaos_rng.random() < self.chaos_drop_rate:
+            self.chaos_injected["drops"] += 1
+            try:
+                handler.connection.close()
+            except OSError:
+                pass
+            handler.close_connection = True
+            return True
+        return False
+
     def _handle_write(self, handler, verb: str) -> None:
         parsed = urlparse(handler.path)
         resolved = self._resolve(parsed.path)
@@ -509,6 +534,35 @@ class FakeKubeAPIServer:
             self._write_json(handler, 404, self._status(404, "NotFound", parsed.path))
             return
         col, ns, name = resolved
+        if self._drop_connection(handler):
+            return
+        if (
+            verb == "create"
+            and ns in self.terminating_namespaces
+        ):
+            self.chaos_injected["ns_terminating"] += 1
+            self._write_json(
+                handler,
+                403,
+                self._status(
+                    403,
+                    "NamespaceTerminating",
+                    f"namespace {ns} is being terminated",
+                ),
+            )
+            return
+        if (
+            verb in ("create", "update")
+            and self.chaos_conflict_rate
+            and self._chaos_rng.random() < self.chaos_conflict_rate
+        ):
+            self.chaos_injected["conflicts"] += 1
+            self._write_json(
+                handler,
+                409,
+                self._status(409, "Conflict", "chaos: injected write conflict"),
+            )
+            return
         body: dict[str, Any] = {}
         length = int(handler.headers.get("Content-Length") or 0)
         if length:

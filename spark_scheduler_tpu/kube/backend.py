@@ -40,6 +40,7 @@ from spark_scheduler_tpu.store.backend import (
     BackendError,
     ConflictError,
     InMemoryBackend,
+    NamespaceTerminatingError,
     NotFoundError,
 )
 
@@ -164,6 +165,10 @@ def _raise_for_status(status: int, body: dict, context: str) -> None:
         raise AlreadyExistsError(f"{context}: {message}")
     if status == 409:
         raise ConflictError(f"{context}: {message}")
+    if status == 403 and reason == "NamespaceTerminating":
+        # Not retryable: the async write-back drops the create outright
+        # (async.go:88-96).
+        raise NamespaceTerminatingError(f"{context}: {message}")
     if status == 404:
         raise NotFoundError(f"{context}: {message}")
     if status == 422:

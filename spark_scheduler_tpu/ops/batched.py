@@ -138,7 +138,9 @@ def batched_fifo_pack(
     _check_cumsum_bound(n, emax)
 
     segmented = apps.commit is not None
-    masked = apps.driver_cand is not None or apps.domain is not None
+    # Segmented windows always run with per-row masks (synthesized all-true
+    # when absent): each segment is one serving request.
+    masked = segmented or apps.driver_cand is not None or apps.domain is not None
     if not masked:
         # Queue mode: shared eligibility, orders fixed from the starting
         # availability (fitEarlierDrivers reuses the orders computed at
@@ -169,9 +171,39 @@ def batched_fifo_pack(
     else:
         extra = ()
 
+    def _fresh_orders(avail, driver_elig, exec_elig, domain):
+        """Priority orders from the given availability (the sort at
+        resource.go:299)."""
+        zrank = zone_ranks(cluster, domain, num_zones, available=avail)
+        d_order, _ = priority_order(
+            cluster, driver_elig, zrank, cluster.label_rank_driver,
+            available=avail,
+        )
+        e_order, _ = priority_order(
+            cluster, exec_elig, zrank, cluster.label_rank_executor,
+            available=avail,
+        )
+        d_rank = _rank_of_position(d_order)
+        out = (d_order, d_rank, e_order)
+        if single_az:
+            out = out + single_az_orders(
+                cluster, driver_elig, exec_elig, zrank, num_zones,
+                available=avail,
+            )
+        return out
+
+    def _orders_placeholder():
+        z = jnp.zeros(n, jnp.int32)
+        out = (z, z, z)
+        if single_az:
+            zb = jnp.zeros((num_zones, n), jnp.bool_)
+            zi = jnp.zeros((num_zones, n), jnp.int32)
+            out = out + (zb, zb, zi, zi, zi)
+        return out
+
     def step(carry, app):
         if segmented:
-            base, avail, blocked = carry
+            base, avail, blocked, carried_orders = carry
             (driver_req, exec_req, count, valid, skippable,
              commit, reset, *masks) = app
             # Segment boundary: rewind to the committed base; FIFO blocking
@@ -189,28 +221,35 @@ def batched_fifo_pack(
         count = jnp.minimum(count, emax)
 
         if masked:
-            # Per-app masks: reproduce a standalone spark_bin_pack call with
-            # these masks against the CURRENT availability — ordering and
-            # zone ranks recomputed per step exactly as each serving request
-            # recomputes them from post-admission usage.
             domain = dom_i & cluster.valid
             driver_elig = domain & cand_i
             exec_elig = domain & ~cluster.unschedulable & cluster.ready
-            zrank = zone_ranks(cluster, domain, num_zones, available=avail)
-            d_order, _ = priority_order(
-                cluster, driver_elig, zrank, cluster.label_rank_driver,
-                available=avail,
+
+        if segmented:
+            # One sort per SEGMENT (= per serving request), computed from
+            # the segment-start availability and reused for every row of
+            # the segment — exactly the reference, which sorts once per
+            # request (resource.go:299) and reuses the orders across
+            # fitEarlierDrivers and the final pack while only availability
+            # mutates. lax.cond executes the sort only on reset rows.
+            orders = jax.lax.cond(
+                reset,
+                lambda: _fresh_orders(avail, driver_elig, exec_elig, domain),
+                lambda: carried_orders,
             )
-            e_order, _ = priority_order(
-                cluster, exec_elig, zrank, cluster.label_rank_executor,
-                available=avail,
-            )
-            d_rank = _rank_of_position(d_order)
+            d_order, d_rank, e_order = orders[:3]
             if single_az:
-                zone_orders = single_az_orders(
-                    cluster, driver_elig, exec_elig, zrank, num_zones,
-                    available=avail,
-                )
+                zone_orders = orders[3:]
+        elif masked:
+            # Per-app masks without segments: each row reproduces a
+            # standalone spark_bin_pack call with these masks against the
+            # CURRENT availability — ordering and zone ranks recomputed per
+            # step exactly as each serving request recomputes them from
+            # post-admission usage.
+            orders = _fresh_orders(avail, driver_elig, exec_elig, domain)
+            d_order, d_rank, e_order = orders[:3]
+            if single_az:
+                zone_orders = orders[3:]
         else:
             driver_elig, exec_elig = driver_elig0, exec_elig0
             d_order, d_rank, e_order = d_order0, d_rank0, e_order0
@@ -265,7 +304,7 @@ def batched_fifo_pack(
             base = jnp.where(
                 admitted & commit, base - delta.astype(base.dtype), base
             )
-            new_carry = (base, new_avail, blocked)
+            new_carry = (base, new_avail, blocked, orders)
         else:
             new_carry = (new_avail, blocked)
         return new_carry, (out_driver, out_execs, admitted, packed)
@@ -279,7 +318,12 @@ def batched_fifo_pack(
     )
     if segmented:
         xs = xs + (apps.commit, apps.reset)
-        init = (cluster.available, cluster.available, jnp.bool_(False))
+        init = (
+            cluster.available,
+            cluster.available,
+            jnp.bool_(False),
+            _orders_placeholder(),
+        )
     else:
         init = (cluster.available, jnp.bool_(False))
     final_carry, (drivers, execs, admitted, packed) = jax.lax.scan(

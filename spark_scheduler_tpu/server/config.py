@@ -40,8 +40,8 @@ class InstallConfig:
     port: int = 8484
     sync_writes: bool = False  # drain write-back inline (tests/single-thread)
     # One batched device solve per driver request (FIFO prefix + current
-    # app); False forces the per-earlier-driver sequential loop. Decisions
-    # are identical either way (core/solver.py pack_queue).
+    # app, core/solver.py pack_window); False forces the per-earlier-driver
+    # sequential loop.
     batched_admission: bool = True
     # Append a JSON line per metric series on every reporter tick (the
     # reference's 30s metric flush, metrics/metrics.go:79). None = off;
@@ -79,6 +79,12 @@ class InstallConfig:
     # Expose /debug/* (trace dump + JAX profiler control). Off by default:
     # on the cluster-exposed port these routes are unauthenticated.
     debug_routes: bool = False
+    # Predicate window tuning: max coalesced requests per device solve, and
+    # the busy-period accumulation hold (how long the dispatcher waits for
+    # stragglers after a coalesced window — a throughput/latency tradeoff;
+    # a lone request on an idle server is never held).
+    predicate_max_window: int = 32
+    predicate_hold_ms: float = 25.0
     # Path to the REFRESHABLE runtime-config YAML (the witchcraft Runtime
     # embed, config.go:24-47): log level, fifo, batched-admission, and the
     # async retry budget reload live on file change or SIGHUP
@@ -147,6 +153,8 @@ class InstallConfig:
             kube_api_burst=int(raw.get("burst", 10)),
             request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
             debug_routes=bool(raw.get("debug-routes", False)),
+            predicate_max_window=int(raw.get("predicate-max-window", 32)),
+            predicate_hold_ms=float(raw.get("predicate-hold-ms", 25.0)),
             runtime_config_path=raw.get("runtime-config-path"),
         )
 

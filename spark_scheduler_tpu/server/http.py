@@ -44,16 +44,31 @@ class PredicateBatcher:
     `extender.predicate_batch` solves (VERDICT r2 #1).
 
     A single dispatcher thread drains the queue: whatever arrived while the
-    previous window was being served forms the next window — no artificial
-    accumulation delay, so an idle server serves a lone request immediately
-    (window of 1 = the solo path), and a loaded server amortizes one device
+    previous window was being served forms the next window, plus — during
+    busy periods only — a short accumulation hold (`hold_ms`) so clients
+    answering the previous window can rejoin and windows stay near the
+    concurrency level. An idle server serves a lone request immediately
+    (window of 1 = the solo path); a loaded server amortizes one device
     solve over every queued request. The dispatcher thread is ALSO the
     serialization point for mutable scheduling state, replacing the
     per-request lock (SURVEY.md §7 "Mutable-state races")."""
 
-    def __init__(self, extender, max_window: int = 32):
+    def __init__(self, extender, max_window: int = 32, hold_ms: float = 25.0):
         self._extender = extender
         self._max_window = max_window
+        # Adaptive accumulation: when the PREVIOUS window was coalesced
+        # (>1 request — i.e. we are in a busy period), hold up to hold_ms
+        # for stragglers before solving, so clients answering the previous
+        # window have time to submit their next request and windows stay
+        # near the concurrency level instead of oscillating small. A lone
+        # request on an idle server is never held.
+        self._hold_s = hold_ms / 1e3
+        self._last_window = 1
+        # The hold engages only while a busy period is LIVE: within this
+        # TTL of the previous coalesced window. A lone request on a
+        # since-idle server is served immediately.
+        self._busy_ttl_s = 2.0
+        self._busy_until = 0.0
         self._cv = threading.Condition()
         self._queue: list[list] = []  # [args, event, result, exception]
         self._stopped = False
@@ -98,10 +113,25 @@ class PredicateBatcher:
         self._thread.join(timeout=5)
 
     def _run(self) -> None:
+        import time as _time
+
         while True:
             with self._cv:
                 while not self._queue and not self._stopped:
                     self._cv.wait()
+                busy = (
+                    self._last_window > 1
+                    and _time.monotonic() < self._busy_until
+                )
+                if not self._stopped and self._hold_s > 0 and busy:
+                    deadline = _time.monotonic() + self._hold_s
+                    while (
+                        len(self._queue) < self._max_window and not self._stopped
+                    ):
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
                 if self._stopped:
                     for entry in self._queue:
                         entry[3] = RuntimeError("scheduler is shutting down")
@@ -110,6 +140,9 @@ class PredicateBatcher:
                     return
                 batch = self._queue[: self._max_window]
                 del self._queue[: self._max_window]
+                self._last_window = len(batch)
+                if len(batch) > 1:
+                    self._busy_until = _time.monotonic() + self._busy_ttl_s
             try:
                 results = self._serve_window(batch)
             except Exception as exc:  # whole-window failure
@@ -184,6 +217,12 @@ class _JSONHandler(BaseHTTPRequestHandler):
             self._write(400, {"error": str(exc)})
             return
         self._write(200, convert_review(review))
+
+
+class _Server(ThreadingHTTPServer):
+    # Default listen backlog (5) resets connections under a concurrent
+    # client burst — exactly the load the predicate batcher exists for.
+    request_queue_size = 128
 
 
 def _run_threaded(server: ThreadingHTTPServer, name: str) -> threading.Thread:
@@ -267,7 +306,12 @@ class SchedulerHTTPServer:
         # Concurrent predicates coalesce into windowed batch solves; the
         # batcher's dispatcher thread is the serialization point for mutable
         # scheduling state (SURVEY.md §7 "Mutable-state races").
-        self.batcher = PredicateBatcher(app.extender)
+        cfg = getattr(app, "config", None)
+        self.batcher = PredicateBatcher(
+            app.extender,
+            max_window=getattr(cfg, "predicate_max_window", 32),
+            hold_ms=getattr(cfg, "predicate_hold_ms", 25.0),
+        )
         outer = self
 
         class Handler(_JSONHandler):
@@ -414,7 +458,7 @@ class SchedulerHTTPServer:
         # handler thread forever (the extender protocol budget is 30 s,
         # examples/extender.yml:59).
         Handler.timeout = request_timeout_s
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server = _Server((host, port), Handler)
         self.tls = _maybe_wrap_tls(
             self._server, cert_file, key_file, client_ca_files,
             handshake_timeout_s=request_timeout_s,
@@ -504,7 +548,7 @@ class ConversionWebhookServer:
                     self._write(404, {"error": "not found"})
 
         Handler.timeout = request_timeout_s
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server = _Server((host, port), Handler)
         self.tls = _maybe_wrap_tls(
             self._server, cert_file, key_file, client_ca_files,
             handshake_timeout_s=request_timeout_s,

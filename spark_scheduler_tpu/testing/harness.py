@@ -137,6 +137,7 @@ class Harness:
         # used as-is; default is a fresh in-memory cluster.
         self.backend = backend if backend is not None else InMemoryBackend()
         self.backend.register_crd(DEMAND_CRD)
+        config_kw.setdefault("sync_writes", True)
         self.app: SchedulerApp = build_scheduler_app(
             self.backend,
             InstallConfig(
@@ -146,7 +147,6 @@ class Harness:
                 should_schedule_dynamically_allocated_executors_in_same_az=(
                     same_az_dynamic_allocation
                 ),
-                sync_writes=True,
                 **config_kw,
             ),
             metrics=metrics,

@@ -79,46 +79,45 @@ def _flatten_segments(segments, n):
     return flat, commit, reset, cands, doms, real_row_of
 
 
+def _segment_batch(segments, n):
+    flat, commit, reset, cands, doms, real_row_of = _flatten_segments(
+        segments, n
+    )
+    return (
+        make_app_batch(
+            np.stack([r[0] for r in flat]),
+            np.stack([r[1] for r in flat]),
+            np.asarray([r[2] for r in flat], np.int32),
+            skippable=[r[3] for r in flat],
+            driver_cand=np.stack(cands),
+            domain=np.stack(doms),
+            commit=commit,
+            reset=reset,
+        ),
+        real_row_of,
+    )
+
+
 @pytest.mark.parametrize("fill", ["tightly-pack", "az-aware-tightly-pack"])
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_segmented_scan_matches_per_segment_solves(fill, seed):
-    """The segmented window scan == solving each segment as its own masked
-    batch against the threaded base availability (exactly the sequential
-    serving semantics pack_window encodes)."""
+    """The WINDOWING property: a multi-segment scan == solving each segment
+    as its own ONE-segment window against the threaded base availability
+    (segments are independent requests given the committed base)."""
     rng = np.random.default_rng(seed)
     c = random_cluster(rng, 32)
     n = 32
     segments = _random_segments(rng, 5, n)
-    flat, commit, reset, cands, doms, real_row_of = _flatten_segments(
-        segments, n
-    )
-    apps = make_app_batch(
-        np.stack([r[0] for r in flat]),
-        np.stack([r[1] for r in flat]),
-        np.asarray([r[2] for r in flat], np.int32),
-        skippable=[r[3] for r in flat],
-        driver_cand=np.stack(cands),
-        domain=np.stack(doms),
-        commit=commit,
-        reset=reset,
-    )
+    apps, real_row_of = _segment_batch(segments, n)
     got = batched_fifo_pack(c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
 
-    # Oracle: per-segment masked batches threaded host-side.
     base = np.asarray(c.available).copy()
     for s_idx, seg in enumerate(segments):
         rows = list(seg["rows"])
-        sub = make_app_batch(
-            np.stack([r[0] for r in rows]),
-            np.stack([r[1] for r in rows]),
-            np.asarray([r[2] for r in rows], np.int32),
-            skippable=[r[3] for r in rows],
-            driver_cand=np.broadcast_to(seg["cand"], (len(rows), n)),
-            domain=np.broadcast_to(seg["dom"], (len(rows), n)),
-        )
+        sub, sub_real = _segment_batch([seg], n)
         ci = dataclasses.replace(c, available=base.astype(np.int32))
         want = batched_fifo_pack(ci, sub, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
-        last = len(rows) - 1
+        last = sub_real[0]
         real = real_row_of[s_idx]
         assert bool(got.admitted[real]) == bool(want.admitted[last]), (
             f"segment {s_idx} admitted"
@@ -133,14 +132,81 @@ def test_segmented_scan_matches_per_segment_solves(fill, seed):
         )
         if bool(want.admitted[last]):
             drv = int(want.driver_node[last])
-            base[drv] -= np.asarray(rows[last][0])
+            base[drv] -= np.asarray(rows[-1][0])
             for e in np.asarray(want.executor_nodes[last]):
                 if e >= 0:
-                    base[e] -= np.asarray(rows[last][1])
+                    base[e] -= np.asarray(rows[-1][1])
     live = np.asarray(c.valid)
     np.testing.assert_array_equal(
         np.asarray(got.available_after)[live], base[live]
     )
+
+
+@pytest.mark.parametrize("seed", [0, 3, 9])
+def test_segment_semantics_match_reference_greedy(seed):
+    """Within a segment: orders are computed ONCE from the segment-start
+    availability and reused for every row (the reference sorts once per
+    request, resource.go:299, and fitEarlierDrivers reuses the orders while
+    only availability mutates). Oracle: greedy fixed-order packing."""
+    from tests import greedy_oracle as G
+
+    rng = np.random.default_rng(seed)
+    c = random_cluster(rng, 24)
+    n = 24
+    segments = _random_segments(rng, 4, n)
+    apps, real_row_of = _segment_batch(segments, n)
+    got = batched_fifo_pack(
+        c, apps, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES
+    )
+
+    base = np.asarray(c.available).astype(np.int64).copy()
+    valid = np.asarray(c.valid)
+    zone = np.asarray(c.zone_id)
+    names = np.asarray(c.name_rank)
+    row0 = 0
+    for s_idx, seg in enumerate(segments):
+        dom = seg["dom"] & valid
+        d_elig = dom & seg["cand"]
+        e_elig = dom & ~np.asarray(c.unschedulable) & np.asarray(c.ready)
+        # Orders from the SEGMENT-START availability, fixed for the segment.
+        d_order = G.greedy_priority_order(
+            base, zone, names, d_elig, domain=dom,
+            label_rank=np.asarray(c.label_rank_driver),
+        )
+        e_order = G.greedy_priority_order(
+            base, zone, names, e_elig, domain=dom,
+            label_rank=np.asarray(c.label_rank_executor),
+        )
+        avail = base.copy()
+        blocked = False
+        for j, row in enumerate(seg["rows"]):
+            flat_j = row0 + j
+            dreq = np.asarray(row[0], np.int64)
+            ereq = np.asarray(row[1], np.int64)
+            count = int(min(row[2], EMAX))
+            drv, execs, ok, _ = G.greedy_spark_bin_pack(
+                avail, dreq, ereq, count, d_order, e_order, "tightly-pack"
+            )
+            packed = ok and int(row[2]) <= EMAX
+            admitted = packed and not blocked
+            assert bool(got.packed[flat_j]) == packed, (s_idx, j)
+            assert bool(got.admitted[flat_j]) == admitted, (s_idx, j)
+            if admitted:
+                assert int(got.driver_node[flat_j]) == drv, (s_idx, j)
+                got_execs = [
+                    int(x) for x in np.asarray(got.executor_nodes[flat_j]) if x >= 0
+                ]
+                assert got_execs == list(execs), (s_idx, j)
+                avail[drv] -= dreq
+                for nd in execs:
+                    avail[nd] -= ereq
+                if j == len(seg["rows"]) - 1:  # the committing request row
+                    base[drv] -= dreq
+                    for nd in execs:
+                        base[nd] -= ereq
+            if not packed and not row[3]:
+                blocked = True
+        row0 += len(seg["rows"])
 
 
 # ----------------------------------------------------------------- extender
