@@ -53,9 +53,15 @@ class PredicateBatcher:
     serialization point for mutable scheduling state, replacing the
     per-request lock (SURVEY.md §7 "Mutable-state races")."""
 
-    def __init__(self, extender, max_window: int = 32, hold_ms: float = 25.0):
+    def __init__(
+        self, extender, max_window: int = 32, hold_ms: float = 25.0, registry=None
+    ):
         self._extender = extender
         self._max_window = max_window
+        # Window-size histogram + wait time in the tagged registry (the
+        # reference's metric discipline for every serving subsystem,
+        # metrics/metrics.go:29-76).
+        self._registry = registry
         # Adaptive accumulation: when the PREVIOUS window was coalesced
         # (>1 request — i.e. we are in a busy period), hold up to hold_ms
         # for stragglers before solving, so clients answering the previous
@@ -153,6 +159,10 @@ class PredicateBatcher:
             self.windows_served += 1
             self.requests_served += len(batch)
             self.max_window_seen = max(self.max_window_seen, len(batch))
+            if self._registry is not None:
+                self._registry.histogram(
+                    "foundry.spark.scheduler.predicate.window"
+                ).update(len(batch))
             for entry, result in zip(batch, results):
                 entry[2] = result
                 entry[1].set()
@@ -311,6 +321,7 @@ class SchedulerHTTPServer:
             app.extender,
             max_window=getattr(cfg, "predicate_max_window", 32),
             hold_ms=getattr(cfg, "predicate_hold_ms", 25.0),
+            registry=registry,
         )
         outer = self
 

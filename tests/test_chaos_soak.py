@@ -111,6 +111,88 @@ def test_chaos_soak_reservations_converge(chaos_apiserver):
     backend.stop()
 
 
+def test_chaos_storm_under_concurrent_windowed_serving(chaos_apiserver):
+    """The full stack under simultaneous stress: concurrent HTTP clients
+    coalescing into windowed solves WHILE the apiserver storms (409s,
+    dropped connections, 410 relists). Every client gets a placement, the
+    window batcher actually coalesced, and reservations converge."""
+    import http.client
+    import json
+    import threading
+
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+
+    server = chaos_apiserver
+    backend = KubeBackend(server.base_url, qps=10_000, burst=10_000)
+    backend.start()
+    assert backend.wait_synced(timeout=5.0)
+    h = Harness(
+        backend=backend,
+        binpack_algo="tightly-pack",
+        fifo=True,
+        sync_writes=False,
+        async_client_retry_count=25,
+    )
+    names = [f"wn{i}" for i in range(24)]
+    h.add_nodes(*(new_node(n) for n in names))
+    http_server = SchedulerHTTPServer(h.app, host="127.0.0.1", port=0)
+    http_server.start()
+
+    server.chaos_conflict_rate = 0.25
+    server.chaos_drop_rate = 0.10
+
+    n_clients = 10
+    errors: list = []
+
+    def client(i):
+        try:
+            pods = static_allocation_spark_pods(f"storm-{i}", 2)
+            backend.add_pod(pods[0])
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", http_server.port, timeout=120
+            )
+            body = json.dumps(
+                {"Pod": pod_to_k8s(pods[0]), "NodeNames": names}
+            ).encode()
+            conn.request("POST", "/predicates", body=body)
+            resp = json.loads(conn.getresponse().read())
+            conn.close()
+            assert resp.get("NodeNames"), (i, resp)
+            backend.bind_pod(pods[0], resp["NodeNames"][0])
+        except Exception as exc:  # surfaced after join
+            errors.append(exc)
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+    finally:
+        server.chaos_conflict_rate = 0.0
+        server.chaos_drop_rate = 0.0
+
+    h.app.rr_cache.flush()
+    assert wait_until(
+        lambda: all(
+            ("namespace", f"storm-{i}")
+            in server.collections["resourcereservations"].objects
+            for i in range(n_clients)
+        ),
+        timeout=10.0,
+    )
+    assert http_server.batcher.stats()["requests_served"] == n_clients
+    m = h.app.rr_cache.client.metrics
+    assert m.dropped == 0, vars(m)
+
+    http_server.stop()
+    backend.stop()
+
+
 def test_namespace_terminating_create_dropped_without_retry_storm(chaos_apiserver):
     server = chaos_apiserver
     backend = KubeBackend(server.base_url, qps=10_000, burst=10_000)
