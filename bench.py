@@ -194,6 +194,23 @@ def bench_config2(rng):
     )
 
 
+def bench_config2_az_aware(rng):
+    """#2b (VERDICT r2 #2 done-criterion): the same 100-driver FIFO window
+    with the az-aware single-AZ strategy — per-zone pack + efficiency-scored
+    zone selection INSIDE the scan step — must stay within ~2x of the plain
+    fills."""
+    cluster = _make_cluster(rng, 500, 4)
+    batches = _make_batches(rng, 1200, 100, 8, exec_count=8, skippable=False)
+    chain = _windowed_chain(cluster, batches, "az-aware-tightly-pack", 8, 4)
+    ms = _measure_marginal_ms(chain, len(batches))
+    _emit(
+        "config2b_fifo100_az_aware_window_service_ms_500_nodes",
+        ms,
+        100,
+        {"nodes": 500, "strict_fifo": True, "fill": "az-aware-tightly-pack"},
+    )
+
+
 def bench_config3(rng):
     """#3: dynamic allocation min=2/max=32, 200 apps, 1k nodes. Gang
     admission reserves min executors; the reservation shells are sized max,
@@ -535,6 +552,7 @@ def main() -> None:
     bench_tpu_parity()
     bench_config1(rng)
     bench_config2(rng)
+    bench_config2_az_aware(rng)
     bench_config3(rng)
     bench_config4(rng)
     bench_serving_http(rng)
