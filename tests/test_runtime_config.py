@@ -96,6 +96,34 @@ def test_unchanged_mtime_is_noop(tmp_path):
     assert mgr.reloads == 2
 
 
+def test_sighup_forces_reapply(tmp_path):
+    """SIGHUP re-applies the runtime config immediately (the witchcraft
+    refresh signal), even with an unchanged file mtime."""
+    import os
+    import signal
+    import time as _t
+
+    path = tmp_path / "runtime.yml"
+    _write(path, {"fifo": True})
+    h = Harness(binpack_algo="tightly-pack", fifo=False)
+    mgr = RuntimeConfigManager(h.app, str(path), poll_interval_s=60.0)
+    mgr.start()  # installs the SIGHUP handler (pytest main thread)
+    try:
+        deadline = _t.time() + 5
+        while mgr.reloads < 1 and _t.time() < deadline:
+            _t.sleep(0.01)
+        assert mgr.reloads == 1
+        assert h.app.extender._config.fifo is True
+        os.kill(os.getpid(), signal.SIGHUP)
+        deadline = _t.time() + 5
+        while mgr.reloads < 2 and _t.time() < deadline:
+            _t.sleep(0.01)
+        assert mgr.reloads == 2  # forced re-apply despite unchanged mtime
+    finally:
+        mgr.stop()
+        signal.signal(signal.SIGHUP, signal.SIG_DFL)
+
+
 def test_runtime_config_parse_defaults():
     cfg = RuntimeConfig.from_dict({})
     assert cfg.log_level is None and cfg.fifo is None
