@@ -510,6 +510,79 @@ def bench_serving_http_concurrent(rng):
     )
 
 
+def bench_serving_http_executors(rng):
+    """Executor binding throughput: after a driver's gang admission, every
+    executor request walks the reservation ladder (already-bound / unbound /
+    reschedule, resource.go:376-428) — host-side state work with no device
+    solve in the common case. Concurrent executor requests ride the same
+    predicate batcher; this measures the served executor path end to end."""
+    import http.client
+    import threading
+
+    from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
+
+    backend, app, server, node_names = _serving_fixture()
+    n_apps, execs_per_app = 8, 16
+    exec_pods = []
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=600)
+    for i in range(n_apps):
+        pods = static_allocation_spark_pods(f"exb-{i}", execs_per_app)
+        backend.add_pod(pods[0])
+        resp, _ = _post_predicate(conn, pods[0], node_names)
+        if not resp.get("NodeNames"):
+            raise RuntimeError(f"driver exb-{i} failed: {resp}")
+        backend.bind_pod(pods[0], resp["NodeNames"][0])
+        exec_pods.extend(pods[1:])
+    conn.close()
+
+    lats = []
+    lat_lock = threading.Lock()
+    errors = []
+    n_workers = 16
+    shards = [exec_pods[i::n_workers] for i in range(n_workers)]
+
+    def worker(shard):
+        try:
+            c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=600)
+            for pod in shard:
+                backend.add_pod(pod)
+                resp, dt_ms = _post_predicate(c, pod, node_names)
+                if not resp.get("NodeNames"):
+                    raise RuntimeError(f"{pod.name}: {resp}")
+                backend.bind_pod(pod, resp["NodeNames"][0])
+                with lat_lock:
+                    lats.append(dt_ms)
+            c.close()
+        except Exception as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in shards]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    try:
+        if errors:
+            raise errors[0]
+    finally:
+        server.stop()
+    p50 = float(np.percentile(lats, 50))
+    _emit(
+        "serving_http_executor_p50_ms_500_nodes",
+        p50,
+        1,
+        {
+            "nodes": 500,
+            "executors": len(lats),
+            "p95_ms": round(float(np.percentile(lats, 95)), 3),
+            "bindings_per_s": round(len(lats) / wall_s, 1),
+            "path": "concurrent executor /predicates -> reservation ladder (host-side)",
+        },
+    )
+
+
 def bench_tpu_parity():
     """Golden-parity smoke on the REAL backend, folded into every bench run
     (VERDICT r2 #5): the same oracle assertions as the CPU golden suite,
@@ -557,6 +630,7 @@ def main() -> None:
     bench_config4(rng)
     bench_serving_http(rng)
     bench_serving_http_concurrent(rng)
+    bench_serving_http_executors(rng)
     bench_config5(rng)  # north star LAST — the headline line
 
 
