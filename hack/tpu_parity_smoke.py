@@ -96,6 +96,89 @@ def run() -> dict:
                     avail[e] -= execs[i]
         checked += 1
 
+    # -- single-AZ batched admission on silicon: every admitted row must be
+    #    a reference-acceptable zone pick against the threaded availability
+    #    (the same acceptance-set oracle as
+    #    tests/test_batched.py::test_batched_single_az_matches_sequential_oracle)
+    from tests.test_batched import greedy_single_az_candidates
+
+    for strategy in ("az-aware-tightly-pack", "single-az-tightly-pack"):
+        c = TG.random_cluster(rng, N_NODES)
+        b = 5
+        drivers = rng.integers(1, 5, size=(b, 3)).astype(np.int32)
+        execs = rng.integers(1, 5, size=(b, 3)).astype(np.int32)
+        counts = rng.integers(1, emax + 1, size=b).astype(np.int32)
+        apps = make_app_batch(drivers, execs, counts, skippable=np.ones(b, bool))
+        out = jax.device_get(
+            batched_fifo_pack(c, apps, fill=strategy, emax=emax, num_zones=num_zones)
+        )
+        avail = np.asarray(c.available).astype(np.int64).copy()
+        sched = np.asarray(c.schedulable).astype(np.int64)
+        zone = np.asarray(c.zone_id)
+        dom = np.asarray(c.valid)
+        e_elig = dom & ~np.asarray(c.unschedulable) & np.asarray(c.ready)
+        d_order = G.greedy_priority_order(
+            np.asarray(c.available), zone, np.asarray(c.name_rank),
+            e_elig, domain=dom, label_rank=np.asarray(c.label_rank_driver),
+        )
+        e_order = G.greedy_priority_order(
+            np.asarray(c.available), zone, np.asarray(c.name_rank),
+            e_elig, domain=dom, label_rank=np.asarray(c.label_rank_executor),
+        )
+        for i in range(b):
+            acceptable, ok = greedy_single_az_candidates(
+                avail, sched, zone, d_order, e_order,
+                drivers[i].astype(np.int64), execs[i].astype(np.int64),
+                int(counts[i]), strategy,
+            )
+            assert bool(out.admitted[i]) == ok, (strategy, i, device)
+            if ok:
+                drv = int(out.driver_node[i])
+                got_execs = [int(x) for x in out.executor_nodes[i] if x >= 0]
+                assert (drv, got_execs) in acceptable, (strategy, i, device)
+                avail[drv] -= drivers[i]
+                for e in got_execs:
+                    avail[e] -= execs[i]
+        checked += 1
+
+    # -- segmented serving windows on silicon: multi-segment scan equals
+    #    per-segment solves threaded through the committed base (the
+    #    windowed == solo serving property, core/solver.py pack_window)
+    import dataclasses
+
+    from tests.test_window_serving import _random_segments, _segment_batch
+
+    for _ in range(2):
+        c = TG.random_cluster(rng, N_NODES)
+        segments = _random_segments(rng, 4, N_NODES)
+        apps, real_row_of = _segment_batch(segments, N_NODES)
+        got = jax.device_get(
+            batched_fifo_pack(c, apps, fill="tightly-pack", emax=8, num_zones=num_zones)
+        )
+        base = np.asarray(c.available).copy()
+        for s_idx, seg in enumerate(segments):
+            sub, sub_real = _segment_batch([seg], N_NODES)
+            ci = dataclasses.replace(c, available=base.astype(np.int32))
+            want = jax.device_get(
+                batched_fifo_pack(ci, sub, fill="tightly-pack", emax=8,
+                                  num_zones=num_zones)
+            )
+            last = sub_real[0]
+            real = real_row_of[s_idx]
+            assert bool(got.admitted[real]) == bool(want.admitted[last]), (s_idx, device)
+            assert int(got.driver_node[real]) == int(want.driver_node[last]), (s_idx, device)
+            assert np.array_equal(
+                np.asarray(got.executor_nodes[real]),
+                np.asarray(want.executor_nodes[last]),
+            ), (s_idx, device)
+            if bool(want.admitted[last]):
+                drv = int(want.driver_node[last])
+                base[drv] -= np.asarray(seg["rows"][-1][0])
+                for e in np.asarray(want.executor_nodes[last]):
+                    if e >= 0:
+                        base[e] -= np.asarray(seg["rows"][-1][1])
+        checked += 1
+
     return {"device": device, "cases_checked": checked, "parity": "ok"}
 
 
