@@ -354,13 +354,16 @@ def test_predicate_batch_fifo_blocking_window():
 
 def test_http_concurrent_requests_are_batched():
     """Concurrent POST /predicates calls coalesce into windows (>1 request
-    per solve) and every gang lands with a consistent reservation state."""
+    per solve), every gang lands with a consistent reservation state, and
+    the window-size histogram reaches the metric registry."""
+    from spark_scheduler_tpu.metrics.registry import MetricRegistry
     from spark_scheduler_tpu.server.http import SchedulerHTTPServer
     from spark_scheduler_tpu.server.kube_io import pod_to_k8s
 
+    registry = MetricRegistry()
     h = _make_harness("tightly-pack", True, 24)
     names = [f"n{i}" for i in range(24)]
-    server = SchedulerHTTPServer(h.app, host="127.0.0.1", port=0)
+    server = SchedulerHTTPServer(h.app, registry=registry, host="127.0.0.1", port=0)
     server.start()
     n_clients = 12
     results = [None] * n_clients
@@ -397,5 +400,9 @@ def test_http_concurrent_requests_are_batched():
         for i in range(n_clients):
             rr = h.get_reservation("namespace", f"conc-{i}")
             assert rr is not None and len(rr.spec.reservations) == 3
+        # window sizes landed in the registry histogram
+        snap = registry.snapshot()
+        hist = snap.get("foundry.spark.scheduler.predicate.window")
+        assert hist and hist[0].get("count", 0) >= 1, snap
     finally:
         server.stop()
