@@ -64,6 +64,8 @@ def _shard_apps(apps: AppBatch, mesh: Mesh, leading=()) -> AppBatch:
         skippable=put(apps.skippable),
         driver_cand=put(apps.driver_cand, node_axis=True),
         domain=put(apps.domain, node_axis=True),
+        commit=put(apps.commit),
+        reset=put(apps.reset),
     )
 
 
