@@ -149,18 +149,22 @@ def _emit(metric, window_ms, window_apps, extra=None):
     )
 
 
-def _windowed_chain(cluster, batches, fill, emax, num_zones):
+def _windowed_chain(cluster, batches, fill, emax, num_zones, *, force_xla=False):
+    """Queue-mode solves route through fifo_pack_auto: the Pallas VMEM-
+    resident kernel on TPU (ops/pallas_fifo.py), the XLA scan elsewhere —
+    the routing the public queue-admission API applies. (The serving path's
+    segmented windows re-sort per segment and always use the XLA scan.)"""
     import jax
 
-    from spark_scheduler_tpu.ops.batched import batched_fifo_pack
+    from spark_scheduler_tpu.ops.pallas_fifo import fifo_pack_auto
 
     def chain(k):
         c = cluster
         admitted = []
         for i in range(k):
-            out = batched_fifo_pack(
+            out = fifo_pack_auto(
                 c, batches[i % len(batches)], fill=fill, emax=emax,
-                num_zones=num_zones,
+                num_zones=num_zones, prefer_pallas=not force_xla,
             )
             c = dataclasses.replace(c, available=out.available_after)
             admitted.append(out.admitted)
@@ -277,10 +281,32 @@ def bench_config4(rng):
 def bench_config5(rng):
     """#5 (north star): 10k nodes x 1k apps, windows of 100 —
     the steady-state placement latency under 1k-concurrent load is the
-    per-window service time (see module docstring)."""
+    per-window service time (see module docstring). Served by the Pallas
+    queue kernel on TPU; the XLA-scan line is reported alongside so the
+    kernel-level speedup stays visible round over round."""
+    from spark_scheduler_tpu.ops.pallas_fifo import pallas_available
+
     n_apps, window, emax = 1_000, 100, 8
     cluster = _make_cluster(rng, 10_000, 4)
     batches = _make_batches(rng, n_apps, window, emax)
+
+    xla_ms = None
+    if pallas_available():
+        # Companion line: the XLA scan on the same shapes. Skipped when the
+        # backend has no Mosaic — the main line below IS the scan then, and
+        # measuring the identical path twice would just double the slowest
+        # bench config.
+        xla_chain = _windowed_chain(
+            cluster, batches, "tightly-pack", emax, 4, force_xla=True
+        )
+        xla_ms = _measure_marginal_ms(xla_chain, len(batches))
+        _emit(
+            "config5_xla_scan_window_service_ms_10k_nodes_1k_apps",
+            xla_ms,
+            window,
+            {"nodes": 10_000, "path": "lax.scan (batched_fifo_pack)"},
+        )
+
     chain = _windowed_chain(cluster, batches, "tightly-pack", emax, 4)
     full = chain(len(batches))
     n_admitted = int(full.sum())
@@ -289,7 +315,17 @@ def bench_config5(rng):
         "gang_placement_p50_window_service_ms_10k_nodes_1k_apps",
         ms,
         window,
-        {"nodes": 10_000, "admitted_of_1k": n_admitted},
+        {
+            "nodes": 10_000,
+            "admitted_of_1k": n_admitted,
+            "path": (
+                "pallas VMEM-resident queue kernel"
+                if pallas_available()
+                else "lax.scan (pallas unavailable on this backend)"
+            ),
+            "xla_scan_ms": round(xla_ms, 3) if xla_ms is not None else None,
+            "r02_ms": 10.51,
+        },
     )
 
 

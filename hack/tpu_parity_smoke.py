@@ -179,6 +179,42 @@ def run() -> dict:
                         base[e] -= np.asarray(seg["rows"][-1][1])
         checked += 1
 
+    # -- Pallas queue kernel on silicon: the Mosaic program must equal the
+    #    XLA scan decision-for-decision (same comparison as
+    #    tests/test_pallas_fifo.py, here COMPILED on the real backend).
+    from spark_scheduler_tpu.ops.pallas_fifo import (
+        PALLAS_FILLS,
+        fifo_pack_pallas,
+        pallas_available,
+    )
+
+    if pallas_available():
+        for fill in PALLAS_FILLS:
+            c = TG.random_cluster(rng, N_NODES)
+            b = 8
+            drivers = rng.integers(1, 6, size=(b, 3)).astype(np.int32)
+            execs = rng.integers(1, 8, size=(b, 3)).astype(np.int32)
+            counts = rng.integers(0, emax + 3, size=b).astype(np.int32)
+            apps = make_app_batch(
+                drivers, execs, counts,
+                skippable=rng.random(b) < 0.5,
+            )
+            want = jax.device_get(
+                batched_fifo_pack(c, apps, fill=fill, emax=emax,
+                                  num_zones=num_zones)
+            )
+            got = jax.device_get(
+                fifo_pack_pallas(c, apps, fill=fill, emax=emax,
+                                 num_zones=num_zones)
+            )
+            for field in ("driver_node", "executor_nodes", "admitted",
+                          "packed", "available_after"):
+                assert np.array_equal(
+                    np.asarray(getattr(got, field)),
+                    np.asarray(getattr(want, field)),
+                ), ("pallas", fill, field, device)
+            checked += 1
+
     return {"device": device, "cases_checked": checked, "parity": "ok"}
 
 

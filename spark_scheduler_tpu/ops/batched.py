@@ -97,6 +97,28 @@ class AppBatch(NamedTuple):
     reset: jnp.ndarray | None = None  # [B] bool
 
 
+def queue_mode_orders(cluster: ClusterTensors, num_zones: int):
+    """Queue-mode eligibility + priority orders, fixed from the starting
+    availability (fitEarlierDrivers reuses the orders computed at
+    resource.go:299 while only availability mutates). Shared by the XLA
+    scan and the Pallas queue kernel (ops/pallas_fifo.py) so the two paths
+    cannot drift.
+
+    Returns (driver_elig, exec_elig, d_order, d_rank, e_order, zrank)."""
+    domain0 = cluster.valid
+    exec_elig = domain0 & ~cluster.unschedulable & cluster.ready
+    driver_elig = exec_elig  # no kube candidate filter in queue mode
+    zrank = zone_ranks(cluster, domain0, num_zones)
+    d_order, _ = priority_order(
+        cluster, driver_elig, zrank, cluster.label_rank_driver
+    )
+    e_order, _ = priority_order(
+        cluster, exec_elig, zrank, cluster.label_rank_executor
+    )
+    d_rank = _rank_of_position(d_order)
+    return driver_elig, exec_elig, d_order, d_rank, e_order, zrank
+
+
 class BatchedPacking(NamedTuple):
     """Per-app gang placement for the whole queue."""
 
@@ -142,20 +164,9 @@ def batched_fifo_pack(
     # when absent): each segment is one serving request.
     masked = segmented or apps.driver_cand is not None or apps.domain is not None
     if not masked:
-        # Queue mode: shared eligibility, orders fixed from the starting
-        # availability (fitEarlierDrivers reuses the orders computed at
-        # resource.go:299 while only availability mutates).
-        domain0 = cluster.valid
-        exec_elig0 = domain0 & ~cluster.unschedulable & cluster.ready
-        driver_elig0 = exec_elig0  # no kube candidate filter in queue mode
-        zrank0 = zone_ranks(cluster, domain0, num_zones)
-        d_order0, _ = priority_order(
-            cluster, driver_elig0, zrank0, cluster.label_rank_driver
+        (driver_elig0, exec_elig0, d_order0, d_rank0, e_order0, zrank0) = (
+            queue_mode_orders(cluster, num_zones)
         )
-        e_order0, _ = priority_order(
-            cluster, exec_elig0, zrank0, cluster.label_rank_executor
-        )
-        d_rank0 = _rank_of_position(d_order0)
         if single_az:
             zone_orders0 = single_az_orders(
                 cluster, driver_elig0, exec_elig0, zrank0, num_zones

@@ -1,0 +1,474 @@
+"""The FIFO gang-admission queue as ONE Pallas TPU kernel.
+
+`ops/batched.batched_fifo_pack` expresses queue admission as a `lax.scan`
+whose per-step body is a handful of O(N) vector ops. At 10k nodes the step
+body is ~microseconds of VPU work, so the scan is dominated by loop-trip
+overhead (HBM round-trips for the carried availability between steps and
+XLA's per-iteration scheduling). This module removes that overhead the
+TPU-native way: the ENTIRE queue runs inside one Mosaic kernel with
+
+  - the availability tensor resident in VMEM scratch across grid steps
+    (TPU grid iterations execute sequentially on a core, so scratch carries
+    the scan state chip-side — it never round-trips to HBM);
+  - per-app parameters (requests, counts, flags) delivered via scalar
+    prefetch into SMEM;
+  - the executor fills re-derived as iterative masked-argmin placement
+    (`emax` rounds of "first open position") instead of
+    cumsum + searchsorted, because a short static loop of VPU reductions
+    beats a 10k-lane prefix scan and Mosaic has no native searchsorted.
+
+Semantics are bit-identical to `batched_fifo_pack` in queue mode (shared
+eligibility, priority orders fixed from the starting availability — the
+`fitEarlierDrivers` semantics of resource.go:221-258): the golden-parity
+suite (tests/test_pallas_fifo.py) and the on-silicon smoke
+(hack/tpu_parity_smoke.py) compare the two paths decision-for-decision.
+
+Fill derivations (reference loops -> argmin keys):
+
+  tightly-pack (pack_tightly.go:45-61): fill each node before moving on
+      == every slot goes to the FIRST position with remaining capacity
+      -> key = position.
+  distribute-evenly (distribute_evenly.go:49-71): one executor per open
+      node per round, rounds in position order
+      == every slot goes to the open position with lexicographically
+      smallest (slots already placed there, position)
+      -> key = placed * Npad + position  (placed <= emax, so no overflow).
+  minimal-fragmentation (minimal_fragmentation.go:68-98): smallest single
+      node fitting the whole gang, else consume nodes in (capacity desc,
+      position asc) order while the running clamped total stays <= count,
+      remainder on the smallest not-consumed node that fits it
+      -> <= emax consume rounds of masked max + two masked-min reductions.
+
+Masked/segmented serving windows keep the XLA path (they re-sort per
+segment inside the scan, which wants XLA's fused sorts); this kernel is the
+queue-mode hot path: the north-star 10k-node x 1k-app batched admission.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_scheduler_tpu.models.cluster import ClusterTensors, INT32_INF
+from spark_scheduler_tpu.ops.batched import (
+    AppBatch,
+    BatchedPacking,
+    queue_mode_orders,
+)
+
+PALLAS_FILLS = ("tightly-pack", "distribute-evenly", "minimal-fragmentation")
+
+_LANES = 128  # int32 lane width — the node axis pads to a multiple of this
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _make_kernel(fill: str, emax: int, n_pad: int, n_apps: int):
+    """Build the kernel body. Everything static (fill, emax, padding) is
+    closed over; per-app scalars arrive via prefetch refs."""
+
+    INF = INT32_INF
+
+    def kernel(
+        dreq_ref,  # SMEM [B, 3] i32 — driver request
+        ereq_ref,  # SMEM [B, 3] i32 — executor request
+        cnt_ref,  # SMEM [B] i32 — gang size
+        valid_ref,  # SMEM [B] i32 — app_valid
+        skip_ref,  # SMEM [B] i32 — skippable
+        avail_ref,  # VMEM [3, Np] i32 — starting availability (position order)
+        elig_e_ref,  # VMEM [1, Np] i32 — executor eligibility
+        elig_d_ref,  # VMEM [1, Np] i32 — driver eligibility
+        drank_ref,  # VMEM [1, Np] i32 — driver-priority rank per position
+        nodeid_ref,  # VMEM [1, Np] i32 — original node index per position
+        meta_out,  # VMEM [B, 4] i32 — (driver_node, admitted, packed, 0)
+        execs_out,  # VMEM [B, emax] i32
+        avail_out,  # VMEM [3, Np] i32 — availability after all admits
+        avail_scr,  # VMEM [3, Np] i32 scratch — the scan carry
+        blocked_scr,  # SMEM [1] i32 scratch — strict-FIFO blocked flag
+    ):
+        b = pl.program_id(0)
+
+        @pl.when(b == 0)
+        def _():
+            avail_scr[:] = avail_ref[:]
+            blocked_scr[0] = 0
+
+        iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_pad), 1)
+        elig_e = elig_e_ref[:] != 0
+        elig_d = elig_d_ref[:] != 0
+        drank = drank_ref[:]
+        node_id = nodeid_ref[:]
+
+        raw_count = cnt_ref[b]
+        too_big = raw_count > emax
+        count = jnp.minimum(raw_count, emax)
+        valid = valid_ref[b] != 0
+        skippable = skip_ref[b] != 0
+        blocked_in = blocked_scr[0] != 0
+
+        # --- node capacities (ops/capacity.py node_capacities, exact
+        # integer semantics: per dim 0 if reserved > avail, INF if req == 0,
+        # else floor((avail-reserved)/req); node cap = max(min over dims, 0))
+        cap_e = jnp.full((1, n_pad), INF, jnp.int32)  # no reservation
+        cap_wd = jnp.full((1, n_pad), INF, jnp.int32)  # driver reserved
+        fit_d = jnp.ones((1, n_pad), jnp.bool_)
+        for d in range(3):
+            a = avail_scr[d : d + 1, :]
+            er = ereq_ref[b, d]
+            dr = dreq_ref[b, d]
+            safe = jnp.maximum(er, 1)
+            per_e = jnp.where(
+                0 > a, 0, jnp.where(er == 0, INF, jnp.floor_divide(a, safe))
+            )
+            per_wd = jnp.where(
+                dr > a,
+                0,
+                jnp.where(er == 0, INF, jnp.floor_divide(a - dr, safe)),
+            )
+            cap_e = jnp.minimum(cap_e, per_e)
+            cap_wd = jnp.minimum(cap_wd, per_wd)
+            fit_d = fit_d & (dr <= a)
+        cap_e = jnp.where(elig_e, jnp.maximum(cap_e, 0), 0)
+        cap_wd = jnp.where(elig_e, jnp.maximum(cap_wd, 0), 0)
+
+        # --- driver selection via the feasibility identity
+        # (ops/packing.py pack_one_app): reserving the driver on node i only
+        # changes node i's executor capacity.
+        cap_e_c = jnp.minimum(cap_e, count)
+        cap_wd_c = jnp.minimum(cap_wd, count)
+        total_base = jnp.sum(cap_e_c)
+        total_if = total_base - cap_e_c + cap_wd_c
+        feasible = elig_d & fit_d & (total_if >= count)
+        best_rank = jnp.min(jnp.where(feasible, drank, INF))
+        found = best_rank < INF
+        # drank is a permutation rank -> at most one position matches.
+        p_star = jnp.min(jnp.where(feasible & (drank == best_rank), iota, INF))
+        is_drv = iota == p_star
+        driver_node = jnp.sum(jnp.where(is_drv, node_id, 0))
+
+        # Executor capacities with the chosen driver tentatively reserved.
+        caps_fill = jnp.where(is_drv, cap_wd, cap_e)
+
+        # --- executor fill: emax rounds of masked-argmin placement.
+        slot_iota = jax.lax.broadcasted_iota(jnp.int32, (1, emax), 1)
+        execs_row = jnp.full((1, emax), -1, jnp.int32)
+        exec_counts = jnp.zeros((1, n_pad), jnp.int32)
+        ok = found  # feasibility identity guarantees the fill succeeds
+
+        if fill == "tightly-pack":
+            remaining = caps_fill
+            for j in range(emax):
+                place = ok & (j < count)
+                pos_j = jnp.min(jnp.where(remaining > 0, iota, INF))
+                hit = (iota == pos_j) & place
+                node_j = jnp.sum(jnp.where(hit, node_id, 0))
+                execs_row = jnp.where(
+                    (slot_iota == j) & place, node_j, execs_row
+                )
+                remaining = remaining - hit
+                exec_counts = exec_counts + hit
+        elif fill == "distribute-evenly":
+            # key = placed * Npad + position over open positions; placed
+            # never exceeds emax so the key stays far below int32 range.
+            for j in range(emax):
+                place = ok & (j < count)
+                open_ = elig_e & (exec_counts < caps_fill)
+                key = exec_counts * n_pad + iota
+                k_min = jnp.min(jnp.where(open_, key, INF))
+                pos_j = jnp.where(k_min < INF, k_min % n_pad, INF)
+                hit = (iota == pos_j) & place
+                node_j = jnp.sum(jnp.where(hit, node_id, 0))
+                execs_row = jnp.where(
+                    (slot_iota == j) & place, node_j, execs_row
+                )
+                exec_counts = exec_counts + hit
+        elif fill == "minimal-fragmentation":
+            cap_ok = caps_fill > 0
+            caps_c = jnp.minimum(caps_fill, count)
+            # Branch A: smallest single node fitting the whole gang
+            # (minimal_fragmentation.go:68-78): min capacity, then earliest
+            # position on capacity ties.
+            mask_a = cap_ok & (caps_fill >= count)
+            exists_a = jnp.any(mask_a)
+            min_cap_a = jnp.min(jnp.where(mask_a, caps_fill, INF))
+            pos_a = jnp.min(
+                jnp.where(mask_a & (caps_fill == min_cap_a), iota, INF)
+            )
+            # Branch B: consume (clamped capacity desc, position asc) while
+            # the running total stays <= count (the maximal prefix of the
+            # reference's desc sort), remainder on the smallest
+            # not-consumed node with UNCLAMPED capacity >= remainder
+            # (minimal_fragmentation.go:80-98).
+            use_b = ok & ~exists_a
+            consumed = jnp.zeros((1, n_pad), jnp.bool_)
+            placed_total = jnp.int32(0)
+            for _ in range(emax):
+                open_b = cap_ok & ~consumed
+                c_max = jnp.max(jnp.where(open_b, caps_c, -1))
+                pos_k = jnp.min(
+                    jnp.where(open_b & (caps_c == c_max), iota, INF)
+                )
+                take = use_b & (c_max > 0) & (placed_total + c_max <= count)
+                hit = (iota == pos_k) & take
+                node_k = jnp.sum(jnp.where(hit, node_id, 0))
+                in_span = (
+                    (slot_iota >= placed_total)
+                    & (slot_iota < placed_total + c_max)
+                    & take
+                )
+                execs_row = jnp.where(in_span, node_k, execs_row)
+                exec_counts = exec_counts + jnp.where(hit, c_max, 0)
+                consumed = consumed | hit
+                placed_total = placed_total + jnp.where(take, c_max, 0)
+            remainder = count - placed_total
+            mask_fin = cap_ok & ~consumed & (caps_fill >= remainder)
+            min_cap_f = jnp.min(jnp.where(mask_fin, caps_fill, INF))
+            pos_f = jnp.min(
+                jnp.where(mask_fin & (caps_fill == min_cap_f), iota, INF)
+            )
+            need_fin = use_b & (remainder > 0)
+            chosen_pos = jnp.where(exists_a, pos_a, pos_f)
+            fin_take = ok & (exists_a | need_fin)
+            fin_count = jnp.where(exists_a, count, remainder)
+            fin_hit = (iota == chosen_pos) & fin_take
+            node_fin = jnp.sum(jnp.where(fin_hit, node_id, 0))
+            fin_start = jnp.where(exists_a, 0, placed_total)
+            in_fin = (
+                (slot_iota >= fin_start)
+                & (slot_iota < fin_start + fin_count)
+                & fin_take
+            )
+            # Branch A overwrites any branch-B spans (it is exclusive).
+            execs_row = jnp.where(
+                exists_a & (slot_iota < count) & ok,
+                node_fin,
+                jnp.where(in_fin, node_fin, execs_row),
+            )
+            exec_counts = jnp.where(
+                exists_a & ok,
+                jnp.where(iota == chosen_pos, count, 0),
+                exec_counts + jnp.where(fin_hit, fin_count, 0),
+            )
+        else:  # pragma: no cover — guarded by fifo_pack_pallas
+            raise ValueError(f"unsupported fill for pallas: {fill}")
+
+        packed = ok & valid & ~too_big
+        admitted = packed & ~blocked_in
+
+        # --- scatter-subtract the admitted gang (resource.go:251-255)
+        for d in range(3):
+            delta = exec_counts * ereq_ref[b, d] + jnp.where(
+                is_drv, dreq_ref[b, d], 0
+            )
+            a = avail_scr[d : d + 1, :]
+            avail_scr[d : d + 1, :] = jnp.where(admitted, a - delta, a)
+
+        # Strict FIFO: a non-skippable valid failure blocks the rest
+        # (resource.go:241-249).
+        blocked_scr[0] = jnp.where(
+            blocked_in | (valid & ~packed & ~skippable), 1, 0
+        ).astype(jnp.int32)
+
+        m_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 4), 1)
+        out_driver = jnp.where(admitted, driver_node, -1)
+        meta = jnp.where(
+            m_iota == 0,
+            out_driver,
+            jnp.where(
+                m_iota == 1,
+                admitted.astype(jnp.int32),
+                jnp.where(m_iota == 2, packed.astype(jnp.int32), 0),
+            ),
+        )
+        meta_out[pl.ds(b, 1), :] = meta
+        execs_out[pl.ds(b, 1), :] = jnp.where(admitted, execs_row, -1)
+
+        @pl.when(b == n_apps - 1)
+        def _():
+            avail_out[:] = avail_scr[:]
+
+    return kernel
+
+
+# Deferred imports so the module imports cleanly where jax.experimental
+# pallas is unavailable (the routing layer falls back to the XLA scan).
+try:  # pragma: no cover - import guard
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORTED = True
+except Exception:  # pragma: no cover
+    _PALLAS_IMPORTED = False
+
+
+@partial(
+    jax.jit, static_argnames=("fill", "emax", "num_zones", "interpret")
+)
+def fifo_pack_pallas(
+    cluster: ClusterTensors,
+    apps: AppBatch,
+    *,
+    fill: str = "tightly-pack",
+    emax: int,
+    num_zones: int,
+    interpret: bool = False,
+) -> BatchedPacking:
+    """Queue-mode `batched_fifo_pack`, executed as one Pallas kernel.
+
+    Only the three plain fills are supported, and only queue mode (no
+    per-app masks, no segmented windows) — exactly the shape of the
+    north-star batched admission. Callers should route through
+    `fifo_pack_auto`, which falls back to the XLA scan everywhere else.
+    """
+    if fill not in PALLAS_FILLS:
+        raise ValueError(f"pallas path supports {PALLAS_FILLS}, got {fill!r}")
+    if apps.commit is not None or apps.driver_cand is not None or apps.domain is not None:
+        raise ValueError("pallas path is queue-mode only (no masks/segments)")
+
+    n = cluster.available.shape[0]
+    b = apps.driver_req.shape[0]
+    if b == 0:
+        # An empty queue admits nothing and leaves availability unchanged
+        # (the grid would be (0,) and the kernel would never run).
+        return BatchedPacking(
+            driver_node=jnp.zeros((0,), jnp.int32),
+            executor_nodes=jnp.zeros((0, emax), jnp.int32),
+            admitted=jnp.zeros((0,), jnp.bool_),
+            packed=jnp.zeros((0,), jnp.bool_),
+            available_after=jnp.asarray(cluster.available, jnp.int32),
+        )
+    n_pad = _round_up(max(n, _LANES), _LANES)
+
+    (driver_elig, exec_elig, d_order, d_rank, e_order, _zrank) = (
+        queue_mode_orders(cluster, num_zones)
+    )
+
+    # Re-arrange the node axis into executor-priority position order so the
+    # kernel's "first open position" argmin IS the executor priority walk.
+    pad_cols = n_pad - n
+
+    def pos_row(x, fill_value):
+        row = x[e_order]
+        return jnp.pad(row[None, :], ((0, 0), (0, pad_cols)), constant_values=fill_value)
+
+    avail_pos = jnp.pad(
+        cluster.available[e_order].T, ((0, 0), (0, pad_cols))
+    ).astype(jnp.int32)
+    elig_e_pos = pos_row(exec_elig.astype(jnp.int32), 0)
+    elig_d_pos = pos_row(driver_elig.astype(jnp.int32), 0)
+    drank_pos = pos_row(d_rank, INT32_INF)
+    nodeid_pos = pos_row(jnp.arange(n, dtype=jnp.int32), 0)
+
+    kernel = _make_kernel(fill, emax, n_pad, b)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(b,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((3, n_pad), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+    )
+    meta, execs, avail_after_pos = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 4), jnp.int32),
+            jax.ShapeDtypeStruct((b, emax), jnp.int32),
+            jax.ShapeDtypeStruct((3, n_pad), jnp.int32),
+        ],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(
+        apps.driver_req.astype(jnp.int32),
+        apps.exec_req.astype(jnp.int32),
+        apps.exec_count.astype(jnp.int32),
+        apps.app_valid.astype(jnp.int32),
+        apps.skippable.astype(jnp.int32),
+        avail_pos,
+        elig_e_pos,
+        elig_d_pos,
+        drank_pos,
+        nodeid_pos,
+    )
+
+    # Un-permute the availability back into node order.
+    avail_after = (
+        jnp.zeros_like(cluster.available)
+        .at[e_order]
+        .set(avail_after_pos[:, :n].T)
+    )
+    return BatchedPacking(
+        driver_node=meta[:, 0],
+        executor_nodes=execs,
+        admitted=meta[:, 1] != 0,
+        packed=meta[:, 2] != 0,
+        available_after=avail_after,
+    )
+
+
+_PALLAS_AVAILABLE: bool | None = None
+
+
+def pallas_available() -> bool:
+    """True when the default backend can compile Mosaic kernels (probed
+    once with a trivial kernel and cached)."""
+    global _PALLAS_AVAILABLE
+    if _PALLAS_AVAILABLE is None:
+        if not _PALLAS_IMPORTED:
+            _PALLAS_AVAILABLE = False
+            return False
+        try:
+
+            def _probe(x_ref, o_ref):
+                o_ref[:] = x_ref[:] + 1
+
+            out = pl.pallas_call(
+                _probe,
+                out_shape=jax.ShapeDtypeStruct((8, _LANES), jnp.int32),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            )(jnp.zeros((8, _LANES), jnp.int32))
+            _PALLAS_AVAILABLE = bool(np.asarray(out)[0, 0] == 1)
+        except Exception:
+            _PALLAS_AVAILABLE = False
+    return _PALLAS_AVAILABLE
+
+
+def fifo_pack_auto(
+    cluster: ClusterTensors,
+    apps: AppBatch,
+    *,
+    fill: str = "tightly-pack",
+    emax: int,
+    num_zones: int,
+    prefer_pallas: bool = True,
+) -> BatchedPacking:
+    """Route a queue solve to the Pallas kernel when the backend supports
+    Mosaic and the request is queue-mode with a plain fill; otherwise the
+    XLA scan. Decisions are identical either way (golden-parity tested)."""
+    from spark_scheduler_tpu.ops.batched import batched_fifo_pack
+
+    if (
+        prefer_pallas
+        and fill in PALLAS_FILLS
+        and apps.commit is None
+        and apps.driver_cand is None
+        and apps.domain is None
+        and pallas_available()
+    ):
+        return fifo_pack_pallas(
+            cluster, apps, fill=fill, emax=emax, num_zones=num_zones
+        )
+    return batched_fifo_pack(
+        cluster, apps, fill=fill, emax=emax, num_zones=num_zones
+    )

@@ -1,0 +1,145 @@
+"""Golden parity: the Pallas queue kernel == the XLA scan, decision for
+decision.
+
+`ops/pallas_fifo.fifo_pack_pallas` re-derives the executor fills as
+iterative masked-argmin placement and runs the whole FIFO scan inside one
+Mosaic kernel; these tests pin it bit-for-bit to `batched_fifo_pack` (which
+is itself oracle-parity-tested in test_batched.py) across randomized
+clusters and queues, in interpreter mode on the CPU suite. The same
+comparison runs compiled on real silicon in hack/tpu_parity_smoke.py.
+"""
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.models.cluster import ClusterTensors
+from spark_scheduler_tpu.ops.batched import batched_fifo_pack, make_app_batch
+from spark_scheduler_tpu.ops.pallas_fifo import (
+    PALLAS_FILLS,
+    fifo_pack_auto,
+    fifo_pack_pallas,
+)
+
+from tests.test_packing_golden import random_cluster
+
+EMAX = 8
+NUM_ZONES = 4
+
+
+def random_apps(rng, b, pad_to=None):
+    driver = rng.integers(1, 6, size=(b, 3)).astype(np.int32)
+    driver[:, 2] = rng.integers(0, 2, size=b)
+    execs = rng.integers(1, 8, size=(b, 3)).astype(np.int32)
+    execs[:, 2] = rng.integers(0, 2, size=b)
+    counts = rng.integers(0, EMAX + 3, size=b).astype(np.int32)  # incl. too-big
+    skip = rng.random(b) < 0.3
+    return make_app_batch(driver, execs, counts, pad_to=pad_to, skippable=skip)
+
+
+def assert_same(got, want):
+    for field in ("driver_node", "executor_nodes", "admitted", "packed",
+                  "available_after"):
+        g = np.asarray(getattr(got, field))
+        w = np.asarray(getattr(want, field))
+        np.testing.assert_array_equal(g, w, err_msg=field)
+
+
+@pytest.mark.parametrize("fill", sorted(PALLAS_FILLS))
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_pallas_matches_xla_scan(fill, seed):
+    rng = np.random.default_rng(seed)
+    c = random_cluster(rng, 37, num_zones=NUM_ZONES)
+    apps = random_apps(rng, 9, pad_to=12)
+    want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
+    got = fifo_pack_pallas(
+        c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES, interpret=True
+    )
+    assert_same(got, want)
+
+
+@pytest.mark.parametrize("fill", sorted(PALLAS_FILLS))
+def test_pallas_strict_fifo_blocking(fill):
+    """A huge non-skippable gang blocks everything behind it in both paths."""
+    rng = np.random.default_rng(7)
+    c = random_cluster(rng, 24, num_zones=NUM_ZONES)
+    driver = np.ones((4, 3), np.int32)
+    execs = np.ones((4, 3), np.int32)
+    execs[1] = 1000  # unpackable
+    counts = np.array([2, 8, 2, 2], np.int32)
+    apps = make_app_batch(driver, execs, counts,
+                          skippable=np.zeros(4, bool))
+    want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
+    got = fifo_pack_pallas(
+        c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES, interpret=True
+    )
+    assert_same(got, want)
+    assert not np.asarray(want.admitted)[2:].any()
+
+
+def test_pallas_negative_availability_and_zero_count():
+    """Overcommitted nodes (negative availability) and zero-executor gangs."""
+    rng = np.random.default_rng(11)
+    c = random_cluster(rng, 20, num_zones=NUM_ZONES)
+    avail = np.asarray(c.available).copy()
+    avail[3] = -5
+    avail[7, 0] = -1
+    import dataclasses
+
+    c = dataclasses.replace(c, available=avail)
+    driver = np.ones((3, 3), np.int32)
+    execs = np.ones((3, 3), np.int32)
+    counts = np.array([0, 3, 0], np.int32)
+    apps = make_app_batch(driver, execs, counts)
+    for fill in sorted(PALLAS_FILLS):
+        want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX,
+                                 num_zones=NUM_ZONES)
+        got = fifo_pack_pallas(
+            c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES, interpret=True
+        )
+        assert_same(got, want)
+
+
+def test_pallas_rejects_masked_and_single_az():
+    rng = np.random.default_rng(3)
+    c = random_cluster(rng, 16, num_zones=NUM_ZONES)
+    apps = random_apps(rng, 4)
+    with pytest.raises(ValueError):
+        fifo_pack_pallas(c, apps, fill="single-az-tightly-pack",
+                         emax=EMAX, num_zones=NUM_ZONES, interpret=True)
+    masked = apps._replace(domain=np.ones((4, 16), bool))
+    with pytest.raises(ValueError):
+        fifo_pack_pallas(c, masked, fill="tightly-pack",
+                         emax=EMAX, num_zones=NUM_ZONES, interpret=True)
+
+
+def test_pallas_empty_batch():
+    """B=0 short-circuits (the grid would be empty): no admissions,
+    availability unchanged — same as the XLA scan."""
+    rng = np.random.default_rng(13)
+    c = random_cluster(rng, 16, num_zones=NUM_ZONES)
+    apps = make_app_batch(
+        np.zeros((0, 3), np.int32), np.zeros((0, 3), np.int32),
+        np.zeros(0, np.int32),
+    )
+    got = fifo_pack_pallas(
+        c, apps, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES,
+        interpret=True,
+    )
+    assert got.driver_node.shape == (0,)
+    assert got.executor_nodes.shape == (0, EMAX)
+    np.testing.assert_array_equal(
+        np.asarray(got.available_after), np.asarray(c.available)
+    )
+
+
+def test_auto_routing_falls_back_on_cpu():
+    """On the CPU suite Mosaic is unavailable: fifo_pack_auto must still
+    return correct decisions via the XLA scan."""
+    rng = np.random.default_rng(5)
+    c = random_cluster(rng, 16, num_zones=NUM_ZONES)
+    apps = random_apps(rng, 5)
+    want = batched_fifo_pack(c, apps, fill="tightly-pack", emax=EMAX,
+                             num_zones=NUM_ZONES)
+    got = fifo_pack_auto(c, apps, fill="tightly-pack", emax=EMAX,
+                         num_zones=NUM_ZONES)
+    assert_same(got, want)
