@@ -35,6 +35,7 @@ from spark_scheduler_tpu.core.sparkpods import (
     SPARK_ROLE_LABEL,
     SparkPodError,
     SparkPodLister,
+    find_instance_group,
     pod_matches_node,
     spark_resources,
 )
@@ -106,6 +107,30 @@ class ExtenderConfig:
     batched_admission: bool = True
 
 
+class WindowTicket:
+    """A serving window between its dispatch and complete phases
+    (predicate_window_dispatch / predicate_window_complete)."""
+
+    __slots__ = (
+        "args_list", "results", "roles", "timer_start", "window", "handle",
+        "all_nodes", "by_name", "domains", "inflight_keys", "sync", "done",
+    )
+
+    def __init__(self, args_list):
+        self.args_list = args_list
+        self.results = None
+        self.roles = None
+        self.timer_start = 0.0
+        self.window = []  # (arg index, pod, app_resources, args)
+        self.handle = None  # solver WindowHandle when a window was dispatched
+        self.all_nodes = []
+        self.by_name = {}
+        self.domains = {}
+        self.inflight_keys = []
+        self.sync = False  # single request: serve via the solo predicate()
+        self.done = False  # results already final (e.g. reconcile failure)
+
+
 class SparkSchedulerExtender:
     def __init__(
         self,
@@ -137,6 +162,13 @@ class SparkSchedulerExtender:
         self._waste = waste
         self._clock = clock
         self._last_request: float = 0.0
+        # Apps whose gang admission is DISPATCHED but not yet applied (a
+        # pipelined window in flight). A later window must not re-admit
+        # them; their requests fall through to the solo loop of their own
+        # window's complete phase, which runs after the prior window
+        # applied — the idempotent-retry branch then returns the reserved
+        # node (resource.go:273-286).
+        self._inflight_apps: set[tuple[str, str]] = set()
 
     # ------------------------------------------------------------------ API
 
@@ -181,34 +213,67 @@ class SparkSchedulerExtender:
         one valid linearization (and the friendliest: an executor whose
         driver is in the same window finds its reservation). Reconciliation
         and soft-reservation compaction run once per window — the window IS
-        the serialization point (SURVEY.md §7 "Mutable-state races")."""
-        if len(args_list) == 1:
-            return [self.predicate(args_list[0])]
-        from spark_scheduler_tpu.tracing import tracer
+        the serialization point (SURVEY.md §7 "Mutable-state races").
 
-        timer_start = self._clock()
+        Synchronous form of the two-phase API: the PIPELINED serving loop
+        (server/http.py PredicateBatcher) instead dispatches window k+1
+        (predicate_window_dispatch) before completing window k
+        (predicate_window_complete), overlapping the next window's host
+        build + device dispatch with the previous window's blocking
+        decision pull."""
+        return self.predicate_window_complete(
+            self.predicate_window_dispatch(args_list)
+        )
+
+    def predicate_window_dispatch(
+        self, args_list: Sequence[ExtenderArgs]
+    ) -> "WindowTicket":
+        """Phase 1: reconcile/compact, select the driver window, build the
+        segmented requests, and DISPATCH the device solve (no blocking
+        fetch). May raise solver.PipelineDrainRequired — the caller must
+        complete the pending window and retry."""
+        t = WindowTicket(args_list)
+        if len(args_list) == 1:
+            t.sync = True
+            return t
+        t.timer_start = self._clock()
         try:
             self._reconcile_if_needed()
         except Exception as exc:
-            return [
+            t.results = [
                 self._fail(a, FAILURE_INTERNAL, f"failed to reconcile: {exc}")
                 for a in args_list
             ]
+            t.done = True
+            return t
         self._rrm.compact_dynamic_allocation_applications()
-
-        results: list[Optional[ExtenderFilterResult]] = [None] * len(args_list)
-        roles = [a.pod.labels.get(SPARK_ROLE_LABEL, "") for a in args_list]
-        driver_ids = [i for i, r in enumerate(roles) if r == ROLE_DRIVER]
+        t.results = [None] * len(args_list)
+        t.roles = [a.pod.labels.get(SPARK_ROLE_LABEL, "") for a in args_list]
+        driver_ids = [i for i, r in enumerate(t.roles) if r == ROLE_DRIVER]
         if (
             len(driver_ids) > 1
             and self._config.batched_admission
             and self._solver.can_batch(self.binpacker.name)
         ):
-            self._serve_driver_window(args_list, driver_ids, results, timer_start)
+            self._dispatch_driver_window(t, driver_ids)
+        return t
 
-        # Everything not window-served (executors, non-spark pods, drivers
-        # when batching is off) runs the solo path in arrival order,
-        # observing the reservations the window just created.
+    def predicate_window_complete(
+        self, t: "WindowTicket"
+    ) -> list[ExtenderFilterResult]:
+        """Phase 2: fetch + apply the window decisions (reservations,
+        demands, events), then serve everything not window-served
+        (executors, non-spark pods, deferred in-flight duplicates, drivers
+        when batching is off) on the solo path in arrival order."""
+        from spark_scheduler_tpu.tracing import tracer
+
+        if t.sync:
+            return [self.predicate(t.args_list[0])]
+        if t.done:
+            return t.results
+        if t.handle is not None:
+            self._complete_driver_window(t)
+        args_list, results, roles = t.args_list, t.results, t.roles
         for i, args in enumerate(args_list):
             if results[i] is not None:
                 continue
@@ -221,7 +286,7 @@ class SparkSchedulerExtender:
                     roles[i], pod, args.node_names
                 )
                 sp.tag("outcome", outcome)
-            self._mark_outcome(pod, roles[i], outcome, timer_start)
+            self._mark_outcome(pod, roles[i], outcome, t.timer_start)
             if node is None:
                 results[i] = self._fail(args, outcome, message or outcome)
             else:
@@ -230,24 +295,40 @@ class SparkSchedulerExtender:
                 )
         return results
 
-    def _serve_driver_window(
-        self, args_list, driver_ids, results, timer_start
-    ) -> None:
+    def _dispatch_driver_window(self, t: WindowTicket, driver_ids) -> None:
         """Gang-admit every driver request of the window in ONE device solve
-        (solver.pack_window). Mirrors _select_driver_node's flow per request:
-        idempotent retry, FIFO earlier-driver rows, demand lifecycle,
-        reservation creation, metrics/events."""
-        window: list[tuple] = []  # (arg index, pod, app_resources, args)
-        seen_apps: set[tuple[str, str]] = set()
+        (solver.pack_window_dispatch; fetched in _complete_driver_window).
+        Mirrors _select_driver_node's flow per request: idempotent retry,
+        FIFO earlier-driver rows, demand lifecycle, reservation creation,
+        metrics/events."""
+        # Build the device tensors FIRST: build_tensors_pipelined is the
+        # only raise site (PipelineDrainRequired), and raising before any
+        # outcome is marked lets the serving loop retry the whole dispatch
+        # without double-counting metrics or waste attempts.
+        all_nodes = t.all_nodes = self._backend.list_nodes()
+        by_name = t.by_name = {n.name: n for n in all_nodes}
+        usage = self._rrm.reserved_usage()
+        overhead = self._overhead.get_overhead(all_nodes)
+        # Device-resident state threaded ACROSS windows: the previous
+        # window's committed base (still on device) plus additive external
+        # deltas — what makes dispatch-before-fetch pipelining exact
+        # (solver.build_tensors_pipelined).
+        tensors = self._solver.build_tensors_pipelined(all_nodes, usage, overhead)
+
+        args_list, results, timer_start = t.args_list, t.results, t.timer_start
+        window = t.window
+        seen_apps: set[tuple[str, str]] = set(self._inflight_apps)
         for i in driver_ids:
             args = args_list[i]
             pod = args.pod
             app_id = pod.labels.get(SPARK_APP_ID_LABEL, "")
             if (pod.namespace, app_id) in seen_apps:
                 # Duplicate submission of the same app in one window (client
-                # retry): leave it for the post-window solo loop, where the
-                # idempotent-retry branch returns the node the FIRST
-                # submission just reserved (resource.go:273-286).
+                # retry) OR an app whose admission is still in flight in a
+                # previous pipelined window: leave it for the post-window
+                # solo loop — it runs after every prior window applied, so
+                # the idempotent-retry branch returns the node the first
+                # submission reserved (resource.go:273-286).
                 continue
             rr = self._rrm.get_resource_reservation(app_id, pod.namespace)
             if rr is not None:
@@ -271,32 +352,66 @@ class SparkSchedulerExtender:
         if not window:
             return
 
-        all_nodes = self._backend.list_nodes()
-        by_name = {n.name: n for n in all_nodes}
-        domains: dict[int, list[str]] = {}
+        # Domain (node-affinity) matching, deduplicated by affinity
+        # signature: requests without selector/affinity — the overwhelmingly
+        # common case — share the all-nodes domain (None => pack_window uses
+        # every valid node), and identical selectors run the O(nodes)
+        # matcher walk once per window instead of once per request.
+        domains = t.domains
+        domain_by_sig: dict[tuple, list[str] | None] = {}
         for i, pod, res, args in window:
-            domains[i] = [n.name for n in all_nodes if pod_matches_node(pod, n)]
-        usage = self._rrm.reserved_usage()
-        overhead = self._overhead.get_overhead(all_nodes)
-        # Device-resident state: full node list, per-request affinity via
-        # each request's domain mask (VERDICT r2 #3).
-        tensors = self._solver.build_tensors_cached(all_nodes, usage, overhead)
+            sig = (
+                tuple(sorted(pod.node_selector.items())),
+                tuple(sorted(
+                    (k, tuple(v)) for k, v in pod.node_affinity.items()
+                )),
+            )
+            if sig not in domain_by_sig:
+                if not pod.node_selector and not pod.node_affinity:
+                    domain_by_sig[sig] = None  # all valid nodes
+                else:
+                    domain_by_sig[sig] = [
+                        n.name for n in all_nodes if pod_matches_node(pod, n)
+                    ]
+            domains[i] = domain_by_sig[sig]
+        # FIFO predecessor rows: one backend scan + one annotation parse per
+        # pending driver for the WHOLE window (each request then filters the
+        # shared snapshot, sparkpods.go:51-77 semantics unchanged).
+        parsed_pending: list[tuple] = []
+        if self._config.fifo:
+            ig_label = self._pod_lister.instance_group_label
+            for ed in self._pod_lister.list_pending_drivers():
+                try:
+                    ed_res = spark_resources(ed)
+                except SparkPodError:
+                    continue  # unparseable driver skipped (resource.go:228-233)
+                parsed_pending.append(
+                    (
+                        ed,
+                        find_instance_group(ed, ig_label),
+                        ed_res,
+                        self._should_skip_driver_fifo(ed),
+                    )
+                )
 
         requests: list[WindowRequest] = []
         for i, pod, res, args in window:
             rows: list[tuple] = []
             if self._config.fifo:
-                for ed in self._pod_lister.list_earlier_drivers(pod):
-                    try:
-                        ed_res = spark_resources(ed)
-                    except SparkPodError:
-                        continue  # unparseable driver skipped (resource.go:228-233)
+                group = find_instance_group(
+                    pod, self._pod_lister.instance_group_label
+                )
+                for ed, ed_group, ed_res, ed_skip in parsed_pending:
+                    if not SparkPodLister.is_earlier_driver(
+                        ed, ed_group, pod, group
+                    ):
+                        continue
                     rows.append(
                         (
                             ed_res.driver_resources,
                             ed_res.executor_resources,
                             ed_res.min_executor_count,
-                            self._should_skip_driver_fifo(ed),
+                            ed_skip,
                         )
                     )
             rows.append(
@@ -315,8 +430,24 @@ class SparkSchedulerExtender:
                 )
             )
 
-        decisions = self._solver.pack_window(self.binpacker.name, tensors, requests)
+        t.handle = self._solver.pack_window_dispatch(
+            self.binpacker.name, tensors, requests
+        )
+        t.inflight_keys = [
+            (pod.namespace, pod.labels.get(SPARK_APP_ID_LABEL, ""))
+            for _, pod, _, _ in window
+        ]
+        self._inflight_apps.update(t.inflight_keys)
 
+    def _complete_driver_window(self, t: WindowTicket) -> None:
+        """Fetch the dispatched window's decisions and apply them:
+        reservations, demand lifecycle, events, metrics."""
+        try:
+            decisions = self._solver.pack_window_fetch(t.handle)
+        finally:
+            self._inflight_apps.difference_update(t.inflight_keys)
+        window, results, timer_start = t.window, t.results, t.timer_start
+        all_nodes, by_name, domains = t.all_nodes, t.by_name, t.domains
         for k, (i, pod, res, args) in enumerate(window):
             d = decisions[k]
             if not d.admitted:
@@ -340,7 +471,9 @@ class SparkSchedulerExtender:
                 self._metrics.report_cross_zone(
                     packing.driver_node,
                     packing.executor_nodes,
-                    [by_name[nm] for nm in domains[i]],
+                    all_nodes
+                    if domains[i] is None
+                    else [by_name[nm] for nm in domains[i]],
                 )
             self._demands.delete_demand_if_exists(pod)
             try:

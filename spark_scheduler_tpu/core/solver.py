@@ -79,6 +79,15 @@ def _scatter_rows(avail, idx, rows):
     return avail.at[idx].set(rows)
 
 
+@jax.jit
+def _add_rows(avail, idx, delta_rows):
+    """Jitted ADDITIVE row update for the pipelined device availability:
+    ships host-side deltas without clobbering gang subtractions the device
+    threaded from still-in-flight windows. Padding rows carry zero deltas,
+    so duplicate padded indices are harmless."""
+    return avail.at[idx].add(delta_rows)
+
+
 from functools import partial as _partial
 
 
@@ -87,11 +96,13 @@ def _window_blob(cluster, apps, *, fill, emax, num_zones):
     """batched_fifo_pack with every per-row output packed into ONE int32
     array [B, 3+Emax]: (driver, admitted, packed, exec slots...). On a
     tunneled device each fetched array is its own RPC round trip, so the
-    serving path pulls a single blob instead of four arrays."""
+    serving path pulls a single blob instead of four arrays. Also returns
+    the threaded committed-base availability so a PIPELINED caller can
+    dispatch the next window from it without fetching this one."""
     out = batched_fifo_pack(
         cluster, apps, fill=fill, emax=emax, num_zones=num_zones
     )
-    return jnp.concatenate(
+    blob = jnp.concatenate(
         [
             out.driver_node[:, None],
             out.admitted[:, None].astype(jnp.int32),
@@ -100,6 +111,7 @@ def _window_blob(cluster, apps, *, fill, emax, num_zones):
         ],
         axis=1,
     )
+    return blob, out.available_after
 
 
 @_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
@@ -151,6 +163,42 @@ class WindowDecision(NamedTuple):
     earlier_blocked: bool
 
 
+class PipelineDrainRequired(RuntimeError):
+    """Raised by build_tensors_pipelined when node topology/attributes
+    changed while a dispatched window is still un-fetched: the caller must
+    fetch (complete) the pending window first, then retry — the fresh full
+    upload would otherwise discard the in-flight window's threaded base."""
+
+
+class WindowHandle:
+    """A dispatched-but-not-yet-fetched window solve
+    (PlacementSolver.pack_window_dispatch -> pack_window_fetch)."""
+
+    __slots__ = (
+        "strategy", "blob", "blob_future", "requests", "flat_rows",
+        "host_avail", "host_schedulable", "priors", "placements", "n",
+    )
+
+    def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
+                 host_schedulable, priors, n):
+        self.strategy = strategy
+        self.blob = blob  # device [B, 3+emax] int32 — not yet transferred
+        # Device->host transfer started EAGERLY on a side thread at dispatch
+        # (pipelined path): the ~RTT-bound pull elapses concurrently with
+        # the dispatcher's host work instead of serializing after it.
+        self.blob_future = None
+        self.requests = requests
+        self.flat_rows = flat_rows
+        # Host availability view at dispatch (int64 [N,3]); the device base
+        # additionally lacks the placements of `priors` (windows dispatched
+        # earlier but un-fetched at this dispatch).
+        self.host_avail = host_avail
+        self.host_schedulable = host_schedulable
+        self.priors = priors  # tuple[WindowHandle] — fetched before this one
+        self.placements = None  # int64 [N,3], filled at fetch
+        self.n = n
+
+
 class PlacementSolver:
     def __init__(
         self,
@@ -174,6 +222,14 @@ class PlacementSolver:
         # tensors + their numpy source. build_tensors_cached diffs against
         # the mirror and ships only changed availability rows.
         self._dev: dict | None = None
+        # Pipelined serving state (build_tensors_pipelined /
+        # pack_window_dispatch / pack_window_fetch): the device availability
+        # threaded ACROSS windows, an int64 mirror of it in host terms, and
+        # the dispatched-but-unfetched handles. Single-threaded by contract
+        # (the predicate batcher is the serialization point); the fetch pool
+        # only runs stateless jax.device_get calls.
+        self._pipe: dict | None = None
+        self._fetch_pool = None
         self.device_state_stats = {
             "full_uploads": 0,
             "delta_uploads": 0,
@@ -275,6 +331,80 @@ class PlacementSolver:
             stats["full_uploads"] += 1
         tensors.host = host
         self._dev = {"host": host, "tensors": tensors}
+        return tensors
+
+    def build_tensors_pipelined(
+        self,
+        nodes: Sequence[Node],
+        usage,
+        overhead,
+    ) -> ClusterTensors:
+        """Device-resident availability threaded ACROSS serving windows.
+
+        Unlike build_tensors_cached (which re-uploads the host availability
+        rows verbatim), this keeps the device availability equal to
+        `last window's committed base` + `external deltas`: the kernel's
+        `available_after` from the previous dispatch is extended with the
+        ADDITIVE difference between the current host view and an int64
+        mirror of what the device already embodies. Gang placements of a
+        window are debited from the mirror when the window is fetched
+        (pack_window_fetch), so the host's own reservation bookkeeping for
+        those gangs does not get shipped a second time — and a gang whose
+        reservation the host then failed to create is automatically
+        restored by the next delta. This is what makes it safe to DISPATCH
+        window k+1 before FETCHING window k (the pipelined serving loop):
+        k's admissions ride the device-side thread, not the host view.
+
+        Raises PipelineDrainRequired when a non-availability field changed
+        while a window is still in flight — fetch it first, then retry.
+        Single-threaded by contract (the predicate batcher thread)."""
+        host = self.build_tensors(nodes, usage, overhead)
+        stats = self.device_state_stats
+        p = self._pipe
+        if (
+            p is not None
+            and p["host"].available.shape == host.available.shape
+            and all(
+                np.array_equal(getattr(p["host"], f), getattr(host, f))
+                for f in _STATIC_FIELDS
+            )
+        ):
+            cur = host.available.astype(np.int64)
+            delta = cur - p["mirror"]
+            dirty = np.flatnonzero(delta.any(axis=1))
+            avail = p["avail"]
+            k = len(dirty)
+            if k:
+                # Pad with a repeated index but ZERO delta rows: .add is
+                # cumulative, so padding must contribute nothing.
+                kb = _bucket(k, 16)
+                idx = np.full(kb, dirty[0], dtype=np.int32)
+                idx[:k] = dirty
+                rows = np.zeros((kb, host.available.shape[1]), np.int32)
+                rows[:k] = delta[dirty]
+                avail = _add_rows(avail, jnp.asarray(idx), jnp.asarray(rows))
+                stats["delta_uploads"] += 1
+                stats["delta_rows"] += k
+            else:
+                stats["reuse_hits"] += 1
+            tensors = dataclasses.replace(p["tensors"], available=avail)
+            tensors.host = host
+            p.update(host=host, tensors=tensors, avail=avail, mirror=cur)
+            return tensors
+        if p is not None and p["unfetched"]:
+            raise PipelineDrainRequired(
+                "cluster topology changed with a window in flight"
+            )
+        tensors = jax.device_put(host)
+        tensors.host = host
+        stats["full_uploads"] += 1
+        self._pipe = {
+            "host": host,
+            "tensors": tensors,
+            "avail": tensors.available,
+            "mirror": host.available.astype(np.int64),
+            "unfetched": [],
+        }
         return tensors
 
     def _label_rank(self, node: Node, prio) -> int:
@@ -455,11 +585,39 @@ class PlacementSolver:
         limitation (cmd/endpoints.go:28-42, SURVEY.md §2d row 1): the
         device cost is one scan over sum(rows) steps instead of one full
         RPC + solve round-trip per request.
+
+        Synchronous form: dispatch + fetch back to back. The PIPELINED
+        serving path splits the two (pack_window_dispatch /
+        pack_window_fetch) so the next window's host build and device
+        dispatch overlap the previous window's blocking decision pull.
         """
+        return self.pack_window_fetch(
+            self.pack_window_dispatch(strategy, tensors, requests)
+        )
+
+    def pack_window_dispatch(
+        self,
+        strategy: str,
+        tensors,
+        requests: Sequence[WindowRequest],
+    ) -> "WindowHandle":
+        """Build the segmented batch and DISPATCH the device solve without
+        blocking on the result. Returns a handle for pack_window_fetch.
+
+        When `tensors` came from build_tensors_pipelined, the threaded
+        committed-base availability (still on device, never fetched) is
+        recorded as the base for the NEXT pipelined build, and the handle
+        notes which earlier windows were still un-fetched — their placements
+        are subtracted from this window's host-side base snapshot at fetch
+        time, so the host reconstruction sees exactly the availability the
+        device saw."""
         if strategy not in BATCHABLE_STRATEGIES:
             raise ValueError(f"strategy {strategy!r} is not batchable")
         if not requests:
-            return []
+            return WindowHandle(
+                strategy=strategy, blob=None, requests=(), flat_rows=[],
+                host_avail=None, host_schedulable=None, priors=(), n=0,
+            )
         n = tensors.available.shape[0]
         host = _host_view(tensors)
         valid_np = np.asarray(host.valid)
@@ -504,26 +662,88 @@ class PlacementSolver:
         from spark_scheduler_tpu.tracing import tracer
 
         with tracer().span(
-            "solve", strategy=strategy, nodes=n, window_requests=len(requests),
-            window_rows=b, batched=True,
+            "solve-dispatch", strategy=strategy, nodes=n,
+            window_requests=len(requests), window_rows=b, batched=True,
         ):
-            blob = jax.device_get(
-                _window_blob(
-                    tensors, apps, fill=strategy, emax=emax,
-                    num_zones=self._num_zones_bucket(),
-                )
+            blob, avail_after = _window_blob(
+                tensors, apps, fill=strategy, emax=emax,
+                num_zones=self._num_zones_bucket(),
             )
-            drivers = blob[:, 0]
-            admitted = blob[:, 1].astype(bool)
-            packed = blob[:, 2].astype(bool)
-            execs = blob[:, 3:]
+
+        priors: tuple = ()
+        p = self._pipe
+        pipelined = p is not None and tensors is p["tensors"]
+        if pipelined:
+            priors = tuple(p["unfetched"])
+            p["avail"] = avail_after  # the next pipelined build extends this
+        handle = WindowHandle(
+            strategy=strategy,
+            blob=blob,
+            requests=tuple(requests),
+            flat_rows=flat_rows,
+            host_avail=np.array(np.asarray(host.available), dtype=np.int64),
+            host_schedulable=np.asarray(host.schedulable),
+            priors=priors,
+            n=n,
+        )
+        if pipelined:
+            p["unfetched"].append(handle)
+            # Start the device->host pull NOW on the fetch thread: over a
+            # tunneled device the transfer RTT dominates, and starting it at
+            # dispatch lets it elapse under the next window's host build.
+            if self._fetch_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._fetch_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="window-blob-fetch"
+                )
+            handle.blob_future = self._fetch_pool.submit(jax.device_get, blob)
+        return handle
+
+    def pack_window_fetch(self, handle: "WindowHandle") -> list[WindowDecision]:
+        """Block on a dispatched window's decisions and reconstruct the
+        per-request outcomes (the second half of pack_window)."""
+        if not handle.requests:
+            return []
+        from spark_scheduler_tpu.tracing import tracer
+
+        requests, flat_rows, n = handle.requests, handle.flat_rows, handle.n
+        with tracer().span(
+            "solve", strategy=handle.strategy, nodes=n,
+            window_requests=len(requests), batched=True,
+        ):
+            try:
+                if handle.blob_future is not None:
+                    blob = handle.blob_future.result()
+                else:
+                    blob = jax.device_get(handle.blob)
+            except Exception:
+                # The device base embodies this window's (now unknowable)
+                # placements while no reservation was created for them.
+                # Drop the whole pipeline: the next build does a full upload
+                # from the host view — the durable truth — restoring the
+                # lost gangs' capacity. Later in-flight handles still fetch
+                # fine (their blobs are independent); they just skip the
+                # mirror debit of a dead pipeline.
+                self._pipe = None
+                raise
+        drivers = blob[:, 0]
+        admitted = blob[:, 1].astype(bool)
+        packed = blob[:, 2].astype(bool)
+        execs = blob[:, 3:]
 
         # Host-side reconstruction for per-request packing efficiency: the
-        # availability each admitted request's final pack saw = start
-        # - committed placements of earlier segments
-        # - in-segment admitted hypothetical placements.
+        # availability each admitted request's final pack saw = the
+        # host view at dispatch, minus the committed placements of windows
+        # that were still in flight then (the device had them threaded),
+        # minus committed placements of earlier segments, minus in-segment
+        # admitted hypothetical placements.
         decisions: list[WindowDecision] = []
-        base = np.array(np.asarray(host.available), dtype=np.int64)
+        base = handle.host_avail.copy()
+        for prior in handle.priors:
+            if prior.placements is not None:
+                base -= prior.placements
+        placements = np.zeros_like(base)
         row = 0
         for r, req in enumerate(requests):
             seg_rows = list(range(row, row + len(req.rows)))
@@ -544,7 +764,7 @@ class PlacementSolver:
             eff = None
             if req_admitted:
                 eff = avg_packing_efficiency_np(
-                    np.asarray(host.schedulable),
+                    handle.host_schedulable,
                     seg_avail,
                     int(drivers[real]),
                     execs[real],
@@ -555,9 +775,11 @@ class PlacementSolver:
                 # segments after it (mirrors the device-side base thread).
                 if drivers[real] >= 0:
                     base[drivers[real]] -= flat_rows[real][0].as_array()
+                    placements[drivers[real]] += flat_rows[real][0].as_array()
                 for e in execs[real]:
                     if e >= 0:
                         base[e] -= flat_rows[real][1].as_array()
+                        placements[e] += flat_rows[real][1].as_array()
             exec_idx = [int(x) for x in execs[real] if int(x) >= 0]
             decisions.append(
                 WindowDecision(
@@ -580,6 +802,17 @@ class PlacementSolver:
                     earlier_blocked=earlier_blocked,
                 )
             )
+        handle.placements = placements
+        # Pipeline accounting: the device base now permanently embodies this
+        # window's committed gangs; debit them from the mirror so the next
+        # build's host-vs-mirror delta ships only EXTERNAL changes. When the
+        # host later fails to create one of these reservations, its usage
+        # never reaches the host view and the next delta restores the gang's
+        # capacity on device automatically (self-correcting drift).
+        p = self._pipe
+        if p is not None and handle in p["unfetched"]:
+            p["unfetched"].remove(handle)
+            p["mirror"] -= placements
         return decisions
 
     def subtract_usage(self, tensors, usage: dict[str, Resources]):

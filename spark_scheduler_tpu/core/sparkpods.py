@@ -164,22 +164,51 @@ class SparkPodLister:
         self._backend = backend
         self.instance_group_label = instance_group_label
 
+    def list_pending_drivers(self) -> list[Pod]:
+        """All unscheduled, undeleted driver pods, oldest first — ONE backend
+        scan shared by every request of a serving window (the per-request
+        filter in `earlier_of` is then O(pending))."""
+        out = [
+            p
+            for p in self._backend.list_pods(labels={SPARK_ROLE_LABEL: ROLE_DRIVER})
+            if not p.node_name and p.deletion_timestamp is None
+        ]
+        out.sort(key=lambda p: p.creation_timestamp)
+        return out
+
+    @staticmethod
+    def is_earlier_driver(p: Pod, p_group: Optional[str], driver: Pod,
+                          driver_group: Optional[str]) -> bool:
+        """The FIFO predecessor predicate (same scheduler + instance group,
+        strictly earlier creation, sparkpods.go:51-77) — THE single
+        definition, shared by the solo path and the window assembly so the
+        two cannot drift."""
+        return (
+            p.scheduler_name == driver.scheduler_name
+            and p.creation_timestamp < driver.creation_timestamp
+            and p_group == driver_group
+        )
+
+    @staticmethod
+    def earlier_of(pending: list[Pod], driver: Pod, group: Optional[str],
+                   instance_group_label: str) -> list[Pod]:
+        """Filter a `list_pending_drivers` snapshot down to `driver`'s FIFO
+        predecessors. Snapshot is already oldest-first."""
+        return [
+            p
+            for p in pending
+            if SparkPodLister.is_earlier_driver(
+                p, find_instance_group(p, instance_group_label), driver, group
+            )
+        ]
+
     def list_earlier_drivers(self, driver: Pod) -> list[Pod]:
         """Unscheduled drivers of the same scheduler + instance group created
         strictly earlier, oldest first (sparkpods.go:51-77)."""
         group = find_instance_group(driver, self.instance_group_label)
-        out = []
-        for p in self._backend.list_pods(labels={SPARK_ROLE_LABEL: ROLE_DRIVER}):
-            if (
-                not p.node_name
-                and p.scheduler_name == driver.scheduler_name
-                and find_instance_group(p, self.instance_group_label) == group
-                and p.creation_timestamp < driver.creation_timestamp
-                and p.deletion_timestamp is None
-            ):
-                out.append(p)
-        out.sort(key=lambda p: p.creation_timestamp)
-        return out
+        return self.earlier_of(
+            self.list_pending_drivers(), driver, group, self.instance_group_label
+        )
 
     def get_driver_pod(self, app_id: str, namespace: str) -> Optional[Pod]:
         pods = self._backend.list_pods(

@@ -82,6 +82,9 @@ class PredicateBatcher:
         self.windows_served = 0
         self.requests_served = 0
         self.max_window_seen = 0
+        # Windows dispatched while another window was still in flight (the
+        # dispatch-before-fetch overlap actually engaging).
+        self.pipelined_windows = 0
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="predicate-batcher"
         )
@@ -119,55 +122,99 @@ class PredicateBatcher:
         self._thread.join(timeout=5)
 
     def _run(self) -> None:
+        """PIPELINED serving loop: dispatch window k+1 (host build + device
+        dispatch, no blocking) BEFORE completing window k (the blocking
+        decision pull + reservation apply). The device round trip of one
+        window overlaps the host work of the next, so steady-state cycle
+        time is ~max(RTT, host work) instead of their sum. Decisions are
+        unchanged: the solver threads the committed base availability
+        device-side across in-flight windows (build_tensors_pipelined), and
+        an app whose admission is still in flight is deferred to its own
+        window's post-apply solo loop (extender in-flight set)."""
         import time as _time
 
+        from spark_scheduler_tpu.core.solver import PipelineDrainRequired
+
+        pending = None  # (ticket, batch) — dispatched, awaiting complete
         while True:
             with self._cv:
-                while not self._queue and not self._stopped:
+                while not self._queue and not self._stopped and pending is None:
                     self._cv.wait()
                 busy = (
                     self._last_window > 1
                     and _time.monotonic() < self._busy_until
                 )
-                if not self._stopped and self._hold_s > 0 and busy:
+                if (
+                    not self._stopped
+                    and self._queue
+                    and pending is None
+                    and self._hold_s > 0
+                    and busy
+                ):
+                    # Accumulation hold, only when nothing is in flight — a
+                    # pending window's fetch IS the accumulation period
+                    # otherwise. Stop holding once the queue reaches the
+                    # previous window size (the natural concurrency level).
+                    target = min(self._last_window, self._max_window)
                     deadline = _time.monotonic() + self._hold_s
                     while (
-                        len(self._queue) < self._max_window and not self._stopped
+                        len(self._queue) < target and not self._stopped
                     ):
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
                             break
                         self._cv.wait(remaining)
                 if self._stopped:
+                    err = RuntimeError("scheduler is shutting down")
+                    if pending is not None:
+                        for entry in pending[1]:
+                            entry[3] = err
+                            entry[1].set()
                     for entry in self._queue:
-                        entry[3] = RuntimeError("scheduler is shutting down")
+                        entry[3] = err
                         entry[1].set()
                     self._queue.clear()
                     return
                 batch = self._queue[: self._max_window]
                 del self._queue[: self._max_window]
-                self._last_window = len(batch)
-                if len(batch) > 1:
-                    self._busy_until = _time.monotonic() + self._busy_ttl_s
-            try:
-                results = self._serve_window(batch)
-            except Exception as exc:  # whole-window failure
-                for entry in batch:
-                    entry[3] = exc
-                    entry[1].set()
-                continue
-            self.windows_served += 1
-            self.requests_served += len(batch)
-            self.max_window_seen = max(self.max_window_seen, len(batch))
-            if self._registry is not None:
-                self._registry.histogram(
-                    "foundry.spark.scheduler.predicate.window"
-                ).update(len(batch))
-            for entry, result in zip(batch, results):
-                entry[2] = result
-                entry[1].set()
+                if batch:
+                    self._last_window = len(batch)
+                    if len(batch) > 1:
+                        self._busy_until = (
+                            _time.monotonic() + self._busy_ttl_s
+                        )
+            new = None
+            if batch:
+                # A pending ticket with NO dispatched device solve (a lone
+                # request served via the solo path, or a batch that didn't
+                # window) must be completed BEFORE dispatching the next
+                # window: its solo serve creates reservations the new
+                # window's solve has to see, and there is no in-flight
+                # fetch to overlap with anyway.
+                if pending is not None and pending[0].handle is None:
+                    self._complete_window(pending)
+                    pending = None
+                try:
+                    new = (self._dispatch_window(batch), batch)
+                except PipelineDrainRequired:
+                    # Topology changed under an in-flight window: apply it
+                    # first, then the fresh full upload is safe.
+                    if pending is not None:
+                        self._complete_window(pending)
+                        pending = None
+                    try:
+                        new = (self._dispatch_window(batch), batch)
+                    except Exception as exc:
+                        self._fail_batch(batch, exc)
+                except Exception as exc:
+                    self._fail_batch(batch, exc)
+            if pending is not None:
+                if new is not None and new[0].handle is not None:
+                    self.pipelined_windows += 1
+                self._complete_window(pending)
+            pending = new
 
-    def _serve_window(self, batch):
+    def _dispatch_window(self, batch):
         from spark_scheduler_tpu.tracing import tracer
 
         args_list = [e[0] for e in batch]
@@ -175,7 +222,7 @@ class PredicateBatcher:
             # Lone request: its work continues the caller's b3 trace
             # exactly as the pre-batcher serving path did.
             with tracer().attach(batch[0][4]):
-                return self._extender.predicate_batch(args_list)
+                return self._extender.predicate_window_dispatch(args_list)
         # Coalesced window: one solve serves many traces — emit a window
         # span linking every request trace (zipkin span-link style).
         with tracer().span(
@@ -183,13 +230,46 @@ class PredicateBatcher:
             window=len(batch),
             request_traces=[e[4].trace_id for e in batch if e[4] is not None],
         ):
-            return self._extender.predicate_batch(args_list)
+            return self._extender.predicate_window_dispatch(args_list)
+
+    def _complete_window(self, pending) -> None:
+        from spark_scheduler_tpu.tracing import tracer
+
+        ticket, batch = pending
+        try:
+            if len(batch) == 1 and batch[0][4] is not None:
+                with tracer().attach(batch[0][4]):
+                    results = self._extender.predicate_window_complete(ticket)
+            else:
+                with tracer().span(
+                    "predicate-window-complete", window=len(batch)
+                ):
+                    results = self._extender.predicate_window_complete(ticket)
+        except Exception as exc:  # whole-window failure
+            self._fail_batch(batch, exc)
+            return
+        self.windows_served += 1
+        self.requests_served += len(batch)
+        self.max_window_seen = max(self.max_window_seen, len(batch))
+        if self._registry is not None:
+            self._registry.histogram(
+                "foundry.spark.scheduler.predicate.window"
+            ).update(len(batch))
+        for entry, result in zip(batch, results):
+            entry[2] = result
+            entry[1].set()
+
+    def _fail_batch(self, batch, exc) -> None:
+        for entry in batch:
+            entry[3] = exc
+            entry[1].set()
 
     def stats(self) -> dict:
         return {
             "windows_served": self.windows_served,
             "requests_served": self.requests_served,
             "max_window_seen": self.max_window_seen,
+            "pipelined_windows": self.pipelined_windows,
             "mean_window": (
                 round(self.requests_served / self.windows_served, 2)
                 if self.windows_served

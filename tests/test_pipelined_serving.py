@@ -1,0 +1,338 @@
+"""Pipelined windowed serving: dispatching window k+1 BEFORE completing
+window k must change nothing about the decisions.
+
+The pipeline's correctness rests on three mechanisms, each pinned here:
+  - the device-side committed-base thread + additive external deltas
+    (solver.build_tensors_pipelined): an overlapped dispatch sees exactly
+    the availability a serialized server would have shown it;
+  - the in-flight app set (extender): an app whose admission is still in
+    flight is deferred to its own window's post-apply solo loop, where the
+    idempotent-retry branch answers;
+  - mirror self-correction: a gang the kernel admitted but whose
+    reservation the host failed to create is restored to the device
+    automatically by the next delta.
+
+Nodes are the harness standard 8 CPU / 8 GiB / 1 GPU
+(extender_test_utils.go:225-257); static-allocation apps cost
+(1 + num_executors) CPU / GiB.
+"""
+
+import threading
+
+from spark_scheduler_tpu.core.extender import ExtenderArgs
+from spark_scheduler_tpu.core.solver import PipelineDrainRequired
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+NS = "namespace"
+
+
+def _mk_harness(n_nodes=12, fifo=True):
+    h = Harness(binpack_algo="tightly-pack", fifo=fifo)
+    h.add_nodes(*[new_node(f"n{i}", zone=f"zone{i % 2}") for i in range(n_nodes)])
+    return h, [f"n{i}" for i in range(n_nodes)]
+
+
+def _driver_args(h, app_id, execs, node_names):
+    driver = static_allocation_spark_pods(app_id, execs)[0]
+    h.add_pods(driver)
+    return driver, ExtenderArgs(pod=driver, node_names=list(node_names))
+
+
+def test_pipelined_windows_match_serialized_decisions():
+    """Dispatch w2 while w1 is un-fetched; the combined decisions must equal
+    a serialized server's (same stream, complete-before-dispatch)."""
+    streams = []
+    for mode in ("pipelined", "serial"):
+        h, node_names = _mk_harness()
+        ext = h.extender
+        w1 = [_driver_args(h, f"app-a{i}", 3, node_names) for i in range(3)]
+        w2 = [_driver_args(h, f"app-b{i}", 3, node_names) for i in range(3)]
+        t1 = ext.predicate_window_dispatch([a for _, a in w1])
+        if mode == "pipelined":
+            # Overlap: w2 dispatched before w1 is completed.
+            t2 = ext.predicate_window_dispatch([a for _, a in w2])
+            r1 = ext.predicate_window_complete(t1)
+            r2 = ext.predicate_window_complete(t2)
+        else:
+            r1 = ext.predicate_window_complete(t1)
+            t2 = ext.predicate_window_dispatch([a for _, a in w2])
+            r2 = ext.predicate_window_complete(t2)
+        placements = [res.node_names for res in r1 + r2]
+        outcomes = [res.outcome for res in r1 + r2]
+        streams.append((placements, outcomes))
+        for (pod, _), res in zip(w1 + w2, r1 + r2):
+            assert res.node_names, (mode, pod.name, res)
+    assert streams[0] == streams[1]
+
+
+def test_pipelined_capacity_is_threaded_not_double_booked():
+    """Two overlapped windows on a cluster that fits exactly one window's
+    gangs: the second window must see the first's (un-applied) admissions
+    via the device-side thread and reject."""
+    # 2 nodes x 8 CPU; one 7-executor app = 8 CPU = one full node.
+    h, node_names = _mk_harness(n_nodes=2, fifo=False)
+    ext = h.extender
+    w1 = [_driver_args(h, f"fit-{i}", 7, node_names) for i in range(2)]
+    w2 = [_driver_args(h, f"over-{i}", 7, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in w1])
+    t2 = ext.predicate_window_dispatch([a for _, a in w2])
+    r1 = ext.predicate_window_complete(t1)
+    r2 = ext.predicate_window_complete(t2)
+    assert all(res.node_names for res in r1), r1
+    assert not any(res.node_names for res in r2), (
+        "second window double-booked capacity the first window's in-flight "
+        f"admissions already hold: {r2}"
+    )
+
+
+def test_inflight_app_defers_to_idempotent_retry():
+    """The same app submitted in two overlapped windows: the second request
+    must not be re-admitted by the kernel — it resolves after the first
+    window applies, to the SAME node."""
+    h, node_names = _mk_harness()
+    ext = h.extender
+    driver, args = _driver_args(h, "dup-app", 3, node_names)
+    _, oargs1 = _driver_args(h, "other-1", 3, node_names)
+    _, oargs2 = _driver_args(h, "other-2", 3, node_names)
+    t1 = ext.predicate_window_dispatch([args, oargs1])
+    # window 2 carries a duplicate of dup-app while window 1 is in flight
+    dup_args = ExtenderArgs(pod=driver, node_names=list(node_names))
+    t2 = ext.predicate_window_dispatch([dup_args, oargs2])
+    assert (NS, "dup-app") in ext._inflight_apps
+    r1 = ext.predicate_window_complete(t1)
+    assert (NS, "dup-app") not in ext._inflight_apps
+    r2 = ext.predicate_window_complete(t2)
+    assert r1[0].node_names and r2[0].node_names
+    assert r1[0].node_names == r2[0].node_names, "idempotent retry diverged"
+    # only ONE reservation exists for the app
+    assert ext._rrm.get_resource_reservation("dup-app", NS) is not None
+    rrs = h.backend.list("resourcereservations")
+    assert sum(1 for rr in rrs if rr.name == "dup-app") == 1
+
+
+def test_reservation_failure_restores_device_capacity():
+    """A gang admitted by the kernel whose reservation the host fails to
+    create must get its capacity back on device at the next window (mirror
+    self-correction), so a later app can use it."""
+    # 1 node x 8 CPU: one 7-executor app fills it.
+    h, node_names = _mk_harness(n_nodes=1, fifo=False)
+    ext = h.extender
+    rrm = ext._rrm
+    from spark_scheduler_tpu.core.reservation_manager import ReservationError
+
+    orig_create = rrm.create_reservations
+
+    def flaky_create(pod, res, driver_node, exec_nodes):
+        if pod.labels["spark-app-id"].startswith("fail"):
+            raise ReservationError("injected write failure")
+        return orig_create(pod, res, driver_node, exec_nodes)
+
+    rrm.create_reservations = flaky_create
+    wf = [_driver_args(h, f"fail-{i}", 7, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in wf])
+    r1 = ext.predicate_window_complete(t1)
+    # fail-0: kernel admitted, reservation write failed (internal error);
+    # fail-1: no capacity left behind fail-0's in-window admission.
+    assert all(not res.node_names for res in r1), r1
+
+    # Next window: the failed gang's capacity must be back (device restored
+    # by the mirror delta), so a fresh app fits.
+    _, okargs = _driver_args(h, "recover", 7, node_names)
+    _, okargs_b = _driver_args(h, "recover-b", 7, node_names)
+    t2 = ext.predicate_window_dispatch([okargs, okargs_b])
+    r2 = ext.predicate_window_complete(t2)
+    assert r2[0].node_names, (
+        f"capacity lost after reservation-write failure: {r2}"
+    )
+    assert not r2[1].node_names  # the node holds exactly one gang
+
+
+def test_topology_change_mid_flight_raises_drain():
+    """Adding a node while a window is un-fetched makes the next pipelined
+    build raise PipelineDrainRequired; after completing the pending window
+    the dispatch succeeds and sees the new node."""
+    h, node_names = _mk_harness(n_nodes=4)
+    ext = h.extender
+    w1 = [_driver_args(h, f"dr-{i}", 2, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in w1])
+    assert t1.handle is not None
+    h.add_nodes(new_node("late-node", zone="zone0"))
+    w2 = [
+        _driver_args(h, f"dr2-{i}", 2, node_names + ["late-node"])
+        for i in range(2)
+    ]
+    try:
+        ext.predicate_window_dispatch([a for _, a in w2])
+        raised = False
+    except PipelineDrainRequired:
+        raised = True
+    assert raised
+    r1 = ext.predicate_window_complete(t1)
+    assert all(res.node_names for res in r1)
+    t2 = ext.predicate_window_dispatch([a for _, a in w2])
+    r2 = ext.predicate_window_complete(t2)
+    assert all(res.node_names for res in r2)
+
+
+def test_fetch_failure_resets_pipeline_to_host_truth():
+    """A failed decision fetch must not leak the window's gangs: the
+    pipeline resets and the next build re-uploads from the host view, so
+    the never-reserved capacity is usable again."""
+    h, node_names = _mk_harness(n_nodes=1, fifo=False)
+    ext = h.extender
+    _, args = _driver_args(h, "lost", 7, node_names)
+    _, args_b = _driver_args(h, "lost-b", 7, node_names)
+    t1 = ext.predicate_window_dispatch([args, args_b])
+
+    class _Boom:
+        def result(self):
+            raise ConnectionError("injected transfer failure")
+
+    t1.handle.blob_future = _Boom()
+    try:
+        ext.predicate_window_complete(t1)
+        raised = False
+    except ConnectionError:
+        raised = True
+    assert raised
+    assert ext._solver._pipe is None  # pipeline dropped
+    assert not ext._inflight_apps  # in-flight cleared despite the failure
+
+    # Capacity was never reserved; a fresh window must be able to use it.
+    _, okargs = _driver_args(h, "after-loss", 7, node_names)
+    _, okargs_b = _driver_args(h, "after-loss-b", 7, node_names)
+    t2 = ext.predicate_window_dispatch([okargs, okargs_b])
+    r2 = ext.predicate_window_complete(t2)
+    assert r2[0].node_names, r2
+
+
+def test_batcher_completes_solo_ticket_before_next_window():
+    """A pending ticket with no dispatched solve (lone request -> solo path)
+    must be completed BEFORE the next window dispatches: its reservation
+    has to be visible to the window's solve (review finding: solo-path
+    admissions were not pipeline-guarded)."""
+    import queue as _q
+
+    from spark_scheduler_tpu.server.http import PredicateBatcher
+
+    events = []
+    release_solo = threading.Event()
+
+    class StubTicket:
+        def __init__(self, tag, handle):
+            self.tag = tag
+            self.handle = handle
+            self.sync = handle is None
+
+    class StubExtender:
+        def predicate_window_dispatch(self, args_list):
+            tag = args_list[0]
+            handle = object() if len(args_list) > 1 else None
+            events.append(("dispatch", tag, handle is not None))
+            return StubTicket(tag, handle)
+
+        def predicate_window_complete(self, ticket):
+            if ticket.sync:
+                release_solo.wait(5)
+            events.append(("complete", ticket.tag, ticket.handle is not None))
+            return ["ok"] * (1 if ticket.sync else 2)
+
+    b = PredicateBatcher(StubExtender(), max_window=4, hold_ms=0)
+    results = _q.Queue()
+
+    def submit(tag):
+        results.put(b.submit(tag))
+
+    t_solo = threading.Thread(target=submit, args=("solo",))
+    t_solo.start()
+    # Give the dispatcher time to claim the solo request as a sync ticket.
+    import time as _time
+
+    _time.sleep(0.15)
+    t_w1 = threading.Thread(target=submit, args=("w",))
+    t_w2 = threading.Thread(target=submit, args=("w",))
+    t_w1.start(), t_w2.start()
+    _time.sleep(0.15)
+    release_solo.set()
+    for t in (t_solo, t_w1, t_w2):
+        t.join(10)
+    b.stop()
+    # The solo ticket's COMPLETE must precede the window's DISPATCH.
+    solo_done = events.index(("complete", "solo", False))
+    win_disp = next(
+        i for i, e in enumerate(events) if e[0] == "dispatch" and e[2]
+    )
+    assert solo_done < win_disp, events
+
+
+def test_http_pipelined_soak_consistent_reservations():
+    """Concurrent clients through the REAL HTTP server: every request lands
+    and the final reservation state is consistent (each app exactly one
+    reservation, executor slots on real nodes, no node over capacity)."""
+    import http.client
+    import json as _json
+
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+
+    h, node_names = _mk_harness(n_nodes=40)
+    server = SchedulerHTTPServer(
+        h.app, host="127.0.0.1", port=0, request_timeout_s=120.0
+    )
+    server.start()
+    n_clients, rounds = 8, 5
+    errs: list = []
+    placed: dict[str, str] = {}
+    lock = threading.Lock()
+
+    def client(ci):
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=120
+            )
+            for r in range(rounds):
+                driver = static_allocation_spark_pods(f"soak-{ci}-{r}", 2)[0]
+                h.backend.add_pod(driver)
+                body = _json.dumps(
+                    {"Pod": pod_to_k8s(driver), "NodeNames": node_names}
+                ).encode()
+                conn.request("POST", "/predicates", body=body)
+                resp = _json.loads(conn.getresponse().read())
+                if not resp.get("NodeNames"):
+                    raise RuntimeError(f"{ci}-{r}: {resp}")
+                h.backend.bind_pod(driver, resp["NodeNames"][0])
+                with lock:
+                    placed[driver.name] = resp["NodeNames"][0]
+            conn.close()
+        except Exception as exc:  # surfaced after join
+            errs.append(exc)
+
+    threads = [
+        threading.Thread(target=client, args=(ci,)) for ci in range(n_clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        if errs:
+            raise errs[0]
+        assert len(placed) == n_clients * rounds
+        rrs = h.backend.list("resourcereservations")
+        assert len(rrs) == n_clients * rounds
+        # node accounting: reserved usage never exceeds allocatable
+        usage: dict[str, list[int]] = {}
+        for rr in rrs:
+            for slot in rr.spec.reservations.values():
+                u = usage.setdefault(slot.node, [0, 0])
+                u[0] += slot.resources.cpu_milli
+                u[1] += slot.resources.mem_kib
+        for node, (cpu, kib) in usage.items():
+            assert node in set(node_names)
+            assert cpu <= 8000 and kib <= 8 * 1024 * 1024, (node, cpu, kib)
+    finally:
+        server.stop()
