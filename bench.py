@@ -427,7 +427,14 @@ def bench_serving_http_concurrent(rng):
     from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
 
     backend, app, server, node_names = _serving_fixture()
-    n_clients, per_client, warmup_rounds = 32, 8, 5
+    # Capacity margin: every app reserves 9 CPU / 9 Gi on an 8x500 = 4000
+    # CPU cluster. warm (5x32) + run (6x32) = 352 gangs = 3168 CPU (79%),
+    # leaving room for the strict-FIFO hypothetical prefix (each request
+    # re-packs ALL its pending earlier drivers, double-counting
+    # admitted-but-unbound ones — reference semantics, resource.go:221-258);
+    # at 8 run rounds the tail of the run brushed 94% and could correctly
+    # reject with failure-earlier-driver.
+    n_clients, per_client, warmup_rounds = 32, 6, 5
     lat_lock = threading.Lock()
 
     def run_phase(phase, rounds):

@@ -114,6 +114,7 @@ class WindowTicket:
     __slots__ = (
         "args_list", "results", "roles", "timer_start", "window", "handle",
         "all_nodes", "by_name", "domains", "inflight_keys", "sync", "done",
+        "epoch",
     )
 
     def __init__(self, args_list):
@@ -129,6 +130,10 @@ class WindowTicket:
         self.inflight_keys = []
         self.sync = False  # single request: serve via the solo predicate()
         self.done = False  # results already final (e.g. reconcile failure)
+        # Extender capacity epoch at dispatch: if a solo-path admission
+        # changed capacity while this window was in flight, its device
+        # decisions are stale and the complete phase re-solves serially.
+        self.epoch = -1
 
 
 class SparkSchedulerExtender:
@@ -169,6 +174,13 @@ class SparkSchedulerExtender:
         # applied — the idempotent-retry branch then returns the reserved
         # node (resource.go:273-286).
         self._inflight_apps: set[tuple[str, str]] = set()
+        # Bumped by every SOLO-path admission that changes capacity (a solo
+        # driver's reservations, an executor reschedule / soft
+        # reservation). Windows dispatched before such a change re-solve at
+        # complete time instead of applying their stale device decisions —
+        # pipelined serving stays decision-equivalent to a serialized
+        # order.
+        self._capacity_epoch = 0
 
     # ------------------------------------------------------------------ API
 
@@ -271,6 +283,27 @@ class SparkSchedulerExtender:
             return [self.predicate(t.args_list[0])]
         if t.done:
             return t.results
+        if t.handle is not None and t.epoch != self._capacity_epoch:
+            # A solo-path admission changed capacity while this window was
+            # in flight: its device decisions could double-book. Discard
+            # them and re-solve NOW — every earlier window has applied by
+            # this point (completions are FIFO), so a fresh serialized
+            # solve sees the full truth. The pipelined device state is
+            # dropped with the stale decisions; later in-flight windows
+            # detect the same epoch change and re-solve too.
+            self._inflight_apps.difference_update(t.inflight_keys)
+            self._solver.discard_pipeline()
+            redo_ids = [
+                i
+                for i, r in enumerate(t.roles)
+                if r == ROLE_DRIVER and t.results[i] is None
+            ]
+            t.window = []
+            t.handle = None
+            t.inflight_keys = []
+            t.domains = {}
+            if len(redo_ids) > 1:
+                self._dispatch_driver_window(t, redo_ids)
         if t.handle is not None:
             self._complete_driver_window(t)
         args_list, results, roles = t.args_list, t.results, t.roles
@@ -433,6 +466,7 @@ class SparkSchedulerExtender:
         t.handle = self._solver.pack_window_dispatch(
             self.binpacker.name, tensors, requests
         )
+        t.epoch = self._capacity_epoch
         t.inflight_keys = [
             (pod.namespace, pod.labels.get(SPARK_APP_ID_LABEL, ""))
             for _, pod, _, _ in window
@@ -496,6 +530,22 @@ class SparkSchedulerExtender:
             results[i] = ExtenderFilterResult(
                 node_names=[packing.driver_node], failed_nodes={}, outcome=SUCCESS
             )
+
+    def _build_serving_tensors(self, all_nodes, usage, overhead):
+        """Device tensors for the SOLO serving paths, shared with the
+        pipelined window cache: one device-resident copy of cluster state,
+        and solo solves see the gangs of still-in-flight windows (the
+        threaded base) instead of a stale host-only view. If topology
+        changed while windows are in flight, fall back to an uncached
+        host-truth build for this one solve."""
+        from spark_scheduler_tpu.core.solver import PipelineDrainRequired
+
+        try:
+            return self._solver.build_tensors_pipelined(
+                all_nodes, usage, overhead
+            )
+        except PipelineDrainRequired:
+            return self._solver.build_tensors(all_nodes, usage, overhead)
 
     def _mark_outcome(self, pod, role, outcome, timer_start) -> None:
         if self._metrics is not None:
@@ -577,7 +627,7 @@ class SparkSchedulerExtender:
             # state is device-resident: full node list + delta upload,
             # affinity filtering via the domain mask (VERDICT r2 #3).
             overhead = self._overhead.get_overhead(all_nodes)
-            tensors = self._solver.build_tensors_cached(all_nodes, usage, overhead)
+            tensors = self._build_serving_tensors(all_nodes, usage, overhead)
             domain = self._solver.candidate_mask(
                 tensors, [n.name for n in available_nodes]
             )
@@ -624,6 +674,8 @@ class SparkSchedulerExtender:
             )
         except ReservationError as exc:
             return None, FAILURE_INTERNAL, str(exc)
+        # Solo-path capacity change: stale in-flight windows must re-solve.
+        self._capacity_epoch += 1
         if self._events is not None:
             # Only on fresh admission — the idempotent-retry branch above
             # must not double-emit application_scheduled (events.go:27-50).
@@ -777,6 +829,9 @@ class SparkSchedulerExtender:
                 self._rrm.reserve_for_executor_on_rescheduled_node(executor, node)
             except ReservationError as exc:
                 return None, FAILURE_INTERNAL, f"failed to reserve node for rescheduled executor: {exc}"
+            # New usage on a node the reservation did not already cover:
+            # stale in-flight windows must re-solve.
+            self._capacity_epoch += 1
             return node, outcome, msg
 
         return None, FAILURE_UNBOUND, "application has no free executor spots to schedule this one"
@@ -820,7 +875,7 @@ class SparkSchedulerExtender:
         usage = self._rrm.reserved_usage()
         all_nodes = self._backend.list_nodes()
         overhead = self._overhead.get_overhead(all_nodes)
-        tensors = self._solver.build_tensors_cached(all_nodes, usage, overhead)
+        tensors = self._build_serving_tensors(all_nodes, usage, overhead)
         domain = self._solver.candidate_mask(tensors, [n.name for n in nodes])
         # A 1-executor gang with no driver = "first sorted node with room".
         packing = self._solver.pack(

@@ -333,6 +333,14 @@ class PlacementSolver:
         self._dev = {"host": host, "tensors": tensors}
         return tensors
 
+    def discard_pipeline(self) -> None:
+        """Drop the pipelined device state: the next build_tensors_pipelined
+        does a full upload from the host view. Used when in-flight window
+        decisions are being discarded (capacity changed under them) — the
+        host view is the durable truth once every surviving window has
+        applied."""
+        self._pipe = None
+
     def build_tensors_pipelined(
         self,
         nodes: Sequence[Node],
@@ -694,8 +702,11 @@ class PlacementSolver:
             if self._fetch_pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
+                # Several workers: over the tunnel, concurrent device_get
+                # RPCs overlap almost perfectly (4 fetches take ~1 RTT), so
+                # a depth-N serving pipeline divides the round trip.
                 self._fetch_pool = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="window-blob-fetch"
+                    max_workers=4, thread_name_prefix="window-blob-fetch"
                 )
             handle.blob_future = self._fetch_pool.submit(jax.device_get, blob)
         return handle

@@ -54,10 +54,15 @@ class PredicateBatcher:
     per-request lock (SURVEY.md §7 "Mutable-state races")."""
 
     def __init__(
-        self, extender, max_window: int = 32, hold_ms: float = 25.0, registry=None
+        self, extender, max_window: int = 32, hold_ms: float = 25.0,
+        registry=None, pipeline_depth: int = 3,
     ):
         self._extender = extender
         self._max_window = max_window
+        # How many dispatched windows may be awaiting their decision pull
+        # at once. Concurrent device_get RPCs overlap (the fetch pool), so
+        # depth N divides the per-window round-trip cost by up to N.
+        self._pipeline_depth = max(1, pipeline_depth)
         # Window-size histogram + wait time in the tagged registry (the
         # reference's metric discipline for every serving subsystem,
         # metrics/metrics.go:29-76).
@@ -122,23 +127,52 @@ class PredicateBatcher:
         self._thread.join(timeout=5)
 
     def _run(self) -> None:
-        """PIPELINED serving loop: dispatch window k+1 (host build + device
-        dispatch, no blocking) BEFORE completing window k (the blocking
-        decision pull + reservation apply). The device round trip of one
-        window overlaps the host work of the next, so steady-state cycle
-        time is ~max(RTT, host work) instead of their sum. Decisions are
-        unchanged: the solver threads the committed base availability
-        device-side across in-flight windows (build_tensors_pipelined), and
-        an app whose admission is still in flight is deferred to its own
-        window's post-apply solo loop (extender in-flight set)."""
+        """PIPELINED serving loop: dispatch the next window (host build +
+        async device dispatch) while up to `pipeline_depth` earlier windows
+        are still awaiting their decision pulls. Each window's pull starts
+        eagerly on the solver's fetch pool at dispatch, and concurrent
+        pulls overlap on the wire, so steady-state cycle time approaches
+        max(host work, RTT / depth) instead of host + RTT. Windows complete
+        strictly in dispatch order. Decisions are unchanged: the solver
+        threads the committed base availability device-side across
+        in-flight windows (build_tensors_pipelined), an app whose admission
+        is still in flight is deferred to its own window's post-apply solo
+        loop (extender in-flight set), and a ticket with no dispatched
+        solve (the solo path) drains the pipeline before serving."""
         import time as _time
+        from collections import deque
 
         from spark_scheduler_tpu.core.solver import PipelineDrainRequired
 
-        pending = None  # (ticket, batch) — dispatched, awaiting complete
+        pending: deque = deque()  # (ticket, batch) in dispatch order
+
+        def complete_head():
+            ok = self._complete_window(pending.popleft())
+            if not ok and pending:
+                # A failed fetch dropped the solver's pipelined state; the
+                # remaining in-flight windows' gangs exist only in their
+                # (still valid) device decisions. Apply them ALL before any
+                # new dispatch — a fresh full upload from the host view
+                # would otherwise lack their capacity debits and the next
+                # window could double-book.
+                while pending:
+                    self._complete_window(pending.popleft())
+
+        def complete_all():
+            while pending:
+                complete_head()
+
+        def head_ready() -> bool:
+            t = pending[0][0]
+            return (
+                t.handle is not None
+                and t.handle.blob_future is not None
+                and t.handle.blob_future.done()
+            )
+
         while True:
             with self._cv:
-                while not self._queue and not self._stopped and pending is None:
+                while not self._queue and not self._stopped and not pending:
                     self._cv.wait()
                 busy = (
                     self._last_window > 1
@@ -147,7 +181,7 @@ class PredicateBatcher:
                 if (
                     not self._stopped
                     and self._queue
-                    and pending is None
+                    and not pending
                     and self._hold_s > 0
                     and busy
                 ):
@@ -166,10 +200,11 @@ class PredicateBatcher:
                         self._cv.wait(remaining)
                 if self._stopped:
                     err = RuntimeError("scheduler is shutting down")
-                    if pending is not None:
-                        for entry in pending[1]:
+                    for _, entries in pending:
+                        for entry in entries:
                             entry[3] = err
                             entry[1].set()
+                    pending.clear()
                     for entry in self._queue:
                         entry[3] = err
                         entry[1].set()
@@ -183,36 +218,41 @@ class PredicateBatcher:
                         self._busy_until = (
                             _time.monotonic() + self._busy_ttl_s
                         )
-            new = None
+            new_ticket = None
             if batch:
-                # A pending ticket with NO dispatched device solve (a lone
-                # request served via the solo path, or a batch that didn't
-                # window) must be completed BEFORE dispatching the next
-                # window: its solo serve creates reservations the new
-                # window's solve has to see, and there is no in-flight
-                # fetch to overlap with anyway.
-                if pending is not None and pending[0].handle is None:
-                    self._complete_window(pending)
-                    pending = None
                 try:
-                    new = (self._dispatch_window(batch), batch)
+                    new_ticket = self._dispatch_window(batch)
                 except PipelineDrainRequired:
-                    # Topology changed under an in-flight window: apply it
+                    # Topology changed under in-flight windows: apply them
                     # first, then the fresh full upload is safe.
-                    if pending is not None:
-                        self._complete_window(pending)
-                        pending = None
+                    complete_all()
                     try:
-                        new = (self._dispatch_window(batch), batch)
+                        new_ticket = self._dispatch_window(batch)
                     except Exception as exc:
                         self._fail_batch(batch, exc)
                 except Exception as exc:
                     self._fail_batch(batch, exc)
-            if pending is not None:
-                if new is not None and new[0].handle is not None:
-                    self.pipelined_windows += 1
-                self._complete_window(pending)
-            pending = new
+            if new_ticket is not None:
+                if new_ticket.handle is None:
+                    # No dispatched device solve (lone request -> solo path,
+                    # or a batch that didn't window): its serve must observe
+                    # every earlier window's reservations, and there is no
+                    # fetch to overlap — drain, then serve now.
+                    complete_all()
+                    self._complete_window((new_ticket, batch))
+                else:
+                    if pending:
+                        self.pipelined_windows += 1
+                    pending.append((new_ticket, batch))
+            # Heads whose pull already landed complete at zero cost; then
+            # enforce the depth bound, and when the queue was empty drain
+            # one head (blocking) so responses never wait on new arrivals.
+            while pending and head_ready():
+                complete_head()
+            if len(pending) >= self._pipeline_depth:
+                complete_head()
+            if not batch and pending:
+                complete_head()
 
     def _dispatch_window(self, batch):
         from spark_scheduler_tpu.tracing import tracer
@@ -232,7 +272,10 @@ class PredicateBatcher:
         ):
             return self._extender.predicate_window_dispatch(args_list)
 
-    def _complete_window(self, pending) -> None:
+    def _complete_window(self, pending) -> bool:
+        """Returns False when the window failed (entries got the error) —
+        the serving loop then drains the rest of the pipeline before
+        dispatching anything new."""
         from spark_scheduler_tpu.tracing import tracer
 
         ticket, batch = pending
@@ -247,7 +290,7 @@ class PredicateBatcher:
                     results = self._extender.predicate_window_complete(ticket)
         except Exception as exc:  # whole-window failure
             self._fail_batch(batch, exc)
-            return
+            return False
         self.windows_served += 1
         self.requests_served += len(batch)
         self.max_window_seen = max(self.max_window_seen, len(batch))
@@ -258,6 +301,7 @@ class PredicateBatcher:
         for entry, result in zip(batch, results):
             entry[2] = result
             entry[1].set()
+        return True
 
     def _fail_batch(self, batch, exc) -> None:
         for entry in batch:

@@ -178,6 +178,100 @@ def test_topology_change_mid_flight_raises_drain():
     assert all(res.node_names for res in r2)
 
 
+def test_solo_solve_sees_inflight_window_gangs():
+    """A solo predicate served while windows are in flight uses the
+    pipelined device base, so it cannot take capacity an in-flight window's
+    gang already holds."""
+    h, node_names = _mk_harness(n_nodes=1, fifo=False)
+    ext = h.extender
+    # Window fills the node (7 execs + driver = 8 CPU).
+    w = [_driver_args(h, f"w-{i}", 7, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in w])
+    # Solo request while the window is un-fetched: must see the in-flight
+    # gang and reject.
+    _, solo_args = _driver_args(h, "solo-late", 3, node_names)
+    solo_res = ext.predicate(solo_args)
+    assert not solo_res.node_names, solo_res
+    r1 = ext.predicate_window_complete(t1)
+    assert r1[0].node_names
+
+
+def test_capacity_epoch_resolves_stale_window():
+    """When a solo admission bypasses the pipelined view (topology-change
+    fallback to a host-truth build), the epoch bump makes the in-flight
+    window discard its stale decisions and re-solve — no double-booking."""
+    h, node_names = _mk_harness(n_nodes=1, fifo=False)
+    ext = h.extender
+    solver = ext._solver
+    w = [_driver_args(h, f"stale-{i}", 7, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in w])
+
+    # Simulate the drain-fallback: the solo solve builds from HOST truth
+    # (blind to the in-flight gang) and admits onto the same node.
+    orig_build = solver.build_tensors_pipelined
+
+    def blind_build(nodes, usage, overhead):
+        return solver.build_tensors(nodes, usage, overhead)
+
+    solver.build_tensors_pipelined = blind_build
+    try:
+        _, solo_args = _driver_args(h, "solo-blind", 7, node_names)
+        solo_res = ext.predicate(solo_args)
+        assert solo_res.node_names, solo_res  # blind solve admits
+    finally:
+        solver.build_tensors_pipelined = orig_build
+
+    # The window's stale decision (stale-0 admitted on the now-taken node)
+    # must be discarded and re-solved: both window apps now fail.
+    r1 = ext.predicate_window_complete(t1)
+    assert not any(res.node_names for res in r1), (
+        f"stale window decisions were applied despite the epoch change: {r1}"
+    )
+    # Exactly one reservation (the solo app) — node not oversubscribed.
+    rrs = h.backend.list("resourcereservations")
+    assert len(rrs) == 1 and rrs[0].name == "solo-blind"
+
+
+def test_fetch_failure_applies_surviving_windows_before_redispatch():
+    """After window k's fetch fails (pipeline dropped), still-in-flight
+    window k+1 must be applied before a new dispatch builds from the host
+    view, or the new window would double-book k+1's capacity."""
+    h, node_names = _mk_harness(n_nodes=2, fifo=False)
+    ext = h.extender
+    w1 = [_driver_args(h, f"k-{i}", 7, node_names) for i in range(2)]
+    w2 = [_driver_args(h, f"k1-{i}", 7, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in w1])
+    t2 = ext.predicate_window_dispatch([a for _, a in w2])
+
+    class _Boom:
+        def result(self):
+            raise ConnectionError("injected")
+
+    t1.handle.blob_future = _Boom()
+    try:
+        ext.predicate_window_complete(t1)
+    except ConnectionError:
+        pass
+    assert ext._solver._pipe is None
+    # Batcher contract: complete the surviving window BEFORE dispatching new.
+    r2 = ext.predicate_window_complete(t2)
+    # k admitted both gangs device-side; k's fetch failed so ITS gangs are
+    # lost, but k+1's decisions were solved against a base that included
+    # k's gangs -> k+1 saw no room and rejects. Crucially its apply ran
+    # before the next dispatch.
+    # Now a fresh window builds from host truth (k lost, k+1 applied):
+    _, a1 = _driver_args(h, "fresh-0", 7, node_names)
+    _, a2 = _driver_args(h, "fresh-1", 7, node_names)
+    t3 = ext.predicate_window_dispatch([a1, a2])
+    r3 = ext.predicate_window_complete(t3)
+    # Accounting: reservations on any node never exceed 8 CPU.
+    usage: dict[str, int] = {}
+    for rr in h.backend.list("resourcereservations"):
+        for slot in rr.spec.reservations.values():
+            usage[slot.node] = usage.get(slot.node, 0) + slot.resources.cpu_milli
+    assert all(v <= 8000 for v in usage.values()), usage
+
+
 def test_fetch_failure_resets_pipeline_to_host_truth():
     """A failed decision fetch must not leak the window's gangs: the
     pipeline resets and the next build re-uploads from the host view, so
@@ -222,6 +316,8 @@ def test_batcher_completes_solo_ticket_before_next_window():
     events = []
     release_solo = threading.Event()
 
+    from types import SimpleNamespace
+
     class StubTicket:
         def __init__(self, tag, handle):
             self.tag = tag
@@ -231,7 +327,9 @@ def test_batcher_completes_solo_ticket_before_next_window():
     class StubExtender:
         def predicate_window_dispatch(self, args_list):
             tag = args_list[0]
-            handle = object() if len(args_list) > 1 else None
+            handle = (
+                SimpleNamespace(blob_future=None) if len(args_list) > 1 else None
+            )
             events.append(("dispatch", tag, handle is not None))
             return StubTicket(tag, handle)
 
