@@ -189,8 +189,16 @@ def run() -> dict:
     )
 
     if pallas_available():
-        for fill in PALLAS_FILLS:
-            c = TG.random_cluster(rng, N_NODES)
+        from spark_scheduler_tpu.ops.pallas_fifo import _SUBLANE_FOLD_MIN_NODES
+
+        # Every fill at the small size (flat [1, Np] layout) AND above the
+        # sublane-fold threshold (the [8, cols] layout): both compiled
+        # layouts of all three fills are parity-checked on silicon.
+        cases = [(fill, N_NODES) for fill in PALLAS_FILLS] + [
+            (fill, _SUBLANE_FOLD_MIN_NODES + 104) for fill in PALLAS_FILLS
+        ]
+        for fill, n_case in cases:
+            c = TG.random_cluster(rng, n_case)
             b = 8
             drivers = rng.integers(1, 6, size=(b, 3)).astype(np.int32)
             execs = rng.integers(1, 8, size=(b, 3)).astype(np.int32)

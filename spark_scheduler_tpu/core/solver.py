@@ -16,6 +16,7 @@ This replaces the reference's per-request map-building + sort + greedy loops
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -77,6 +78,50 @@ def _scatter_rows(avail, idx, rows):
     Duplicate indices carry identical rows (bucketing pads by repeating a
     dirty row), so .set is deterministic."""
     return avail.at[idx].set(rows)
+
+
+class _DaemonFetchPool:
+    """Minimal fetch pool with DAEMON workers: a device transfer stuck on a
+    dead tunnel must never block interpreter exit, which
+    ThreadPoolExecutor's non-daemon workers (joined by its atexit hook)
+    would. Futures are concurrent.futures.Future — result()/done()
+    compatible with the executor API the handles expose."""
+
+    def __init__(self, workers: int = 4, name: str = "window-blob-fetch"):
+        import queue as _queue
+
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._threads = []
+        for i in range(workers):
+            t = threading.Thread(
+                target=self._run, daemon=True, name=f"{name}-{i}"
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except BaseException as exc:  # delivered via future.result()
+                fut.set_exception(exc)
+
+    def submit(self, fn, *args):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+        self._q.put((fut, lambda: fn(*args)))
+        return fut
+
+    def shutdown(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
 
 
 @jax.jit
@@ -332,6 +377,15 @@ class PlacementSolver:
         tensors.host = host
         self._dev = {"host": host, "tensors": tensors}
         return tensors
+
+    def close(self) -> None:
+        """Release the blob-fetch pool. Workers are daemon threads
+        (_DaemonFetchPool), so a transfer stuck on a dead tunnel can never
+        block interpreter exit; shutdown just tells idle workers to
+        finish."""
+        if self._fetch_pool is not None:
+            self._fetch_pool.shutdown()
+            self._fetch_pool = None
 
     def discard_pipeline(self) -> None:
         """Drop the pipelined device state: the next build_tensors_pipelined
@@ -700,14 +754,10 @@ class PlacementSolver:
             # tunneled device the transfer RTT dominates, and starting it at
             # dispatch lets it elapse under the next window's host build.
             if self._fetch_pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-
                 # Several workers: over the tunnel, concurrent device_get
                 # RPCs overlap almost perfectly (4 fetches take ~1 RTT), so
                 # a depth-N serving pipeline divides the round trip.
-                self._fetch_pool = ThreadPoolExecutor(
-                    max_workers=4, thread_name_prefix="window-blob-fetch"
-                )
+                self._fetch_pool = _DaemonFetchPool(workers=4)
             handle.blob_future = self._fetch_pool.submit(jax.device_get, blob)
         return handle
 

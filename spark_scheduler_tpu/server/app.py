@@ -80,6 +80,7 @@ class SchedulerApp:
         self.rr_cache.stop()
         self.demand_cache.flush()
         self.demand_cache.stop()
+        self.solver.close()
 
 
 def build_scheduler_app(

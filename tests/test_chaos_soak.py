@@ -72,12 +72,22 @@ def test_chaos_soak_reservations_converge(chaos_apiserver):
             for p in pods[1:]:
                 assert h.schedule(p, names).node_names, (i, p.name)
     finally:
-        # Storm off: the ladder must now converge.
-        server.chaos_conflict_rate = 0.0
-        server.chaos_drop_rate = 0.0
+        # Let the storm actually bite before switching it off: on a loaded
+        # machine all 12 admissions can finish before the async workers
+        # attempt a single write, so give the workers time to run into the
+        # injected faults first.
+        try:
+            wait_until(
+                lambda: server.chaos_injected["conflicts"] >= 3
+                and server.chaos_injected["drops"] >= 1,
+                timeout=10.0,
+            )
+        finally:
+            # Storm off: the ladder must now converge.
+            server.chaos_conflict_rate = 0.0
+            server.chaos_drop_rate = 0.0
 
-    # The storm actually happened (exact counts depend on how many writes
-    # the async workers attempted while the storm was up).
+    # The storm actually happened.
     assert server.chaos_injected["conflicts"] >= 3, server.chaos_injected
     assert server.chaos_injected["drops"] >= 1, server.chaos_injected
 

@@ -99,6 +99,32 @@ def test_pallas_negative_availability_and_zero_count():
         assert_same(got, want)
 
 
+def test_pallas_sublane_folded_layout_matches():
+    """Clusters at/above the fold threshold run the [8, cols] sublane
+    layout; its decisions must equal the XLA scan exactly like the flat
+    row's. The threshold is patched down so interpret mode stays fast —
+    a fresh node count keeps the jit cache from reusing a flat-layout
+    trace."""
+    from spark_scheduler_tpu.ops import pallas_fifo as pf
+
+    orig = pf._layout_rows
+    pf._layout_rows = lambda n: pf._SUBLANES
+    try:
+        rng = np.random.default_rng(21)
+        c = random_cluster(rng, 53, num_zones=NUM_ZONES)
+        apps = random_apps(rng, 7)
+        for fill in sorted(PALLAS_FILLS):
+            want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX,
+                                     num_zones=NUM_ZONES)
+            got = fifo_pack_pallas(
+                c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES,
+                interpret=True,
+            )
+            assert_same(got, want)
+    finally:
+        pf._layout_rows = orig
+
+
 def test_pallas_rejects_masked_and_single_az():
     rng = np.random.default_rng(3)
     c = random_cluster(rng, 16, num_zones=NUM_ZONES)
