@@ -329,6 +329,25 @@ def bench_config5(rng):
     )
 
 
+def bench_config6_beyond_baseline(rng):
+    """BEYOND the baseline matrix: the north-star workload at 10x the node
+    scale (100k nodes x 1k apps). The Pallas queue kernel keeps the whole
+    availability tensor (~1.2 MB) in VMEM, so the admission scan keeps its
+    shape — demonstrating the single-chip headroom past BASELINE.md's
+    largest config."""
+    n_apps, window, emax = 1_000, 100, 8
+    cluster = _make_cluster(rng, 100_000, 4)
+    batches = _make_batches(rng, n_apps, window, emax)
+    chain = _windowed_chain(cluster, batches, "tightly-pack", emax, 4)
+    ms = _measure_marginal_ms(chain, len(batches))
+    _emit(
+        "config6_beyond_baseline_window_service_ms_100k_nodes",
+        ms,
+        window,
+        {"nodes": 100_000, "note": "10x the baseline node scale"},
+    )
+
+
 def _serving_fixture(n_nodes=500):
     from spark_scheduler_tpu.server.app import build_scheduler_app
     from spark_scheduler_tpu.server.config import InstallConfig
@@ -690,6 +709,7 @@ def main() -> None:
     bench_config2_az_aware(rng)
     bench_config3(rng)
     bench_config4(rng)
+    bench_config6_beyond_baseline(rng)
     bench_serving_http(rng)
     bench_serving_http_concurrent(rng)
     bench_serving_http_executors(rng)

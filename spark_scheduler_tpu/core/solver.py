@@ -91,6 +91,7 @@ class _DaemonFetchPool:
         import queue as _queue
 
         self._q: "_queue.Queue" = _queue.Queue()
+        self._shutdown = False
         self._threads = []
         for i in range(workers):
             t = threading.Thread(
@@ -115,11 +116,17 @@ class _DaemonFetchPool:
     def submit(self, fn, *args):
         from concurrent.futures import Future
 
+        if self._shutdown:
+            # Fail fast like ThreadPoolExecutor: a submit after shutdown
+            # must not enqueue a Future no worker will ever run (the caller
+            # would block forever on .result()).
+            raise RuntimeError("cannot schedule new futures after shutdown")
         fut: Future = Future()
         self._q.put((fut, lambda: fn(*args)))
         return fut
 
     def shutdown(self) -> None:
+        self._shutdown = True
         for _ in self._threads:
             self._q.put(None)
 
