@@ -233,12 +233,16 @@ def bench_config3(rng):
 
 def bench_config4(rng):
     """#4: 5 instance-groups, heterogeneous node shapes, 5k nodes — one
-    vmapped grouped_fifo_pack over stacked per-group subproblems
+    grouped_fifo_pack_auto over stacked per-group subproblems (per-group
+    Pallas kernels on a single chip, the vmapped scan on meshes)
     (failover.go:276-313 grouping, SURVEY.md §5.7)."""
     import jax
 
     from spark_scheduler_tpu.parallel.mesh import make_solver_mesh
-    from spark_scheduler_tpu.parallel.solve import grouped_fifo_pack, stack_groups
+    from spark_scheduler_tpu.parallel.solve import (
+        grouped_fifo_pack_auto,
+        stack_groups,
+    )
 
     shapes = [  # (cpu-range, mem-range, gpu-range) per group — heterogeneous
         ((4, 16), (8, 32), (0, 1)),
@@ -262,7 +266,7 @@ def bench_config4(rng):
         c = stacked_cluster
         admitted = []
         for _ in range(k):
-            out = grouped_fifo_pack(
+            out = grouped_fifo_pack_auto(
                 mesh, c, stacked_apps, fill="tightly-pack", emax=8, num_zones=4
             )
             c = dataclasses.replace(c, available=out.available_after)

@@ -223,6 +223,54 @@ def run() -> dict:
                 ), ("pallas", fill, field, device)
             checked += 1
 
+    # -- grouped single-chip fast path: the jitted per-group Pallas loop
+    #    (grouped_fifo_pack_auto) must equal the vmapped XLA scan
+    #    group-for-group on silicon.
+    if pallas_available():
+        from spark_scheduler_tpu.parallel import (
+            grouped_fifo_pack,
+            grouped_fifo_pack_auto,
+            make_solver_mesh,
+            stack_groups,
+        )
+
+        # One-device mesh EXPLICITLY: on a multi-chip host a full-device
+        # mesh would route auto to the GSPMD scan and this check would
+        # vacuously compare the scan with itself.
+        mesh = make_solver_mesh(n_groups=1, devices=jax.devices()[:1])
+        clusters, app_batches = [], []
+        for _ in range(3):
+            clusters.append(TG.random_cluster(rng, N_NODES))
+            b = 6
+            app_batches.append(
+                make_app_batch(
+                    rng.integers(1, 6, size=(b, 3)).astype(np.int32),
+                    rng.integers(1, 6, size=(b, 3)).astype(np.int32),
+                    rng.integers(0, emax + 1, size=b).astype(np.int32),
+                    skippable=rng.random(b) < 0.5,
+                )
+            )
+        sc, sa = stack_groups(clusters, app_batches)
+        want = jax.device_get(
+            grouped_fifo_pack(
+                mesh, sc, sa, fill="tightly-pack", emax=emax,
+                num_zones=num_zones,
+            )
+        )
+        got = jax.device_get(
+            grouped_fifo_pack_auto(
+                mesh, sc, sa, fill="tightly-pack", emax=emax,
+                num_zones=num_zones,
+            )
+        )
+        for field in ("driver_node", "executor_nodes", "admitted", "packed",
+                      "available_after"):
+            assert np.array_equal(
+                np.asarray(getattr(got, field)),
+                np.asarray(getattr(want, field)),
+            ), ("grouped-pallas", field, device)
+        checked += 1
+
     return {"device": device, "cases_checked": checked, "parity": "ok"}
 
 

@@ -92,6 +92,10 @@ class _DaemonFetchPool:
 
         self._q: "_queue.Queue" = _queue.Queue()
         self._shutdown = False
+        # Serializes the shutdown-flag check against shutdown itself: an
+        # unsynchronized check-then-put could slip an item in behind the
+        # worker-exit sentinels, recreating the forever-pending future.
+        self._lock = threading.Lock()
         self._threads = []
         for i in range(workers):
             t = threading.Thread(
@@ -116,19 +120,23 @@ class _DaemonFetchPool:
     def submit(self, fn, *args):
         from concurrent.futures import Future
 
-        if self._shutdown:
-            # Fail fast like ThreadPoolExecutor: a submit after shutdown
-            # must not enqueue a Future no worker will ever run (the caller
-            # would block forever on .result()).
-            raise RuntimeError("cannot schedule new futures after shutdown")
-        fut: Future = Future()
-        self._q.put((fut, lambda: fn(*args)))
-        return fut
+        with self._lock:
+            if self._shutdown:
+                # Fail fast like ThreadPoolExecutor: a submit after
+                # shutdown must not enqueue a Future no worker will ever
+                # run (the caller would block forever on .result()).
+                raise RuntimeError(
+                    "cannot schedule new futures after shutdown"
+                )
+            fut: Future = Future()
+            self._q.put((fut, lambda: fn(*args)))
+            return fut
 
     def shutdown(self) -> None:
-        self._shutdown = True
-        for _ in self._threads:
-            self._q.put(None)
+        with self._lock:
+            self._shutdown = True
+            for _ in self._threads:
+                self._q.put(None)
 
 
 @jax.jit

@@ -12,6 +12,7 @@ psum/all-gather/all-to-all it needs (scaling-book recipe).
 from spark_scheduler_tpu.parallel.mesh import make_solver_mesh
 from spark_scheduler_tpu.parallel.solve import (
     grouped_fifo_pack,
+    grouped_fifo_pack_auto,
     sharded_fifo_pack,
     stack_groups,
 )
@@ -20,5 +21,6 @@ __all__ = [
     "make_solver_mesh",
     "sharded_fifo_pack",
     "grouped_fifo_pack",
+    "grouped_fifo_pack_auto",
     "stack_groups",
 ]

@@ -158,6 +158,29 @@ def test_pallas_empty_batch():
     )
 
 
+def test_grouped_auto_falls_back_on_cpu():
+    """On the CPU mesh (no Mosaic) grouped_fifo_pack_auto must produce the
+    vmapped scan's decisions; on a multi-device mesh it must always use the
+    GSPMD path regardless of backend."""
+    from spark_scheduler_tpu.parallel import (
+        grouped_fifo_pack,
+        grouped_fifo_pack_auto,
+        make_solver_mesh,
+        stack_groups,
+    )
+
+    rng = np.random.default_rng(17)
+    clusters = [random_cluster(rng, 16, num_zones=NUM_ZONES) for _ in range(2)]
+    batches = [random_apps(rng, 4) for _ in range(2)]
+    sc, sa = stack_groups(clusters, batches)
+    mesh = make_solver_mesh(n_groups=1)
+    want = grouped_fifo_pack(mesh, sc, sa, fill="tightly-pack", emax=EMAX,
+                             num_zones=NUM_ZONES)
+    got = grouped_fifo_pack_auto(mesh, sc, sa, fill="tightly-pack",
+                                 emax=EMAX, num_zones=NUM_ZONES)
+    assert_same(got, want)
+
+
 def test_auto_routing_falls_back_on_cpu():
     """On the CPU suite Mosaic is unavailable: fifo_pack_auto must still
     return correct decisions via the XLA scan."""
