@@ -78,6 +78,19 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def pallas_eligible(apps: "AppBatch", fill: str) -> bool:
+    """THE single definition of what the Pallas queue kernel supports:
+    plain queue mode (no per-app masks, no segmented windows) with one of
+    the three plain fills. Shared by every routing site so eligibility
+    cannot drift when the kernel learns new shapes."""
+    return (
+        fill in PALLAS_FILLS
+        and apps.commit is None
+        and apps.driver_cand is None
+        and apps.domain is None
+    )
+
+
 def _make_kernel(fill: str, emax: int, n_pad: int, n_apps: int, rows: int):
     """Build the kernel body. Everything static (fill, emax, padding,
     layout) is closed over; per-app scalars arrive via prefetch refs.
@@ -343,10 +356,12 @@ def fifo_pack_pallas(
     north-star batched admission. Callers should route through
     `fifo_pack_auto`, which falls back to the XLA scan everywhere else.
     """
-    if fill not in PALLAS_FILLS:
-        raise ValueError(f"pallas path supports {PALLAS_FILLS}, got {fill!r}")
-    if apps.commit is not None or apps.driver_cand is not None or apps.domain is not None:
-        raise ValueError("pallas path is queue-mode only (no masks/segments)")
+    if not pallas_eligible(apps, fill):
+        raise ValueError(
+            f"pallas path supports queue mode with {PALLAS_FILLS}, got "
+            f"fill={fill!r} masked={apps.driver_cand is not None or apps.domain is not None} "
+            f"segmented={apps.commit is not None}"
+        )
 
     n = cluster.available.shape[0]
     b = apps.driver_req.shape[0]
@@ -485,14 +500,7 @@ def fifo_pack_auto(
     XLA scan. Decisions are identical either way (golden-parity tested)."""
     from spark_scheduler_tpu.ops.batched import batched_fifo_pack
 
-    if (
-        prefer_pallas
-        and fill in PALLAS_FILLS
-        and apps.commit is None
-        and apps.driver_cand is None
-        and apps.domain is None
-        and pallas_available()
-    ):
+    if prefer_pallas and pallas_eligible(apps, fill) and pallas_available():
         return fifo_pack_pallas(
             cluster, apps, fill=fill, emax=emax, num_zones=num_zones
         )

@@ -135,39 +135,43 @@ def grouped_fifo_pack_auto(
     vmap) — decisions identical, groups are independent. Multi-device
     meshes and masked/segmented batches keep the GSPMD vmapped scan."""
     from spark_scheduler_tpu.ops.pallas_fifo import (
-        PALLAS_FILLS,
         pallas_available,
+        pallas_eligible,
     )
 
-    queue_mode = (
-        apps.commit is None
-        and apps.driver_cand is None
-        and apps.domain is None
-    )
     if (
         mesh.devices.size == 1
-        and queue_mode
-        and fill in PALLAS_FILLS
+        and pallas_eligible(apps, fill)
         and pallas_available()
     ):
-        return _grouped_pallas(
-            clusters,
-            apps,
-            fill=fill,
-            emax=emax,
-            num_zones=num_zones,
-            g=clusters.available.shape[0],
-        )
+        # Pin execution (and result placement) to the mesh's device — the
+        # jitted fast path would otherwise run on the default device even
+        # when the caller built the mesh over a different chip.
+        with jax.default_device(list(mesh.devices.flat)[0]):
+            return _grouped_pallas(
+                clusters,
+                apps,
+                fill=fill,
+                emax=emax,
+                num_zones=num_zones,
+                g=clusters.available.shape[0],
+            )
     return grouped_fifo_pack(
         mesh, clusters, apps, fill=fill, emax=emax, num_zones=num_zones
     )
 
 
-@partial(jax.jit, static_argnames=("fill", "emax", "num_zones", "g"))
-def _grouped_pallas(clusters, apps, *, fill, emax, num_zones, g):
+@partial(
+    jax.jit, static_argnames=("fill", "emax", "num_zones", "g", "interpret")
+)
+def _grouped_pallas(
+    clusters, apps, *, fill, emax, num_zones, g, interpret=False
+):
     """All G group solves in ONE jitted program (one dispatch; G Mosaic
     kernel launches back to back). Slicing the group axis eagerly would
-    cost an RPC per op on a tunneled device."""
+    cost an RPC per op on a tunneled device. `interpret` lets the CPU
+    suite drive the slicing/stacking logic through the Pallas
+    interpreter."""
     from spark_scheduler_tpu.ops.pallas_fifo import fifo_pack_pallas
 
     outs = []
@@ -176,7 +180,8 @@ def _grouped_pallas(clusters, apps, *, fill, emax, num_zones, g):
         a_i = AppBatch(*[None if col is None else col[i] for col in apps])
         outs.append(
             fifo_pack_pallas(
-                c_i, a_i, fill=fill, emax=emax, num_zones=num_zones
+                c_i, a_i, fill=fill, emax=emax, num_zones=num_zones,
+                interpret=interpret,
             )
         )
     return BatchedPacking(

@@ -244,15 +244,44 @@ class PredicateBatcher:
                     if pending:
                         self.pipelined_windows += 1
                     pending.append((new_ticket, batch))
-            # Heads whose pull already landed complete at zero cost; then
-            # enforce the depth bound, and when the queue was empty drain
-            # one head (blocking) so responses never wait on new arrivals.
+                    # Wake the loop the moment this window's decision pull
+                    # lands, so its complete never waits on a cv timeout.
+                    fut = new_ticket.handle.blob_future
+                    if fut is not None:
+                        fut.add_done_callback(lambda _f: self._notify())
+            # Heads whose pull already landed complete at zero cost, and
+            # the depth bound backpressures (blocking complete) when the
+            # pipeline is full.
             while pending and head_ready():
                 complete_head()
             if len(pending) >= self._pipeline_depth:
                 complete_head()
-            if not batch and pending:
-                complete_head()
+            if not batch and pending and not self._queue:
+                head = pending[0][0]
+                if head.handle is None or head.handle.blob_future is None:
+                    # No in-flight pull to overlap with (no eager fetch was
+                    # started): complete now, blocking fetch and all.
+                    complete_head()
+                else:
+                    # The head's pull is still in flight: sleep until it
+                    # lands OR a request shows up. NEVER block in result()
+                    # here — requests arriving during the fetch must
+                    # dispatch the next window first so their solve
+                    # overlaps this fetch (blocking the dispatcher on an
+                    # un-ready head serializes the pipeline whenever all
+                    # clients cluster into one window cohort).
+                    with self._cv:
+                        while (
+                            not self._queue
+                            and not self._stopped
+                            and pending
+                            and not head_ready()
+                        ):
+                            self._cv.wait(0.05)
+
+    def _notify(self) -> None:
+        with self._cv:
+            self._cv.notify_all()
 
     def _dispatch_window(self, batch):
         from spark_scheduler_tpu.tracing import tracer

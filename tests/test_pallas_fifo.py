@@ -158,6 +158,33 @@ def test_pallas_empty_batch():
     )
 
 
+def test_grouped_pallas_fast_path_interpret():
+    """The per-group slicing/stacking of the single-chip fast path
+    (_grouped_pallas) must reproduce the vmapped scan's decisions — driven
+    through the Pallas interpreter so the CPU suite covers the wiring, not
+    just the fallback."""
+    from spark_scheduler_tpu.parallel import (
+        grouped_fifo_pack,
+        grouped_fifo_pack_auto,  # noqa: F401 — fallback covered below
+        make_solver_mesh,
+        stack_groups,
+    )
+    from spark_scheduler_tpu.parallel.solve import _grouped_pallas
+
+    rng = np.random.default_rng(29)
+    # 24 nodes: divisible by the virtual mesh's 8-way node axis (the
+    # `want` side shards over it).
+    clusters = [random_cluster(rng, 24, num_zones=NUM_ZONES) for _ in range(3)]
+    batches = [random_apps(rng, 5) for _ in range(3)]
+    sc, sa = stack_groups(clusters, batches)
+    mesh = make_solver_mesh(n_groups=1)
+    want = grouped_fifo_pack(mesh, sc, sa, fill="tightly-pack", emax=EMAX,
+                             num_zones=NUM_ZONES)
+    got = _grouped_pallas(sc, sa, fill="tightly-pack", emax=EMAX,
+                          num_zones=NUM_ZONES, g=3, interpret=True)
+    assert_same(got, want)
+
+
 def test_grouped_auto_falls_back_on_cpu():
     """On the CPU mesh (no Mosaic) grouped_fifo_pack_auto must produce the
     vmapped scan's decisions; on a multi-device mesh it must always use the
