@@ -293,6 +293,13 @@ class SparkSchedulerExtender:
             # detect the same epoch change and re-solve too.
             self._inflight_apps.difference_update(t.inflight_keys)
             self._solver.discard_pipeline()
+            # The discard/re-solve is itself a capacity change: the re-solve
+            # below may place this window's gangs on different nodes than the
+            # (discarded) device decisions a LATER in-flight window's base
+            # threads. Bump the epoch so every window dispatched before this
+            # discard also re-solves from host truth instead of applying
+            # decisions computed against the dropped placements.
+            self._capacity_epoch += 1
             redo_ids = [
                 i
                 for i, r in enumerate(t.roles)

@@ -451,23 +451,37 @@ class PlacementSolver:
             dirty = np.flatnonzero(delta.any(axis=1))
             avail = p["avail"]
             k = len(dirty)
-            if k:
-                # Pad with a repeated index but ZERO delta rows: .add is
-                # cumulative, so padding must contribute nothing.
-                kb = _bucket(k, 16)
-                idx = np.full(kb, dirty[0], dtype=np.int32)
-                idx[:k] = dirty
-                rows = np.zeros((kb, host.available.shape[1]), np.int32)
-                rows[:k] = delta[dirty]
-                avail = _add_rows(avail, jnp.asarray(idx), jnp.asarray(rows))
-                stats["delta_uploads"] += 1
-                stats["delta_rows"] += k
-            else:
-                stats["reuse_hits"] += 1
-            tensors = dataclasses.replace(p["tensors"], available=avail)
-            tensors.host = host
-            p.update(host=host, tensors=tensors, avail=avail, mirror=cur)
-            return tensors
+            # An external availability swing too large for the int32 delta
+            # rows falls through to a FULL re-upload instead of wrapping
+            # silently and corrupting the device base (with windows in
+            # flight that raises PipelineDrainRequired below — the standard
+            # retry contract of this method).
+            fits_i32 = k == 0 or (
+                delta.min() >= np.iinfo(np.int32).min
+                and delta.max() <= np.iinfo(np.int32).max
+            )
+            if not fits_i32 and p["unfetched"]:
+                raise PipelineDrainRequired(
+                    "availability delta exceeds int32 with a window in flight"
+                )
+            if fits_i32:
+                if k:
+                    # Pad with a repeated index but ZERO delta rows: .add
+                    # is cumulative, so padding must contribute nothing.
+                    kb = _bucket(k, 16)
+                    idx = np.full(kb, dirty[0], dtype=np.int32)
+                    idx[:k] = dirty
+                    rows = np.zeros((kb, host.available.shape[1]), np.int32)
+                    rows[:k] = delta[dirty]
+                    avail = _add_rows(avail, jnp.asarray(idx), jnp.asarray(rows))
+                    stats["delta_uploads"] += 1
+                    stats["delta_rows"] += k
+                else:
+                    stats["reuse_hits"] += 1
+                tensors = dataclasses.replace(p["tensors"], available=avail)
+                tensors.host = host
+                p.update(host=host, tensors=tensors, avail=avail, mirror=cur)
+                return tensors
         if p is not None and p["unfetched"]:
             raise PipelineDrainRequired(
                 "cluster topology changed with a window in flight"

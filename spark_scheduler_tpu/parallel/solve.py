@@ -144,10 +144,22 @@ def grouped_fifo_pack_auto(
         and pallas_eligible(apps, fill)
         and pallas_available()
     ):
-        # Pin execution (and result placement) to the mesh's device — the
-        # jitted fast path would otherwise run on the default device even
-        # when the caller built the mesh over a different chip.
-        with jax.default_device(list(mesh.devices.flat)[0]):
+        # Pin execution (and result placement) to the mesh's device.
+        # jax.default_device only steers UNcommitted arrays — jit follows
+        # committed inputs — so committed-elsewhere leaves are moved
+        # explicitly.
+        dev = list(mesh.devices.flat)[0]
+
+        def _pin(x):
+            if x is None:
+                return None
+            if getattr(x, "devices", None) and x.devices() != {dev}:
+                return jax.device_put(x, dev)
+            return x
+
+        clusters = jax.tree_util.tree_map(_pin, clusters)
+        apps = AppBatch(*[_pin(col) for col in apps])
+        with jax.default_device(dev):
             return _grouped_pallas(
                 clusters,
                 apps,

@@ -82,6 +82,11 @@ class PredicateBatcher:
         self._busy_until = 0.0
         self._cv = threading.Condition()
         self._queue: list[list] = []  # [args, event, result, exception]
+        # Entries the dispatcher has claimed whose events may not be set
+        # yet — what stop() fails when the dispatcher thread is stalled in
+        # a blocking fetch against a dead tunnel (join times out but
+        # in-flight HTTP handlers must not hang until request timeout).
+        self._claimed: list[list] = []
         self._stopped = False
         # Serving stats (surfaced at GET /metrics).
         self.windows_served = 0
@@ -123,8 +128,22 @@ class PredicateBatcher:
     def stop(self) -> None:
         with self._cv:
             self._stopped = True
-            self._cv.notify()
+            self._cv.notify_all()
         self._thread.join(timeout=5)
+        # Fail every claimed/queued entry whose event is still unset so
+        # in-flight handlers return instead of hanging until their own
+        # request timeout — covers a dispatcher STALLED in a decision pull
+        # against a dead tunnel (join timed out) and one that DIED with a
+        # batch's events unset. No-op on a clean exit (everything is set);
+        # a late set() by a stalled thread is harmless.
+        err = RuntimeError("scheduler is shutting down")
+        with self._cv:
+            leftovers = self._claimed + self._queue
+            self._queue.clear()
+        for entry in leftovers:
+            if not entry[1].is_set():
+                entry[3] = err
+                entry[1].set()
 
     def _run(self) -> None:
         """PIPELINED serving loop: dispatch the next window (host build +
@@ -212,6 +231,10 @@ class PredicateBatcher:
                     return
                 batch = self._queue[: self._max_window]
                 del self._queue[: self._max_window]
+                self._claimed = [
+                    e for e in self._claimed if not e[1].is_set()
+                ]
+                self._claimed.extend(batch)
                 if batch:
                     self._last_window = len(batch)
                     if len(batch) > 1:

@@ -264,6 +264,65 @@ def test_capacity_epoch_resolves_stale_window():
     assert len(rrs) == 1 and rrs[0].name == "solo-blind"
 
 
+def test_epoch_mismatch_resolve_invalidates_later_inflight_windows():
+    """The discard/re-solve of an epoch-stale window is ITSELF a capacity
+    change (advisor r3, high): the re-solve may move the window's gangs off
+    the placements a LATER in-flight window's device base threads. That
+    later window must also re-solve — applying its device decisions would
+    double-book the moved gangs' nodes.
+
+    Interleave (two 8-CPU nodes): window B (two 2-CPU gangs, both land on
+    n0); solo app (6 CPU -> n1) bumps the epoch; window C dispatched
+    against B-original + solo (sees n0=4, n1=6 used); B completes, detects
+    the stale epoch, re-solves from host truth — now one of B's gangs
+    prefers n1 (2 free next to the solo app) and MOVES; C completes. Before
+    the fix C applied its device decisions (computed against B-original)
+    and n1 ended 10/8 oversubscribed."""
+    h, node_names = _mk_harness(n_nodes=2, fifo=False)
+    ext = h.extender
+    solver = ext._solver
+
+    w_b = [_driver_args(h, f"b-{i}", 1, node_names) for i in range(2)]  # 2 CPU each
+    t_b = ext.predicate_window_dispatch([a for _, a in w_b])
+    assert t_b.handle is not None
+
+    # Solo admission while B is in flight: 6 CPU only fits n1 (n0 would
+    # have 4 free in the pipelined view but 6 > 4... actually n0 has
+    # 8-4=4 free -> must go n1). Bumps the capacity epoch.
+    _, solo_args = _driver_args(h, "solo-mid", 5, node_names)  # 6 CPU
+    solo_res = ext.predicate(solo_args)
+    assert solo_res.node_names, solo_res
+    epoch_after_solo = ext._capacity_epoch
+
+    # Window C dispatched at the post-solo epoch, device base threading
+    # B's ORIGINAL placements.
+    w_c = [
+        _driver_args(h, "c-0", 3, node_names),  # 4 CPU
+        _driver_args(h, "c-1", 1, node_names),  # 2 CPU
+    ]
+    t_c = ext.predicate_window_dispatch([a for _, a in w_c])
+    assert t_c.epoch == epoch_after_solo
+
+    # B completes: stale epoch -> discard + re-solve. The discard must
+    # bump the epoch again so C re-solves too.
+    r_b = ext.predicate_window_complete(t_b)
+    assert ext._capacity_epoch > epoch_after_solo, (
+        "discard/re-solve did not invalidate later in-flight windows"
+    )
+    r_c = ext.predicate_window_complete(t_c)
+
+    # Accounting invariant: whatever got admitted, no node exceeds 8 CPU.
+    usage: dict[str, int] = {}
+    for rr in h.backend.list("resourcereservations"):
+        for slot in rr.spec.reservations.values():
+            usage[slot.node] = usage.get(slot.node, 0) + slot.resources.cpu_milli
+    assert all(v <= 8000 for v in usage.values()), usage
+    # Everything fits serially (2+2+6+4+2 = 16 = cluster), so a correct
+    # re-solve chain admits all of it.
+    for res in list(r_b) + list(r_c):
+        assert res.node_names, (usage, r_b, r_c)
+
+
 def test_fetch_failure_applies_surviving_windows_before_redispatch():
     """After window k's fetch fails (pipeline dropped), still-in-flight
     window k+1 must be applied before a new dispatch builds from the host
