@@ -1,0 +1,85 @@
+"""Profile the HOST-side executor binding ladder, bench-shaped: 500 nodes,
+8 apps x 16 executors bound through windowed serving (the executors ride
+the post-window solo loop). Run: python hack/profile_executor_host.py"""
+
+import cProfile
+import io
+import pstats
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, ".")
+
+from spark_scheduler_tpu.core.extender import ExtenderArgs  # noqa: E402
+from spark_scheduler_tpu.server.app import build_scheduler_app  # noqa: E402
+from spark_scheduler_tpu.server.config import InstallConfig  # noqa: E402
+from spark_scheduler_tpu.store.backend import InMemoryBackend  # noqa: E402
+from spark_scheduler_tpu.testing.harness import (  # noqa: E402
+    INSTANCE_GROUP_LABEL,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+def main():
+    n_apps, execs_per_app, window = 8, 16, 16
+    backend = InMemoryBackend()
+    node_names = []
+    for i in range(500):
+        n = new_node(f"bench-n{i}", zone=f"zone{i % 4}")
+        backend.add_node(n)
+        node_names.append(n.name)
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=True, sync_writes=True, instance_group_label=INSTANCE_GROUP_LABEL
+        ),
+    )
+    ext = app.extender
+
+    exec_pods = []
+    for i in range(n_apps):
+        pods = static_allocation_spark_pods(f"exb-{i}", execs_per_app)
+        backend.add_pod(pods[0])
+        r = ext.predicate(ExtenderArgs(pod=pods[0], node_names=list(node_names)))
+        assert r.node_names, r.outcome
+        backend.bind_pod(pods[0], r.node_names[0])
+        exec_pods.extend(pods[1:])
+
+    def bind_window(pods):
+        for p in pods:
+            backend.add_pod(p)
+        t = ext.predicate_window_dispatch(
+            [ExtenderArgs(pod=p, node_names=list(node_names)) for p in pods]
+        )
+        results = ext.predicate_window_complete(t)
+        for p, r in zip(pods, results):
+            assert r.node_names, (p.name, r.outcome)
+            backend.bind_pod(p, r.node_names[0])
+
+    # Warm one window.
+    bind_window(exec_pods[:window])
+    rest = exec_pods[window:]
+
+    t0 = time.perf_counter()
+    pr = cProfile.Profile()
+    pr.enable()
+    for i in range(0, len(rest), window):
+        bind_window(rest[i : i + window])
+    pr.disable()
+    wall = time.perf_counter() - t0
+    print(
+        f"== {len(rest)} executor bindings in windows of {window}: "
+        f"{wall*1e3/len(rest):.2f} ms/binding, {len(rest)/wall:.0f} bindings/s"
+    )
+    s = io.StringIO()
+    pstats.Stats(pr, stream=s).sort_stats("cumulative").print_stats(40)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

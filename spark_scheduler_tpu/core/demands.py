@@ -38,6 +38,11 @@ class DemandManager:
         self._events = events
         self._waste = waste
 
+    def deferred_sync(self):
+        """Window-scoped write-back batching (WriteThroughCache.deferred_sync)
+        for this manager's demand cache."""
+        return self._cache.deferred_sync()
+
     # -- creation -----------------------------------------------------------
 
     def create_demand_for_application(

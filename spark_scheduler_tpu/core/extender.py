@@ -311,28 +311,33 @@ class SparkSchedulerExtender:
             t.domains = {}
             if len(redo_ids) > 1:
                 self._dispatch_driver_window(t, redo_ids)
-        if t.handle is not None:
-            self._complete_driver_window(t)
-        args_list, results, roles = t.args_list, t.results, t.roles
-        for i, args in enumerate(args_list):
-            if results[i] is not None:
-                continue
-            pod = args.pod
-            with tracer().span(
-                "select-node", role=roles[i] or "unknown",
-                pod=f"{pod.namespace}/{pod.name}",
-            ) as sp:
-                node, outcome, message = self._select_node(
-                    roles[i], pod, args.node_names
-                )
-                sp.tag("outcome", outcome)
-            self._mark_outcome(pod, roles[i], outcome, t.timer_start)
-            if node is None:
-                results[i] = self._fail(args, outcome, message or outcome)
-            else:
-                results[i] = ExtenderFilterResult(
-                    node_names=[node], failed_nodes={}, outcome=outcome
-                )
+        # One write-back drain for the whole window instead of one per
+        # mutation: every result below is only released to its client after
+        # this context exits, so durability-before-response is unchanged.
+        with self._rrm.rr_cache.deferred_sync(), \
+                self._demands.deferred_sync():
+            if t.handle is not None:
+                self._complete_driver_window(t)
+            args_list, results, roles = t.args_list, t.results, t.roles
+            for i, args in enumerate(args_list):
+                if results[i] is not None:
+                    continue
+                pod = args.pod
+                with tracer().span(
+                    "select-node", role=roles[i] or "unknown",
+                    pod=f"{pod.namespace}/{pod.name}",
+                ) as sp:
+                    node, outcome, message = self._select_node(
+                        roles[i], pod, args.node_names
+                    )
+                    sp.tag("outcome", outcome)
+                self._mark_outcome(pod, roles[i], outcome, t.timer_start)
+                if node is None:
+                    results[i] = self._fail(args, outcome, message or outcome)
+                else:
+                    results[i] = ExtenderFilterResult(
+                        node_names=[node], failed_nodes={}, outcome=outcome
+                    )
         return results
 
     def _dispatch_driver_window(self, t: WindowTicket, driver_ids) -> None:
@@ -809,21 +814,19 @@ class SparkSchedulerExtender:
             # bound node not offered; fall through (resource.go:377-388)
 
         try:
-            unbound_nodes, found_unbound = self._rrm.find_unbound_reservation_nodes(executor)
+            chosen, unbound_count = self._rrm.reserve_executor_on_unbound(
+                executor, node_names
+            )
         except ReservationError as exc:
             return None, FAILURE_INTERNAL, f"error when looking for unbound reservations: {exc}"
-        if found_unbound:
-            chosen = next((n for n in node_names if n in set(unbound_nodes)), None)
-            if chosen is not None:
-                try:
-                    self._rrm.reserve_for_executor_on_unbound_reservation(executor, chosen)
-                except ReservationError as exc:
-                    return None, FAILURE_INTERNAL, f"failed to reserve node for executor: {exc}"
-                return chosen, SUCCESS, ""
+        if chosen is not None:
+            return chosen, SUCCESS, ""
+        found_unbound = unbound_count > 0
 
         try:
             free_spots = self._rrm.get_remaining_allowed_executor_count(
-                executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
+                executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace,
+                unbound_count=unbound_count,
             )
         except ReservationError as exc:
             return None, FAILURE_INTERNAL, f"error when checking for remaining allowed executor count: {exc}"

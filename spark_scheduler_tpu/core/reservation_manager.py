@@ -144,33 +144,40 @@ class ResourceReservationManager:
             return sr.node, True
         return None, False
 
-    def find_unbound_reservation_nodes(self, executor: Pod) -> tuple[list[str], bool]:
-        unbound = self._get_unbound_reservations(
-            executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
-        )
-        nodes = sorted(set(unbound.values()))
-        return nodes, bool(nodes)
+    def get_remaining_allowed_executor_count(
+        self, app_id: str, namespace: str, *, unbound_count: int | None = None
+    ) -> int:
+        """`unbound_count` lets a caller that just scanned the unbound slots
+        (reserve_executor_on_unbound) skip re-deriving them."""
+        if unbound_count is None:
+            unbound_count = len(self._get_unbound_reservations(app_id, namespace))
+        return unbound_count + self._get_free_soft_reservation_spots(app_id, namespace)
 
-    def get_remaining_allowed_executor_count(self, app_id: str, namespace: str) -> int:
-        unbound = self._get_unbound_reservations(app_id, namespace)
-        return len(unbound) + self._get_free_soft_reservation_spots(app_id, namespace)
-
-    def reserve_for_executor_on_unbound_reservation(
-        self, executor: Pod, node: str
-    ) -> None:
+    def reserve_executor_on_unbound(
+        self, executor: Pod, node_names: list[str]
+    ) -> tuple[Optional[str], int]:
+        """The find-unbound + bind rungs fused into ONE unbound scan under
+        the mutex (a split find -> re-scan -> bind pair would derive the
+        active pod set twice per executor — the serving ladder's hot spot).
+        Binds to the first OFFERED candidate (node_names order) holding an
+        unbound slot, matching the split path's choice exactly
+        (resource.go:389-400). Returns (bound node | None, unbound slot
+        count); the count feeds get_remaining_allowed_executor_count."""
         with self._mutex:
             unbound = self._get_unbound_reservations(
                 executor.labels.get(SPARK_APP_ID_LABEL, ""), executor.namespace
             )
-            for res_name, res_node in unbound.items():
-                if res_node == node:
-                    self._bind_executor_to_resource_reservation(
-                        executor, res_name, node
-                    )
-                    return
-        raise ReservationError(
-            "failed to find free reservation on requested node for executor"
-        )
+            if unbound:
+                nodes = set(unbound.values())
+                chosen = next((n for n in node_names if n in nodes), None)
+                if chosen is not None:
+                    for res_name, res_node in unbound.items():
+                        if res_node == chosen:
+                            self._bind_executor_to_resource_reservation(
+                                executor, res_name, chosen
+                            )
+                            return chosen, len(unbound)
+            return None, len(unbound)
 
     def reserve_for_executor_on_rescheduled_node(
         self, executor: Pod, node: str

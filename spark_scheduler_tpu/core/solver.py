@@ -237,6 +237,7 @@ class WindowHandle:
     __slots__ = (
         "strategy", "blob", "blob_future", "requests", "flat_rows",
         "host_avail", "host_schedulable", "priors", "placements", "n",
+        "row_driver_req", "row_exec_req", "row_skippable",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
@@ -257,6 +258,9 @@ class WindowHandle:
         self.priors = priors  # tuple[WindowHandle] — fetched before this one
         self.placements = None  # int64 [N,3], filled at fetch
         self.n = n
+        self.row_driver_req = None  # int64 [B,3], set after dispatch
+        self.row_exec_req = None
+        self.row_skippable = None
 
 
 class PlacementSolver:
@@ -290,6 +294,12 @@ class PlacementSolver:
         # only runs stateless jax.device_get calls.
         self._pipe: dict | None = None
         self._fetch_pool = None
+        # Candidate-mask memo: serving windows pass the same (usually
+        # cluster-wide) candidate list once per request, and building the
+        # [N] bool mask is a Python walk over every name. Keyed by the full
+        # name tuple + registry epoch + padded size, so a stale mapping can
+        # never serve (collision-safe: dict equality compares the tuple).
+        self._cand_cache: dict[tuple, np.ndarray] = {}
         self.device_state_stats = {
             "full_uploads": 0,
             "delta_uploads": 0,
@@ -575,11 +585,22 @@ class PlacementSolver:
 
     def candidate_mask(self, tensors, node_names: Sequence[str]) -> np.ndarray:
         n = tensors.available.shape[0]
+        key = (n, self.registry.epoch, tuple(node_names))
+        mask = self._cand_cache.get(key)
+        if mask is not None:
+            return mask
         mask = np.zeros(n, dtype=bool)
+        index_of = self.registry.index_of
         for name in node_names:
-            idx = self.registry.index_of(name)
+            idx = index_of(name)
             if idx is not None and idx < n:
                 mask[idx] = True
+        # Shared across callers — must be treated read-only (every consumer
+        # either copies via `&`/stack or hands it straight to the device).
+        mask.flags.writeable = False
+        if len(self._cand_cache) >= 64:
+            self._cand_cache.clear()
+        self._cand_cache[key] = mask
         return mask
 
     def _num_zones_bucket(self) -> int:
@@ -734,13 +755,28 @@ class PlacementSolver:
                 dom_rows.append(dom)
 
         b = len(flat_rows)
-        counts = [int(r[2]) for r in flat_rows]
-        emax = _bucket(max(max(counts), 1), 8)
+        # FIFO windows repeat the SAME row objects across requests (request
+        # i's hypothetical prefix shares the pending-driver parse of request
+        # i+1), so materialize each distinct Resources once.
+        arr_memo: dict[int, np.ndarray] = {}
+
+        def as_arr(res) -> np.ndarray:
+            a = arr_memo.get(id(res))
+            if a is None:
+                a = res.as_array()
+                arr_memo[id(res)] = a
+            return a
+
+        drv_arr = np.stack([as_arr(r[0]) for r in flat_rows])
+        exc_arr = np.stack([as_arr(r[1]) for r in flat_rows])
+        counts = np.asarray([r[2] for r in flat_rows], np.int32)
+        skip_arr = np.asarray([bool(r[3]) for r in flat_rows])
+        emax = _bucket(max(int(counts.max()), 1), 8)
         apps = make_app_batch(
-            np.stack([r[0].as_array() for r in flat_rows]),
-            np.stack([r[1].as_array() for r in flat_rows]),
-            np.asarray(counts, np.int32),
-            skippable=[bool(r[3]) for r in flat_rows],
+            drv_arr,
+            exc_arr,
+            counts,
+            skippable=skip_arr,
             # Coarse row bucket (32): window row counts jitter with load and
             # FIFO depth; each distinct bucket is a fresh XLA compile, which
             # on a remote TPU stalls live serving for seconds.
@@ -777,6 +813,11 @@ class PlacementSolver:
             priors=priors,
             n=n,
         )
+        # Stacked per-row requests for the fetch-side reconstruction: int64
+        # so the vectorized subtractions against the int64 base never wrap.
+        handle.row_driver_req = drv_arr.astype(np.int64)
+        handle.row_exec_req = exc_arr.astype(np.int64)
+        handle.row_skippable = skip_arr
         if pipelined:
             p["unfetched"].append(handle)
             # Start the device->host pull NOW on the fetch thread: over a
@@ -797,7 +838,7 @@ class PlacementSolver:
             return []
         from spark_scheduler_tpu.tracing import tracer
 
-        requests, flat_rows, n = handle.requests, handle.flat_rows, handle.n
+        requests, n = handle.requests, handle.n
         with tracer().span(
             "solve", strategy=handle.strategy, nodes=n,
             window_requests=len(requests), batched=True,
@@ -827,7 +868,12 @@ class PlacementSolver:
         # host view at dispatch, minus the committed placements of windows
         # that were still in flight then (the device had them threaded),
         # minus committed placements of earlier segments, minus in-segment
-        # admitted hypothetical placements.
+        # admitted hypothetical placements. Vectorized over each segment's
+        # rows (a FIFO window carries O(requests x pending) hypothetical
+        # rows — per-row Python was the serving loop's hot spot).
+        drv64 = handle.row_driver_req
+        exc64 = handle.row_exec_req
+        skip = handle.row_skippable
         decisions: list[WindowDecision] = []
         base = handle.host_avail.copy()
         for prior in handle.priors:
@@ -836,40 +882,49 @@ class PlacementSolver:
         placements = np.zeros_like(base)
         row = 0
         for r, req in enumerate(requests):
-            seg_rows = list(range(row, row + len(req.rows)))
-            row += len(req.rows)
-            real = seg_rows[-1]
-            earlier_blocked = False
-            seg_avail = base.copy()
-            for j in seg_rows[:-1]:
-                if admitted[j]:
-                    if drivers[j] >= 0:
-                        seg_avail[drivers[j]] -= flat_rows[j][0].as_array()
-                    for e in execs[j]:
-                        if e >= 0:
-                            seg_avail[e] -= flat_rows[j][1].as_array()
-                elif not packed[j] and not flat_rows[j][3]:
-                    earlier_blocked = True
+            nrows = len(req.rows)
+            hyp = np.arange(row, row + nrows - 1)
+            real = row + nrows - 1
+            row += nrows
             req_admitted = bool(admitted[real])
+            earlier_blocked = False
             eff = None
+            if nrows > 1:
+                adm_h = admitted[hyp]
+                earlier_blocked = bool(
+                    np.any(~adm_h & ~packed[hyp] & ~skip[hyp])
+                )
             if req_admitted:
+                seg_avail = base.copy()
+                if nrows > 1:
+                    dsel = adm_h & (drivers[hyp] >= 0)
+                    if dsel.any():
+                        np.subtract.at(
+                            seg_avail, drivers[hyp][dsel], drv64[hyp][dsel]
+                        )
+                    e = execs[hyp]
+                    esel = adm_h[:, None] & (e >= 0)
+                    if esel.any():
+                        ri, _si = np.nonzero(esel)
+                        np.subtract.at(seg_avail, e[esel], exc64[hyp][ri])
                 eff = avg_packing_efficiency_np(
                     handle.host_schedulable,
                     seg_avail,
                     int(drivers[real]),
                     execs[real],
-                    flat_rows[real][0].as_array(),
-                    flat_rows[real][1].as_array(),
+                    drv64[real],
+                    exc64[real],
                 )
                 # Commit this request's placement into the base for the
                 # segments after it (mirrors the device-side base thread).
                 if drivers[real] >= 0:
-                    base[drivers[real]] -= flat_rows[real][0].as_array()
-                    placements[drivers[real]] += flat_rows[real][0].as_array()
-                for e in execs[real]:
-                    if e >= 0:
-                        base[e] -= flat_rows[real][1].as_array()
-                        placements[e] += flat_rows[real][1].as_array()
+                    base[drivers[real]] -= drv64[real]
+                    placements[drivers[real]] += drv64[real]
+                ev = execs[real]
+                ev = ev[ev >= 0]
+                if ev.size:
+                    np.subtract.at(base, ev, exc64[real])
+                    np.add.at(placements, ev, exc64[real])
             exec_idx = [int(x) for x in execs[real] if int(x) >= 0]
             decisions.append(
                 WindowDecision(

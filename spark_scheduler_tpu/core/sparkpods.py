@@ -163,6 +163,13 @@ class SparkPodLister:
     def __init__(self, backend, instance_group_label: str):
         self._backend = backend
         self.instance_group_label = instance_group_label
+        # Per-app and per-role listing are on the executor/FIFO hot paths;
+        # with an index-capable backend they touch one bucket instead of
+        # scanning every pod (the reference's informer indexers).
+        register = getattr(backend, "register_pod_index", None)
+        if register is not None:
+            register(SPARK_APP_ID_LABEL)
+            register(SPARK_ROLE_LABEL)
 
     def list_pending_drivers(self) -> list[Pod]:
         """All unscheduled, undeleted driver pods, oldest first — ONE backend

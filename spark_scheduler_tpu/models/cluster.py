@@ -99,6 +99,13 @@ class NodeRegistry:
         self._free: list[int] = []
         self._zone_ids: dict[str, int] = {}
         self._zone_names: list[str] = []
+        # Bumped on every name->index mapping change; lets derived artifacts
+        # (candidate masks, rank tables) cache against a stable mapping.
+        self._epoch = 0
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
 
     def intern(self, name: str) -> int:
         with self._intern_lock:
@@ -111,6 +118,7 @@ class NodeRegistry:
                     idx = len(self._names)
                     self._names.append(name)
                 self._index[name] = idx
+                self._epoch += 1
             return idx
 
     def remove(self, name: str) -> None:
@@ -119,6 +127,7 @@ class NodeRegistry:
             if idx is not None:
                 self._names[idx] = None
                 self._free.append(idx)
+                self._epoch += 1
 
     def index_of(self, name: str) -> int | None:
         return self._index.get(name)

@@ -27,6 +27,7 @@ from __future__ import annotations
 import dataclasses
 import re
 from fractions import Fraction
+from functools import lru_cache
 
 import numpy as np
 
@@ -93,7 +94,16 @@ def parse_quantity(s: str | int | float, dim: int, *, round_up: bool = True) -> 
 
     round_up=True (requests) rounds toward +inf; round_up=False (allocatable)
     rounds toward -inf, so rounding is always conservative for admission.
-    """
+
+    Memoized: quantity strings repeat massively at serving time (every pod
+    of a fleet carries the same handful of "8"/"8Gi"-style values), and the
+    exact-Fraction parse is the expensive part. Pure function of hashable
+    inputs — safe to cache."""
+    return _parse_quantity_cached(s, dim, round_up)
+
+
+@lru_cache(maxsize=8192)
+def _parse_quantity_cached(s, dim: int, round_up: bool) -> int:
     frac = _parse_to_fraction(s)
     scale = 1024 if dim == MEM_DIM else 1000
     # Memory unit is KiB; CPU/GPU units are milli.

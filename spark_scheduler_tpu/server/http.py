@@ -207,16 +207,30 @@ class PredicateBatcher:
                     # Accumulation hold, only when nothing is in flight — a
                     # pending window's fetch IS the accumulation period
                     # otherwise. Stop holding once the queue reaches the
-                    # previous window size (the natural concurrency level).
+                    # previous window size (the natural concurrency level)
+                    # OR stops growing for two consecutive slices: when the
+                    # live cohort is smaller than the previous window (e.g.
+                    # a 16-client phase after a 32-client one), everyone has
+                    # submitted within a couple ms and the rest of the hold
+                    # would be pure added latency.
                     target = min(self._last_window, self._max_window)
                     deadline = _time.monotonic() + self._hold_s
+                    prev_len, stalls = -1, 0
                     while (
                         len(self._queue) < target and not self._stopped
                     ):
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
                             break
-                        self._cv.wait(remaining)
+                        qlen = len(self._queue)
+                        if qlen == prev_len and qlen > 0:
+                            stalls += 1
+                            if stalls >= 2:
+                                break
+                        else:
+                            stalls = 0
+                        prev_len = qlen
+                        self._cv.wait(min(remaining, 0.002))
                 if self._stopped:
                     err = RuntimeError("scheduler is shutting down")
                     for _, entries in pending:

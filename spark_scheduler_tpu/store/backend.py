@@ -78,6 +78,12 @@ class InMemoryBackend(ClusterBackend):
         self.terminating_namespaces: set[str] = set()
         # Write fault injection for tests: fn(kind, verb, obj) -> Exception | None
         self.fault_injector: Optional[Callable[[str, str, Any], Optional[Exception]]] = None
+        # Incrementally-maintained pod indexes by label key — the informer
+        # indexer slot (the reference's clientsets list pods through indexed
+        # informer caches, never by scanning every pod). Registered lazily
+        # by consumers (SparkPodLister); list_pods uses them when the filter
+        # carries an indexed key.
+        self._pod_indexes: dict[str, dict[str, dict[tuple[str, str], Pod]]] = {}
 
     # -- CRDs ---------------------------------------------------------------
 
@@ -165,6 +171,8 @@ class InMemoryBackend(ClusterBackend):
             if hasattr(obj, "resource_version"):
                 obj.resource_version = self._next_rv()
             self._objects[kind][k] = obj
+            if kind == "pods":
+                self._pod_index_add(obj)
             self._on_committed(kind, "create", obj)
         self._fire(kind, "add", obj)
         return obj
@@ -184,6 +192,9 @@ class InMemoryBackend(ClusterBackend):
                 obj.resource_version = self._next_rv()
             old = cur
             self._objects[kind][k] = obj
+            if kind == "pods":
+                self._pod_index_remove(old)
+                self._pod_index_add(obj)
             self._on_committed(kind, "update", obj)
         self._fire(kind, "update", old, obj)
         return obj
@@ -194,6 +205,8 @@ class InMemoryBackend(ClusterBackend):
             cur = self._objects[kind].pop((namespace, name), None)
             if cur is None:
                 raise NotFoundError(f"{kind} {(namespace, name)}")
+            if kind == "pods":
+                self._pod_index_remove(cur)
             self._on_committed(kind, "delete", (namespace, name))
         self._fire(kind, "delete", cur)
 
@@ -225,13 +238,53 @@ class InMemoryBackend(ClusterBackend):
     def delete_pod(self, pod: Pod) -> None:
         self.delete("pods", pod.namespace, pod.name)
 
+    def register_pod_index(self, label_key: str) -> None:
+        """Maintain a pods-by-label-value index for `label_key`; list_pods
+        filters carrying that key then touch only the matching bucket
+        instead of scanning every pod (informer-indexer semantics)."""
+        with self._lock:
+            if label_key in self._pod_indexes:
+                return
+            idx: dict[str, dict[tuple[str, str], Pod]] = {}
+            for k, p in self._objects["pods"].items():
+                v = p.labels.get(label_key)
+                if v is not None:
+                    idx.setdefault(v, {})[k] = p
+            self._pod_indexes[label_key] = idx
+
+    def _pod_index_add(self, pod: Pod) -> None:
+        k = self._key(pod)
+        for label_key, idx in self._pod_indexes.items():
+            v = pod.labels.get(label_key)
+            if v is not None:
+                idx.setdefault(v, {})[k] = pod
+
+    def _pod_index_remove(self, pod: Pod) -> None:
+        k = self._key(pod)
+        for label_key, idx in self._pod_indexes.items():
+            v = pod.labels.get(label_key)
+            if v is not None:
+                bucket = idx.get(v)
+                if bucket is not None:
+                    bucket.pop(k, None)
+                    if not bucket:
+                        idx.pop(v, None)
+
     def list_pods(
         self,
         namespace: str | None = None,
         labels: dict[str, str] | None = None,
     ) -> list[Pod]:
         with self._lock:
-            pods: Iterable[Pod] = self._objects["pods"].values()
+            pods: Iterable[Pod] = None  # type: ignore[assignment]
+            if labels:
+                for k in labels:
+                    idx = self._pod_indexes.get(k)
+                    if idx is not None:
+                        pods = idx.get(labels[k], {}).values()
+                        break
+            if pods is None:
+                pods = self._objects["pods"].values()
             out = []
             for p in pods:
                 if namespace is not None and p.namespace != namespace:
