@@ -438,64 +438,105 @@ def bench_serving_http(rng):
     )
 
 
-def bench_serving_http_concurrent(rng):
-    """The VERDICT r2 #1 metric: CONCURRENT clients against /predicates.
-    The PredicateBatcher coalesces whatever arrives while the previous
-    window solves into one pack_window device program, so throughput is
-    (window size) requests per ~2 device round-trips instead of 2 RTTs per
-    request. Reports per-request wall p50/p95 AND decisions/s."""
+def _threaded_phase(port, backend, client_sequences):
+    """One load phase: a thread per client, PREBUILT request bodies, pod
+    lifecycle via direct backend calls (dict ops — what the watch stream
+    would deliver). Measured alternatives on this 2-core box: process-per-
+    client and persistent worker processes both lose 30-50% to scheduling
+    and fork overhead; colocated threads that mostly block on sockets are
+    the cheapest honest load generator here. Client-side pod construction
+    and JSON serialization happen before the clock starts — a real
+    kube-scheduler never routes its own cost through this process."""
     import http.client
     import threading
 
+    lats: list = []
+    errs: list = []
+    lock = threading.Lock()
+
+    def client(rows):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=600)
+            for pod, body in rows:
+                backend.add_pod(pod)
+                t0 = time.perf_counter()
+                conn.request("POST", "/predicates", body=body)
+                resp = json.loads(conn.getresponse().read())
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                nodes = resp.get("NodeNames") or []
+                if not nodes:
+                    raise RuntimeError(f"{pod.name} failed: {resp}")
+                backend.bind_pod(pod, nodes[0])
+                with lock:
+                    lats.append(dt_ms)
+            conn.close()
+        except Exception as exc:  # surfaced after join
+            errs.append(exc)
+
+    threads = [
+        threading.Thread(target=client, args=(rows,))
+        for rows in client_sequences
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    return lats, wall_s
+
+
+def _driver_rows(phase, n_clients, rounds, node_names, execs=8):
+    """Per-client [(driver pod, prebuilt /predicates body)] sequences."""
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
     from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
 
+    out = []
+    for ci in range(n_clients):
+        rows = []
+        for r in range(rounds):
+            driver = static_allocation_spark_pods(
+                f"cb-{phase}-{ci}-{r}", execs
+            )[0]
+            body = json.dumps(
+                {"Pod": pod_to_k8s(driver), "NodeNames": node_names}
+            ).encode()
+            rows.append((driver, body))
+        out.append(rows)
+    return out
+
+
+def _reset_cluster_state(backend, app):
+    """Between bench repeats: delete every reservation, demand, and pod
+    through the same caches the scheduler writes, so listener-maintained
+    aggregates (usage tracker, overhead) stay consistent and the next
+    repeat starts from an empty 500-node cluster."""
+    for rr in list(backend.list("resourcereservations")):
+        app.rr_cache.delete(rr.namespace, rr.name)
+    for d in list(backend.list("demands")):
+        app.demand_cache.delete(d.namespace, d.name)
+    for pod in list(backend.list_pods()):
+        backend.delete_pod(pod)
+
+
+def bench_serving_http_concurrent(rng):
+    """The VERDICT r2 #1 metric: CONCURRENT clients against /predicates.
+    The PredicateBatcher coalesces whatever arrives while the previous
+    window solves into one pack_window device program; the pipelined
+    dispatch-before-fetch loop overlaps window solves with decision pulls.
+    Load: colocated client threads with prebuilt bodies (_threaded_phase —
+    measured cheaper than any process-based generator on this 2-core box).
+    k repeats from a reset cluster give ≥50 measured windows and a
+    run-to-run variance band (VERDICT r3 #7)."""
     backend, app, server, node_names = _serving_fixture()
-    # Capacity margin: every app reserves 9 CPU / 9 Gi on an 8x500 = 4000
-    # CPU cluster. warm (5x32) + run (6x32) = 352 gangs = 3168 CPU (79%),
-    # leaving room for the strict-FIFO hypothetical prefix (each request
-    # re-packs ALL its pending earlier drivers, double-counting
-    # admitted-but-unbound ones — reference semantics, resource.go:221-258);
-    # at 8 run rounds the tail of the run brushed 94% and could correctly
-    # reject with failure-earlier-driver.
-    n_clients, per_client, warmup_rounds = 32, 6, 5
-    lat_lock = threading.Lock()
-
-    def run_phase(phase, rounds):
-        lats = []
-        errs = []
-
-        def client(ci):
-            try:
-                conn = http.client.HTTPConnection(
-                    "127.0.0.1", server.port, timeout=600
-                )
-                for r in range(rounds):
-                    driver = static_allocation_spark_pods(
-                        f"cb-{phase}-{ci}-{r}", 8
-                    )[0]
-                    backend.add_pod(driver)
-                    resp, dt_ms = _post_predicate(conn, driver, node_names)
-                    if not resp.get("NodeNames"):
-                        raise RuntimeError(f"{phase}-{ci}-{r} failed: {resp}")
-                    backend.bind_pod(driver, resp["NodeNames"][0])
-                    with lat_lock:
-                        lats.append(dt_ms)
-                conn.close()
-            except Exception as exc:  # surfaced after join
-                errs.append(exc)
-
-        threads = [
-            threading.Thread(target=client, args=(ci,)) for ci in range(n_clients)
-        ]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall_s = time.perf_counter() - t0
-        if errs:
-            raise errs[0]
-        return lats, wall_s
+    # Capacity: every app reserves 9 CPU / 9 Gi on an 8x500 = 4000 CPU
+    # cluster; each repeat admits (2+6)x32 = 256 gangs = 2304 CPU (58%)
+    # and then RESETS, leaving strict-FIFO hypothetical-prefix headroom
+    # (each request re-packs all its pending earlier drivers —
+    # resource.go:221-258 semantics).
+    n_clients, per_client, warmup_rounds, repeats = 32, 6, 2, 3
 
     def precompile_window_buckets():
         """Force the XLA compiles for every pack_window row bucket the run
@@ -520,22 +561,34 @@ def bench_serving_http_concurrent(rng):
 
     from spark_scheduler_tpu.tracing import tracer
 
+    lats: list = []
+    repeat_dps: list = []
+    solve_spans: list = []
     try:
         precompile_window_buckets()
-        run_phase("warm", warmup_rounds)  # warm the serving path end to end
-        tracer().clear()  # measure only the run phase's solve spans
-        lats, wall_s = run_phase("run", per_client)
+        for rep in range(repeats):
+            if rep:
+                _reset_cluster_state(backend, app)
+            _threaded_phase(
+                server.port, backend,
+                _driver_rows(f"w{rep}", n_clients, warmup_rounds, node_names),
+            )
+            tracer().clear()  # only run-phase solve spans
+            rep_lats, rep_wall = _threaded_phase(
+                server.port, backend,
+                _driver_rows(f"r{rep}", n_clients, per_client, node_names),
+            )
+            lats.extend(rep_lats)
+            repeat_dps.append(n_clients * per_client / rep_wall)
+            solve_spans.extend(
+                s for s in tracer().finished_spans() if s["name"] == "solve"
+            )
     finally:
         stats = server.batcher.stats()
         dev_stats = dict(app.solver.device_state_stats)
-        # Server-side solve cost (dispatch + the one blocking fetch), from
-        # the tracing spans: what a LOCALLY-ATTACHED TPU deployment pays
-        # per window, without this rig's relay RTT.
-        solve_spans = [
-            s for s in tracer().finished_spans() if s["name"] == "solve"
-        ]
         server.stop()
-    total = n_clients * per_client
+    total = n_clients * per_client * repeats
+    wall_s = total / (sum(repeat_dps) / len(repeat_dps))
     p50 = float(np.percentile(lats, 50))
 
     # Transport floor evidence: one minimal device round trip (dispatch +
@@ -562,18 +615,24 @@ def bench_serving_http_concurrent(rng):
         "nodes": 500,
         "concurrent_clients": n_clients,
         "requests": total,
+        "repeats": repeats,
         "p50_ms": round(p50, 3),
         "p95_ms": round(float(np.percentile(lats, 95)), 3),
         "decisions_per_s_measured": round(total / wall_s, 1),
+        # Run-to-run variance band across the k reset repeats.
+        "decisions_per_s_by_repeat": [round(x, 1) for x in repeat_dps],
+        "decisions_per_s_min_max": [
+            round(min(repeat_dps), 1), round(max(repeat_dps), 1)
+        ],
         "mean_window": stats["mean_window"],
         "max_window_seen": stats["max_window_seen"],
         "device_state": dev_stats,
         "device_rtt_floor_ms": rtt_floor_ms,
-        # Per-WINDOW server-side solve span (relay RTT + device work + host
-        # GIL contention from the concurrent clients — an UPPER bound on
-        # what a locally-attached TPU deployment would pay per window).
+        # Per-WINDOW server-side solve span (dispatch + blocking decision
+        # pull actually awaited — ~0 when the pipeline hides the fetch).
         "window_solve_p50_ms": solve_p50_ms,
         "windows_measured": len(solve_spans),
+        "load_generator": "colocated threads, prebuilt bodies (see _threaded_phase)",
         "path": "concurrent HTTP /predicates -> windowed pack_window solve",
         "r02": "unbatched serving: 8.4 decisions/s, p50 119.7 ms",
     }
@@ -602,12 +661,13 @@ def bench_serving_http_executors(rng):
     solve in the common case. Concurrent executor requests ride the same
     predicate batcher; this measures the served executor path end to end."""
     import http.client
-    import threading
 
     from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
 
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+
     backend, app, server, node_names = _serving_fixture()
-    n_apps, execs_per_app = 8, 16
+    n_apps, execs_per_app, n_workers = 8, 16, 16
     exec_pods = []
     conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=600)
     for i in range(n_apps):
@@ -620,37 +680,21 @@ def bench_serving_http_executors(rng):
         exec_pods.extend(pods[1:])
     conn.close()
 
-    lats = []
-    lat_lock = threading.Lock()
-    errors = []
-    n_workers = 16
-    shards = [exec_pods[i::n_workers] for i in range(n_workers)]
-
-    def worker(shard):
-        try:
-            c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=600)
-            for pod in shard:
-                backend.add_pod(pod)
-                resp, dt_ms = _post_predicate(c, pod, node_names)
-                if not resp.get("NodeNames"):
-                    raise RuntimeError(f"{pod.name}: {resp}")
-                backend.bind_pod(pod, resp["NodeNames"][0])
-                with lat_lock:
-                    lats.append(dt_ms)
-            c.close()
-        except Exception as exc:
-            errors.append(exc)
-
-    threads = [threading.Thread(target=worker, args=(s,)) for s in shards]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall_s = time.perf_counter() - t0
+    # Prebuilt bodies + thread-per-worker (see _threaded_phase).
+    sequences = [
+        [
+            (
+                p,
+                json.dumps(
+                    {"Pod": pod_to_k8s(p), "NodeNames": node_names}
+                ).encode(),
+            )
+            for p in exec_pods[i::n_workers]
+        ]
+        for i in range(n_workers)
+    ]
     try:
-        if errors:
-            raise errors[0]
+        lats, wall_s = _threaded_phase(server.port, backend, sequences)
     finally:
         server.stop()
     p50 = float(np.percentile(lats, 50))
@@ -663,6 +707,7 @@ def bench_serving_http_executors(rng):
             "executors": len(lats),
             "p95_ms": round(float(np.percentile(lats, 95)), 3),
             "bindings_per_s": round(len(lats) / wall_s, 1),
+            "load_generator": "colocated threads, prebuilt bodies (see _threaded_phase)",
             "path": "concurrent executor /predicates -> reservation ladder (host-side)",
         },
     )

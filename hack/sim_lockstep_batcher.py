@@ -1,0 +1,105 @@
+"""Simulate the lockstep client cohort against PredicateBatcher with a stub
+extender whose 'fetch' resolves after a configurable RTT — reproduces the
+TPU serving dynamics (window coalescing, hold behavior) without the TPU.
+
+Run: python hack/sim_lockstep_batcher.py [--clients 32] [--rtt-ms 100]
+"""
+
+import argparse
+import threading
+import time
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import sys
+
+sys.path.insert(0, ".")
+
+from spark_scheduler_tpu.server.http import PredicateBatcher  # noqa: E402
+
+
+class StubTicket:
+    def __init__(self, n, handle):
+        self.n = n
+        self.handle = handle
+        self.sync = False
+        self.done = False
+
+
+class StubExtender:
+    """Mimics the real extender's timing: host work at dispatch/complete,
+    a device fetch that lands RTT ms after dispatch."""
+
+    def __init__(self, rtt_s, host_dispatch_s, host_complete_per_req_s):
+        self.rtt_s = rtt_s
+        self.host_dispatch_s = host_dispatch_s
+        self.host_complete_s = host_complete_per_req_s
+        self.windows = []
+
+    def predicate_window_dispatch(self, args_list):
+        time.sleep(self.host_dispatch_s + 0.0005 * len(args_list))
+        fut = Future()
+        fut.set_running_or_notify_cancel()
+        timer = threading.Timer(self.rtt_s, fut.set_result, args=(None,))
+        timer.daemon = True
+        timer.start()
+        return StubTicket(len(args_list), SimpleNamespace(blob_future=fut))
+
+    def predicate_window_complete(self, t):
+        t.handle.blob_future.result()
+        time.sleep(self.host_complete_s * t.n)
+        self.windows.append(t.n)
+        return ["ok"] * t.n
+
+
+def run(n_clients, rounds, rtt_ms, label, **batcher_kw):
+    ext = StubExtender(rtt_ms / 1e3, 0.010, 0.0015)
+    b = PredicateBatcher(ext, **batcher_kw)
+    lats = []
+    lock = threading.Lock()
+
+    def client(ci):
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            b.submit(("req", ci, r))
+            with lock:
+                lats.append((time.perf_counter() - t0) * 1e3)
+            time.sleep(0.001)  # client-side think time (json, bind)
+
+    threads = [
+        threading.Thread(target=client, args=(ci,)) for ci in range(n_clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    b.stop()
+    lats.sort()
+    total = n_clients * rounds
+    print(
+        f"{label}: {total/wall:.0f} req/s, "
+        f"p50 {lats[len(lats)//2]:.0f} ms, p95 {lats[int(len(lats)*0.95)]:.0f} ms, "
+        f"mean_window {sum(ext.windows)/len(ext.windows):.1f}, "
+        f"windows {len(ext.windows)}"
+    )
+    return total / wall
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--rtt-ms", type=float, default=100.0)
+    args = ap.parse_args()
+    run(
+        args.clients, args.rounds, args.rtt_ms,
+        f"{args.clients} clients lockstep",
+        max_window=32, hold_ms=25.0, pipeline_depth=3,
+    )
+    run(
+        16, args.rounds, args.rtt_ms,
+        "16 clients after (fresh batcher)",
+        max_window=32, hold_ms=25.0, pipeline_depth=3,
+    )

@@ -1,0 +1,121 @@
+"""Focused TPU serving experiment: the bench's concurrent phase with the
+batcher claim log dumped afterwards — shows exactly how windows formed.
+
+Run (TPU): python hack/tpu_serving_probe.py [--clients 32] [--rounds 5]
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--warm-rounds", type=int, default=3)
+    args = ap.parse_args()
+
+    import bench
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+    from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
+
+    backend, app, server, node_names = bench._serving_fixture()
+    lat_lock = threading.Lock()
+
+    def run_phase(phase, rounds):
+        lats, errs = [], []
+        prebuilt = []
+        for ci in range(args.clients):
+            rows = []
+            for r in range(rounds):
+                driver = static_allocation_spark_pods(
+                    f"pr-{phase}-{ci}-{r}", 8
+                )[0]
+                body = json.dumps(
+                    {"Pod": pod_to_k8s(driver), "NodeNames": node_names}
+                ).encode()
+                rows.append((driver, body))
+            prebuilt.append(rows)
+
+        def client(ci):
+            try:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", server.port, timeout=600
+                )
+                for r, (driver, body) in enumerate(prebuilt[ci]):
+                    backend.add_pod(driver)
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/predicates", body=body)
+                    resp = json.loads(conn.getresponse().read())
+                    dt = (time.perf_counter() - t0) * 1e3
+                    if not resp.get("NodeNames"):
+                        raise RuntimeError(f"{phase}-{ci}-{r}: {resp}")
+                    backend.bind_pod(driver, resp["NodeNames"][0])
+                    with lat_lock:
+                        lats.append(dt)
+                conn.close()
+            except Exception as exc:
+                errs.append(exc)
+
+        threads = [
+            threading.Thread(target=client, args=(ci,))
+            for ci in range(args.clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        return lats, wall
+
+    # Precompile + warm exactly like the bench.
+    from spark_scheduler_tpu.core.solver import WindowRequest
+    from spark_scheduler_tpu.models.resources import Resources
+
+    solver = app.solver
+    tensors = solver.build_tensors_cached(backend.list_nodes(), {}, {})
+    one = Resources.from_quantities("1", "1Gi")
+    for rows_total in (32, 64, 128, 256, 512, 1024, 2048):
+        per_req = max(1, rows_total // args.clients)
+        reqs = [
+            WindowRequest(
+                rows=[(one, one, 8, False)] * per_req,
+                driver_candidate_names=node_names,
+            )
+            for _ in range(min(args.clients, rows_total))
+        ]
+        solver.pack_window("tightly-pack", tensors, reqs)
+
+    run_phase("warm", args.warm_rounds)
+    server.batcher.claim_log.clear()
+    n_before = server.batcher.windows_served
+    lats, wall = run_phase("run", args.rounds)
+    total = args.clients * args.rounds
+    log = list(server.batcher.claim_log)
+    stats = server.batcher.stats()
+    server.stop()
+    lats.sort()
+    print(
+        f"\n== {total} reqs, {args.clients} clients: "
+        f"{total/wall:.1f} decisions/s, p50 {lats[len(lats)//2]:.0f} ms, "
+        f"p95 {lats[int(len(lats)*.95)]:.0f} ms, "
+        f"windows {stats['windows_served']-n_before}"
+    )
+    print("claim log (window, queue_after, pending, target, hold_ms):")
+    for row in log:
+        print("  ", row)
+
+
+if __name__ == "__main__":
+    main()

@@ -245,7 +245,17 @@ class SparkSchedulerExtender:
         fetch). May raise solver.PipelineDrainRequired — the caller must
         complete the pending window and retry."""
         t = WindowTicket(args_list)
-        if len(args_list) == 1:
+        if len(args_list) == 1 and (
+            args_list[0].pod.labels.get(SPARK_ROLE_LABEL, "") != ROLE_DRIVER
+            or not self._config.batched_admission
+            or not self._solver.can_batch(self.binpacker.name)
+        ):
+            # Lone NON-driver request: the solo ladder (host-only, no device
+            # solve to overlap). A lone DRIVER stays on the window path
+            # below: the solo driver path would bump the capacity epoch
+            # (forcing every in-flight window to re-solve) and its ticket
+            # would drain the pipeline — one straggler client could
+            # serialize the whole serving loop.
             t.sync = True
             return t
         t.timer_start = self._clock()
@@ -263,7 +273,7 @@ class SparkSchedulerExtender:
         t.roles = [a.pod.labels.get(SPARK_ROLE_LABEL, "") for a in args_list]
         driver_ids = [i for i, r in enumerate(t.roles) if r == ROLE_DRIVER]
         if (
-            len(driver_ids) > 1
+            driver_ids
             and self._config.batched_admission
             and self._solver.can_batch(self.binpacker.name)
         ):
@@ -309,7 +319,11 @@ class SparkSchedulerExtender:
             t.handle = None
             t.inflight_keys = []
             t.domains = {}
-            if len(redo_ids) > 1:
+            if redo_ids:
+                # Even a SINGLE invalidated driver redoes on the window
+                # path: the solo ladder would bump the epoch again on
+                # success, cascading re-solves through every other
+                # in-flight window.
                 self._dispatch_driver_window(t, redo_ids)
         # One write-back drain for the whole window instead of one per
         # mutation: every result below is only released to its client after
@@ -488,6 +502,8 @@ class SparkSchedulerExtender:
     def _complete_driver_window(self, t: WindowTicket) -> None:
         """Fetch the dispatched window's decisions and apply them:
         reservations, demand lifecycle, events, metrics."""
+        from spark_scheduler_tpu.tracing import tracer
+
         try:
             decisions = self._solver.pack_window_fetch(t.handle)
         finally:
@@ -496,52 +512,69 @@ class SparkSchedulerExtender:
         all_nodes, by_name, domains = t.all_nodes, t.by_name, t.domains
         for k, (i, pod, res, args) in enumerate(window):
             d = decisions[k]
-            if not d.admitted:
-                self._demands.create_demand_for_application(pod, res)
-                if d.earlier_blocked:
-                    outcome, msg = (
-                        FAILURE_EARLIER_DRIVER,
-                        "earlier drivers do not fit to the cluster",
+            # Per-request trace span over the decision apply, same
+            # name/tags as the solo path's — dashboards keyed on
+            # select-node cover windowed serving too.
+            with tracer().span(
+                "select-node", role=ROLE_DRIVER,
+                pod=f"{pod.namespace}/{pod.name}",
+            ) as sp:
+                if not d.admitted:
+                    self._demands.create_demand_for_application(pod, res)
+                    if d.earlier_blocked:
+                        outcome, msg = (
+                            FAILURE_EARLIER_DRIVER,
+                            "earlier drivers do not fit to the cluster",
+                        )
+                    else:
+                        outcome, msg = (
+                            FAILURE_FIT,
+                            "application does not fit to the cluster",
+                        )
+                    sp.tag("outcome", outcome)
+                    self._mark_outcome(pod, ROLE_DRIVER, outcome, timer_start)
+                    results[i] = self._fail(args, outcome, msg)
+                    continue
+                packing = d.packing
+                if self._metrics is not None:
+                    self._metrics.report_packing_efficiency(
+                        self.binpacker.name, packing
                     )
-                else:
-                    outcome, msg = (
-                        FAILURE_FIT,
-                        "application does not fit to the cluster",
+                    self._metrics.report_cross_zone(
+                        packing.driver_node,
+                        packing.executor_nodes,
+                        all_nodes
+                        if domains[i] is None
+                        else [by_name[nm] for nm in domains[i]],
                     )
-                self._mark_outcome(pod, ROLE_DRIVER, outcome, timer_start)
-                results[i] = self._fail(args, outcome, msg)
-                continue
-            packing = d.packing
-            if self._metrics is not None:
-                self._metrics.report_packing_efficiency(self.binpacker.name, packing)
-                self._metrics.report_cross_zone(
-                    packing.driver_node,
-                    packing.executor_nodes,
-                    all_nodes
-                    if domains[i] is None
-                    else [by_name[nm] for nm in domains[i]],
+                self._demands.delete_demand_if_exists(pod)
+                try:
+                    self._rrm.create_reservations(
+                        pod, res, packing.driver_node, packing.executor_nodes
+                    )
+                except ReservationError as exc:
+                    # No rollback of the window's committed base: later
+                    # window decisions stand even though this app holds
+                    # nothing. That is the reference's own durability
+                    # stance — reservation writes are fire-and-forget and
+                    # "some writes will be lost on leader change"
+                    # (failover.go:35-41); the failed app retries, and
+                    # failover reconciliation repairs drift.
+                    sp.tag("outcome", FAILURE_INTERNAL)
+                    self._mark_outcome(
+                        pod, ROLE_DRIVER, FAILURE_INTERNAL, timer_start
+                    )
+                    results[i] = self._fail(args, FAILURE_INTERNAL, str(exc))
+                    continue
+                if self._events is not None:
+                    self._events.emit_application_scheduled(pod, res)
+                sp.tag("outcome", SUCCESS)
+                self._mark_outcome(pod, ROLE_DRIVER, SUCCESS, timer_start)
+                results[i] = ExtenderFilterResult(
+                    node_names=[packing.driver_node],
+                    failed_nodes={},
+                    outcome=SUCCESS,
                 )
-            self._demands.delete_demand_if_exists(pod)
-            try:
-                self._rrm.create_reservations(
-                    pod, res, packing.driver_node, packing.executor_nodes
-                )
-            except ReservationError as exc:
-                # No rollback of the window's committed base: later window
-                # decisions stand even though this app holds nothing. That
-                # is the reference's own durability stance — reservation
-                # writes are fire-and-forget and "some writes will be lost
-                # on leader change" (failover.go:35-41); the failed app
-                # retries, and failover reconciliation repairs drift.
-                self._mark_outcome(pod, ROLE_DRIVER, FAILURE_INTERNAL, timer_start)
-                results[i] = self._fail(args, FAILURE_INTERNAL, str(exc))
-                continue
-            if self._events is not None:
-                self._events.emit_application_scheduled(pod, res)
-            self._mark_outcome(pod, ROLE_DRIVER, SUCCESS, timer_start)
-            results[i] = ExtenderFilterResult(
-                node_names=[packing.driver_node], failed_nodes={}, outcome=SUCCESS
-            )
 
     def _build_serving_tensors(self, all_nodes, usage, overhead):
         """Device tensors for the SOLO serving paths, shared with the

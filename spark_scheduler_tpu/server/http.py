@@ -92,6 +92,11 @@ class PredicateBatcher:
         self.windows_served = 0
         self.requests_served = 0
         self.max_window_seen = 0
+        # Debug log of claim decisions:
+        # (window, queue_after, pending, hold_ms). Cheap appends; recording
+        # stops at the 4096-entry bound; stats() exposes the tail for
+        # serving-dynamics forensics.
+        self.claim_log: list[tuple] = []
         # Windows dispatched while another window was still in flight (the
         # dispatch-before-fetch overlap actually engaging).
         self.pipelined_windows = 0
@@ -206,31 +211,25 @@ class PredicateBatcher:
                 ):
                     # Accumulation hold, only when nothing is in flight — a
                     # pending window's fetch IS the accumulation period
-                    # otherwise. Stop holding once the queue reaches the
-                    # previous window size (the natural concurrency level)
-                    # OR stops growing for two consecutive slices: when the
-                    # live cohort is smaller than the previous window (e.g.
-                    # a 16-client phase after a 32-client one), everyone has
-                    # submitted within a couple ms and the rest of the hold
-                    # would be pure added latency.
+                    # otherwise: requests arriving during it dispatch as
+                    # the next window and their solve overlaps the fetch
+                    # (measured: under a GIL-bound lockstep cohort this
+                    # staggered-subgroup pipelining beats holding for the
+                    # full cohort, whose resubmission takes tens of ms —
+                    # holds serialize RTTs that the overlap hides).
+                    hold_t0 = _time.monotonic()
                     target = min(self._last_window, self._max_window)
-                    deadline = _time.monotonic() + self._hold_s
-                    prev_len, stalls = -1, 0
+                    deadline = hold_t0 + self._hold_s
                     while (
                         len(self._queue) < target and not self._stopped
                     ):
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
                             break
-                        qlen = len(self._queue)
-                        if qlen == prev_len and qlen > 0:
-                            stalls += 1
-                            if stalls >= 2:
-                                break
-                        else:
-                            stalls = 0
-                        prev_len = qlen
-                        self._cv.wait(min(remaining, 0.002))
+                        self._cv.wait(remaining)
+                    hold_ms = (_time.monotonic() - hold_t0) * 1e3
+                else:
+                    hold_ms = 0.0
                 if self._stopped:
                     err = RuntimeError("scheduler is shutting down")
                     for _, entries in pending:
@@ -245,6 +244,11 @@ class PredicateBatcher:
                     return
                 batch = self._queue[: self._max_window]
                 del self._queue[: self._max_window]
+                if len(self.claim_log) < 4096:
+                    self.claim_log.append((
+                        len(batch), len(self._queue), len(pending),
+                        round(hold_ms, 1),
+                    ))
                 self._claimed = [
                     e for e in self._claimed if not e[1].is_set()
                 ]
@@ -314,7 +318,7 @@ class PredicateBatcher:
                             and pending
                             and not head_ready()
                         ):
-                            self._cv.wait(0.05)
+                            self._cv.wait(0.005)
 
     def _notify(self) -> None:
         with self._cv:
@@ -385,6 +389,8 @@ class PredicateBatcher:
                 if self.windows_served
                 else 0.0
             ),
+            # (window, queue_after, pending, hold_ms) for recent claims.
+            "claim_log_tail": self.claim_log[-32:],
         }
 
 
@@ -392,10 +398,26 @@ class _JSONHandler(BaseHTTPRequestHandler):
     """Shared JSON plumbing + the routes both servers serve
     (liveness, POST /convert)."""
 
+    # Keep-alive: without this the stdlib default (HTTP/1.0) closes the
+    # connection after EVERY response, so each request pays TCP connect +
+    # a fresh handler thread — measured ~6 ms/call on loopback, dwarfing
+    # the actual handler work. Every _write sets Content-Length, which
+    # HTTP/1.1 persistent connections require.
+    protocol_version = "HTTP/1.1"
+
     def log_message(self, *args):  # quiet
         pass
 
     def _write(self, code: int, payload) -> None:
+        # Keep-alive discipline: a handler that answers without reading the
+        # request body (404s, gated debug routes) would leave those bytes
+        # in rfile and desync the NEXT request on this persistent
+        # connection — drain them first.
+        if not getattr(self, "_body_consumed", False):
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                self.rfile.read(length)
+            self._body_consumed = True
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -403,8 +425,13 @@ class _JSONHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def handle_one_request(self):
+        self._body_consumed = False  # per-request, before any handler runs
+        super().handle_one_request()
+
     def _body(self):
         length = int(self.headers.get("Content-Length") or 0)
+        self._body_consumed = True
         return json.loads(self.rfile.read(length) or b"{}")
 
     def _handle_liveness(self) -> None:

@@ -42,7 +42,7 @@ class WriteThroughCache:
         self._store = ObjectStore()
         self._queue = make_sharded_queue(num_clients)
         self._sync = sync_writes
-        self._defer_drains = 0  # see deferred_sync()
+        self._defer_threads: dict[int, int] = {}  # see deferred_sync()
         # Mutation listeners: fn(old, new) fired synchronously after every
         # local-store mutation (create: old=None; delete: new=None). This is
         # the delta feed for incremental aggregates (ReservedUsageTracker).
@@ -102,26 +102,33 @@ class WriteThroughCache:
 
     @contextlib.contextmanager
     def deferred_sync(self):
-        """Batch sync-mode write-back: inside the context per-mutation
-        drains are suppressed; ONE drain runs at exit. A serving window
-        applies dozens of mutations back to back — per-write queue drains
-        (num_buckets pops each) were measurable host time, and deferring
-        them changes nothing observable: reads go through the local store
-        (write-through), and the drain still completes before the window's
-        responses are released. No-op in async mode. Reentrant."""
+        """Batch sync-mode write-back FOR THE CALLING THREAD: inside the
+        context its per-mutation drains are suppressed; ONE drain runs at
+        exit. A serving window applies dozens of mutations back to back —
+        per-write queue drains (num_buckets pops each) were measurable
+        host time, and deferring them changes nothing observable for this
+        thread: reads go through the local store (write-through), and the
+        drain still completes before the window's responses are released.
+        Scoped per thread so a CONCURRENT writer (watch handlers, GC
+        subscribers) keeps the full sync-mode drain-on-write guarantee.
+        No-op in async mode. Reentrant."""
         if not self._sync:
             yield
             return
-        self._defer_drains += 1
+        tid = threading.get_ident()
+        self._defer_threads[tid] = self._defer_threads.get(tid, 0) + 1
         try:
             yield
         finally:
-            self._defer_drains -= 1
-            if self._defer_drains == 0:
+            n = self._defer_threads[tid] - 1
+            if n:
+                self._defer_threads[tid] = n
+            else:
+                del self._defer_threads[tid]
                 self.client.drain_sync()
 
     def _after_write(self) -> None:
-        if self._sync and not self._defer_drains:
+        if self._sync and threading.get_ident() not in self._defer_threads:
             self.client.drain_sync()
 
     def create(self, obj: Any) -> bool:

@@ -138,8 +138,12 @@ class TestServingTrace:
             # one joined trace, continuing the caller's id
             assert {s["traceId"] for s in spans} == {trace_id}
             assert by_name["predicate"]["parentId"] == "ab" * 8
+            # A lone driver rides the WINDOW path: select-node (the
+            # decision apply) and solve (the decision pull) are siblings
+            # under the request's predicate span.
             assert by_name["select-node"]["parentId"] == by_name["predicate"]["id"]
-            assert by_name["solve"]["parentId"] == by_name["select-node"]["id"]
+            assert by_name["solve"]["parentId"] == by_name["predicate"]["id"]
+            assert by_name["select-node"]["tags"]["outcome"] == "success"
             assert by_name["predicate"]["tags"]["outcome"] == "success"
             assert by_name["solve"]["tags"]["batched"] is True
             # write-back ran under the trace too (sync_writes drains inline)
