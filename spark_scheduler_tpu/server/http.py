@@ -75,6 +75,11 @@ class PredicateBatcher:
         # request on an idle server is never held.
         self._hold_s = hold_ms / 1e3
         self._last_window = 1
+        # Whether the previous window dispatched a DEVICE solve. The hold
+        # exists to amortize one device program over more requests; an
+        # executor-only window is pure host work and holding for
+        # stragglers just adds their wait to everyone's latency.
+        self._last_had_solve = False
         # The hold engages only while a busy period is LIVE: within this
         # TTL of the previous coalesced window. A lone request on a
         # since-idle server is served immediately.
@@ -208,6 +213,7 @@ class PredicateBatcher:
                     and not pending
                     and self._hold_s > 0
                     and busy
+                    and self._last_had_solve
                 ):
                     # Accumulation hold, only when nothing is in flight — a
                     # pending window's fetch IS the accumulation period
@@ -217,6 +223,12 @@ class PredicateBatcher:
                     # staggered-subgroup pipelining beats holding for the
                     # full cohort, whose resubmission takes tens of ms —
                     # holds serialize RTTs that the overlap hides).
+                    # Deliberately NO stopped-growing early exit: arrival
+                    # gaps of several ms mid-resubmission made it claim
+                    # straggler subgroups that then ratcheted the window
+                    # size down. Cost: after a cohort SHRINKS, the first
+                    # window waits the full hold once; the target then
+                    # adapts to the new cohort size.
                     hold_t0 = _time.monotonic()
                     target = min(self._last_window, self._max_window)
                     deadline = hold_t0 + self._hold_s
@@ -244,7 +256,7 @@ class PredicateBatcher:
                     return
                 batch = self._queue[: self._max_window]
                 del self._queue[: self._max_window]
-                if len(self.claim_log) < 4096:
+                if batch and len(self.claim_log) < 4096:
                     self.claim_log.append((
                         len(batch), len(self._queue), len(pending),
                         round(hold_ms, 1),
@@ -274,6 +286,7 @@ class PredicateBatcher:
                 except Exception as exc:
                     self._fail_batch(batch, exc)
             if new_ticket is not None:
+                self._last_had_solve = new_ticket.handle is not None
                 if new_ticket.handle is None:
                     # No dispatched device solve (lone request -> solo path,
                     # or a batch that didn't window): its serve must observe
