@@ -42,6 +42,43 @@ from spark_scheduler_tpu.ops.efficiency import avg_packing_efficiency_np
 # BINPACK_FUNCTIONS must also be taught to the batched scan.
 BATCHABLE_STRATEGIES = frozenset(BINPACK_FUNCTIONS)
 
+def _build_segmented_window(
+    requests, drv_arr, exc_arr, counts, skip_arr, cand_per_req, dom_per_req
+):
+    """Segment-major [S, R] arrays for the Pallas window path
+    (ops/pallas_window.make_segmented_window), with S and R BUCKETED
+    coarsely: every (s_pad, r_pad) pair is a separate scan-over-segments
+    compile, and padding segments are skipped at runtime (lax.cond on
+    row_count) so coarse S padding costs no device time. Returns
+    (SegmentedWindow, seg_idx, row_idx) — host numpy index arrays mapping
+    each flat row to its [S, R] position (used by pack_window_fetch to
+    flatten the fetched blob)."""
+    from spark_scheduler_tpu.ops.pallas_window import make_segmented_window
+
+    s = len(requests)
+    rc = np.asarray([len(req.rows) for req in requests], np.int32)
+    s_pad = 4
+    while s_pad < s:
+        s_pad *= 8
+    r_pad = 16
+    while r_pad < int(rc.max()):
+        r_pad *= 4
+    offsets = np.concatenate([[0], np.cumsum(rc)])
+    rows_per_req = [
+        [
+            (drv_arr[k], exc_arr[k], int(counts[k]), bool(skip_arr[k]))
+            for k in range(offsets[i], offsets[i + 1])
+        ]
+        for i in range(s)
+    ]
+    win = make_segmented_window(
+        rows_per_req, cand_per_req, dom_per_req,
+        pad_segments=s_pad, pad_rows=r_pad,
+    )
+    seg_idx = np.repeat(np.arange(s, dtype=np.int64), rc)
+    row_idx = np.concatenate([np.arange(k, dtype=np.int64) for k in rc])
+    return win, seg_idx, row_idx
+
 
 def _bucket(n: int, minimum: int) -> int:
     out = minimum
@@ -175,6 +212,23 @@ def _window_blob(cluster, apps, *, fill, emax, num_zones):
 
 
 @_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
+def _window_blob_pallas(cluster, win, *, fill, emax, num_zones):
+    """Segmented-window solve on the Pallas path (ops/pallas_window). The
+    blob stays [S, R, 3+emax] — pack_window_fetch flattens the real rows
+    host-side via the handle's seg_map, so the device program's shape
+    depends ONLY on the (segments, rows) buckets, never on the window's
+    flat row count (a third shape dimension would cross-multiply the
+    compile cache)."""
+    from spark_scheduler_tpu.ops.pallas_window import window_pack_pallas
+
+    meta, execs, base_after = window_pack_pallas(
+        cluster, win, fill=fill, emax=emax, num_zones=num_zones
+    )
+    blob = jnp.concatenate([meta[:, :, :3], execs], axis=2)
+    return blob, base_after
+
+
+@_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
 def _pack_blob(cluster, dreq, ereq, count, dmask, dom, *, fill, emax, num_zones):
     """Single-app pack with the Packing flattened to one int32 [2+Emax]
     array: (driver, has_capacity, exec slots...) — one device fetch."""
@@ -237,13 +291,16 @@ class WindowHandle:
     __slots__ = (
         "strategy", "blob", "blob_future", "requests", "flat_rows",
         "host_avail", "host_schedulable", "priors", "placements", "n",
-        "row_driver_req", "row_exec_req", "row_skippable",
+        "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
                  host_schedulable, priors, n):
         self.strategy = strategy
-        self.blob = blob  # device [B, 3+emax] int32 — not yet transferred
+        # Device blob, not yet transferred: flat [B, 3+emax] int32 on the
+        # XLA path; [S, R, 3+emax] on the Pallas window path (seg_map set
+        # — pack_window_fetch flattens the real rows after the pull).
+        self.blob = blob
         # Device->host transfer started EAGERLY on a side thread at dispatch
         # (pipelined path): the ~RTT-bound pull elapses concurrently with
         # the dispatcher's host work instead of serializing after it.
@@ -261,6 +318,7 @@ class WindowHandle:
         self.row_driver_req = None  # int64 [B,3], set after dispatch
         self.row_exec_req = None
         self.row_skippable = None
+        self.seg_map = None  # pallas window path: (seg_idx, row_idx)
 
 
 class PlacementSolver:
@@ -306,6 +364,8 @@ class PlacementSolver:
             "delta_rows": 0,
             "reuse_hits": 0,
         }
+        # Which device path served each dispatched window (pallas | xla).
+        self.window_path_counts: dict[str, int] = {}
 
     @property
     def uses_native_arena(self) -> bool:
@@ -739,6 +799,8 @@ class PlacementSolver:
         reset: list[bool] = []
         cand_rows: list[np.ndarray] = []
         dom_rows: list[np.ndarray] = []
+        cand_per_req: list[np.ndarray] = []
+        dom_per_req: list[np.ndarray] = []
         for req in requests:
             cand = self.candidate_mask(tensors, req.driver_candidate_names)
             if req.domain_mask is not None:
@@ -747,6 +809,8 @@ class PlacementSolver:
                 dom = self.candidate_mask(tensors, req.domain_node_names) & valid_np
             else:
                 dom = valid_np
+            cand_per_req.append(cand)
+            dom_per_req.append(dom)
             for j, row in enumerate(req.rows):
                 flat_rows.append(row)
                 commit.append(j == len(req.rows) - 1)
@@ -772,30 +836,57 @@ class PlacementSolver:
         counts = np.asarray([r[2] for r in flat_rows], np.int32)
         skip_arr = np.asarray([bool(r[3]) for r in flat_rows])
         emax = _bucket(max(int(counts.max()), 1), 8)
-        apps = make_app_batch(
-            drv_arr,
-            exc_arr,
-            counts,
-            skippable=skip_arr,
-            # Coarse row bucket (32): window row counts jitter with load and
-            # FIFO depth; each distinct bucket is a fresh XLA compile, which
-            # on a remote TPU stalls live serving for seconds.
-            pad_to=_bucket(b, 32),
-            driver_cand=np.stack(cand_rows),
-            domain=np.stack(dom_rows),
-            commit=commit,
-            reset=reset,
-        )
         from spark_scheduler_tpu.tracing import tracer
 
+        # Route the segmented window to the Pallas path when the backend
+        # compiles Mosaic and the strategy is a plain fill (ops/
+        # pallas_window): XLA sorts per segment, Mosaic walks the rows with
+        # availability in VMEM. Decisions identical (parity-suite pinned).
+        seg_map = None
+        from spark_scheduler_tpu.ops.pallas_window import (
+            window_pallas_eligible,
+        )
+
+        use_pallas = window_pallas_eligible(strategy)
+        path = "pallas" if use_pallas else "xla"
+        self.window_path_counts[path] = (
+            self.window_path_counts.get(path, 0) + 1
+        )
         with tracer().span(
             "solve-dispatch", strategy=strategy, nodes=n,
             window_requests=len(requests), window_rows=b, batched=True,
+            path=path,
         ):
-            blob, avail_after = _window_blob(
-                tensors, apps, fill=strategy, emax=emax,
-                num_zones=self._num_zones_bucket(),
-            )
+            if use_pallas:
+                win, seg_idx, row_idx = _build_segmented_window(
+                    requests, drv_arr, exc_arr, counts, skip_arr,
+                    cand_per_req, dom_per_req,
+                )
+                seg_map = (seg_idx, row_idx)
+                blob, avail_after = _window_blob_pallas(
+                    tensors, win, fill=strategy,
+                    emax=emax, num_zones=self._num_zones_bucket(),
+                )
+            else:
+                apps = make_app_batch(
+                    drv_arr,
+                    exc_arr,
+                    counts,
+                    skippable=skip_arr,
+                    # Coarse row bucket (32): window row counts jitter with
+                    # load and FIFO depth; each distinct bucket is a fresh
+                    # XLA compile, which on a remote TPU stalls live
+                    # serving for seconds.
+                    pad_to=_bucket(b, 32),
+                    driver_cand=np.stack(cand_rows),
+                    domain=np.stack(dom_rows),
+                    commit=commit,
+                    reset=reset,
+                )
+                blob, avail_after = _window_blob(
+                    tensors, apps, fill=strategy, emax=emax,
+                    num_zones=self._num_zones_bucket(),
+                )
 
         priors: tuple = ()
         p = self._pipe
@@ -818,6 +909,7 @@ class PlacementSolver:
         handle.row_driver_req = drv_arr.astype(np.int64)
         handle.row_exec_req = exc_arr.astype(np.int64)
         handle.row_skippable = skip_arr
+        handle.seg_map = seg_map  # pallas path: [S,R] blob -> flat rows
         if pipelined:
             p["unfetched"].append(handle)
             # Start the device->host pull NOW on the fetch thread: over a
@@ -858,6 +950,10 @@ class PlacementSolver:
                 # mirror debit of a dead pipeline.
                 self._pipe = None
                 raise
+        if handle.seg_map is not None:
+            # Pallas window path: the device blob is [S, R, 3+emax];
+            # flatten the real rows back into flat-row order host-side.
+            blob = np.asarray(blob)[handle.seg_map[0], handle.seg_map[1]]
         drivers = blob[:, 0]
         admitted = blob[:, 1].astype(bool)
         packed = blob[:, 2].astype(bool)

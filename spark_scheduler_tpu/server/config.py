@@ -90,6 +90,11 @@ class InstallConfig:
     # async retry budget reload live on file change or SIGHUP
     # (server/runtime.py). None = no runtime reloads.
     runtime_config_path: Optional[str] = None
+    # Persistent XLA compilation cache directory: window-shape buckets
+    # compile once per machine/image instead of once per process, so a
+    # restarted scheduler serves its first windows without multi-second
+    # compile stalls. None = per-process compiles.
+    jax_compilation_cache_dir: Optional[str] = None
 
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
@@ -156,6 +161,7 @@ class InstallConfig:
             predicate_max_window=int(raw.get("predicate-max-window", 32)),
             predicate_hold_ms=float(raw.get("predicate-hold-ms", 25.0)),
             runtime_config_path=raw.get("runtime-config-path"),
+            jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),
         )
 
 
