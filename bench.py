@@ -50,18 +50,11 @@ def _enable_compile_cache():
     cache in its image)."""
     import os
 
-    import jax
+    from spark_scheduler_tpu.server.config import InstallConfig
 
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
-            ),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:
-        pass  # older jax without the knobs: compiles stay per-process
+    InstallConfig.enable_jax_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    )
 
 
 def _make_cluster(rng, n_nodes, num_zones, *, cpu=(8, 96), mem=(16, 256), gpu=(0, 2)):

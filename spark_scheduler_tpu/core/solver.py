@@ -53,7 +53,9 @@ def _build_segmented_window(
     (SegmentedWindow, seg_idx, row_idx) — host numpy index arrays mapping
     each flat row to its [S, R] position (used by pack_window_fetch to
     flatten the fetched blob)."""
-    from spark_scheduler_tpu.ops.pallas_window import make_segmented_window
+    from spark_scheduler_tpu.ops.pallas_window import (
+        segmented_window_from_flat,
+    )
 
     s = len(requests)
     rc = np.asarray([len(req.rows) for req in requests], np.int32)
@@ -63,21 +65,10 @@ def _build_segmented_window(
     r_pad = 16
     while r_pad < int(rc.max()):
         r_pad *= 4
-    offsets = np.concatenate([[0], np.cumsum(rc)])
-    rows_per_req = [
-        [
-            (drv_arr[k], exc_arr[k], int(counts[k]), bool(skip_arr[k]))
-            for k in range(offsets[i], offsets[i + 1])
-        ]
-        for i in range(s)
-    ]
-    win = make_segmented_window(
-        rows_per_req, cand_per_req, dom_per_req,
+    return segmented_window_from_flat(
+        drv_arr, exc_arr, counts, skip_arr, rc, cand_per_req, dom_per_req,
         pad_segments=s_pad, pad_rows=r_pad,
     )
-    seg_idx = np.repeat(np.arange(s, dtype=np.int64), rc)
-    row_idx = np.concatenate([np.arange(k, dtype=np.int64) for k in rc])
-    return win, seg_idx, row_idx
 
 
 def _bucket(n: int, minimum: int) -> int:

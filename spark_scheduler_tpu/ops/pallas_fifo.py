@@ -39,9 +39,21 @@ Fill derivations (reference loops -> argmin keys):
       remainder on the smallest not-consumed node that fits it
       -> <= emax consume rounds of masked max + two masked-min reductions.
 
-Masked/segmented serving windows keep the XLA path (they re-sort per
-segment inside the scan, which wants XLA's fused sorts); this kernel is the
-queue-mode hot path: the north-star 10k-node x 1k-app batched admission.
+This kernel is the queue-mode hot path (the north-star 10k-node x 1k-app
+batched admission), covering all six strategies — the single-AZ wrappers
+run their per-zone fill + efficiency-scored zone pick in-kernel. Segmented
+serving windows run on their own Mosaic path (ops/pallas_window, sharing
+this module's fill/driver closures); per-app-masked batches keep the XLA
+scan.
+
+Documented deviation (single-AZ zone scoring): the zone efficiency is a
+float32 mean, and this kernel sums it as a weighted tile reduction while
+the XLA scan sums gathered per-entry values — different summation orders
+can differ in the last ulp, so a cross-zone tie closer than ~1 ulp may
+break differently between the two paths (same class of deviation as the
+module-documented Go-rounding difference in ops/efficiency.py; bit-exact
+float reductions across different programs are not guaranteeable). The
+parity suites use fixed seeds and are deterministic per jax build.
 """
 
 from __future__ import annotations
@@ -60,6 +72,16 @@ from spark_scheduler_tpu.ops.batched import (
 )
 
 PALLAS_FILLS = ("tightly-pack", "distribute-evenly", "minimal-fragmentation")
+
+# Single-AZ strategies the queue kernel serves (VERDICT r3 #4):
+# strategy -> (inner fill, az-aware plain fallback, executors counted in
+# the zone-efficiency reservation — the minimalFragmentation quirk,
+# ops/efficiency.py avg_packing_efficiency docstring).
+PALLAS_SINGLE_AZ = {
+    "single-az-tightly-pack": ("tightly-pack", False, True),
+    "single-az-minimal-fragmentation": ("minimal-fragmentation", False, False),
+    "az-aware-tightly-pack": ("tightly-pack", True, True),
+}
 
 _LANES = 128  # int32 lane width
 _SUBLANES = 8  # VPU sublanes
@@ -80,26 +102,196 @@ def _round_up(x: int, m: int) -> int:
 
 def pallas_eligible(apps: "AppBatch", fill: str) -> bool:
     """THE single definition of what the Pallas queue kernel supports:
-    plain queue mode (no per-app masks, no segmented windows) with one of
-    the three plain fills. Shared by every routing site so eligibility
-    cannot drift when the kernel learns new shapes."""
+    plain queue mode (no per-app masks, no segmented windows) with any of
+    the six strategies — the three plain fills, and since r4 the
+    single-AZ wrappers (per-zone fill + efficiency-scored zone pick
+    in-kernel). Shared by every routing site so eligibility cannot drift
+    when the kernel learns new shapes. (Segmented serving windows have
+    their own Mosaic path, ops/pallas_window.)"""
     return (
-        fill in PALLAS_FILLS
+        (fill in PALLAS_FILLS or fill in PALLAS_SINGLE_AZ)
         and apps.commit is None
         and apps.driver_cand is None
         and apps.domain is None
     )
 
 
-def _make_kernel(fill: str, emax: int, n_pad: int, n_apps: int, rows: int):
+def make_driver_selector(count, cap_e, cap_wd, fit_d, elig_d, drank):
+    """Shared driver-selection closure for the Mosaic kernels (queue AND
+    segmented-window paths — ONE implementation so the two cannot drift).
+    Picks the best-ranked feasible driver via the feasibility identity
+    (ops/packing.py pack_one_app): reserving the driver on node i only
+    changes node i's executor capacity."""
+    INF = INT32_INF
+
+    def select_driver(zone_mask):
+        cap_e_m = jnp.where(zone_mask, cap_e, 0)
+        cap_wd_m = jnp.where(zone_mask, cap_wd, 0)
+        cap_e_c = jnp.minimum(cap_e_m, count)
+        cap_wd_c = jnp.minimum(cap_wd_m, count)
+        total_base = jnp.sum(cap_e_c)
+        total_if = total_base - cap_e_c + cap_wd_c
+        feasible = elig_d & zone_mask & fit_d & (total_if >= count)
+        best_rank = jnp.min(jnp.where(feasible, drank, INF))
+        found = best_rank < INF
+        # drank is a permutation rank -> at most one position matches.
+        is_drv = feasible & (drank == best_rank)
+        # Executor capacities with the chosen driver reserved.
+        caps_fill = jnp.where(is_drv, cap_wd_m, cap_e_m)
+        return found, is_drv, caps_fill
+
+    return select_driver
+
+
+def make_fill_runner(
+    inner_fill, emax, n_pad, shape, count, key, node_val, slot_iota
+):
+    """Shared executor-fill closure for the Mosaic kernels: `emax` rounds
+    of masked-argmin placement, parameterized by the priority KEY tensor —
+    `iota` itself for the queue kernel (whose node axis is pre-permuted
+    into priority order) and the per-segment executor rank for the window
+    kernel. `key` must be a permutation over real positions padded with
+    INF; `node_val` holds the output node id per position. ONE
+    implementation serves both kernels so fill semantics cannot drift."""
+    INF = INT32_INF
+
+    def run_fill(ok, caps_fill, elig_mask):
+        execs_row = jnp.full((1, emax), -1, jnp.int32)
+        exec_counts = jnp.zeros(shape, jnp.int32)
+        if inner_fill == "tightly-pack":
+            remaining = caps_fill
+            for j in range(emax):
+                place = ok & (j < count)
+                k_sel = jnp.min(jnp.where(remaining > 0, key, INF))
+                hit = (key == k_sel) & (remaining > 0) & place
+                node_j = jnp.sum(jnp.where(hit, node_val, 0))
+                execs_row = jnp.where(
+                    (slot_iota == j) & place, node_j, execs_row
+                )
+                remaining = remaining - hit
+                exec_counts = exec_counts + hit
+        elif inner_fill == "distribute-evenly":
+            # dkey = placed * Npad + key over open positions; placed never
+            # exceeds emax and key < Npad at open positions, so the key
+            # stays far below int32 range.
+            for j in range(emax):
+                place = ok & (j < count)
+                open_ = elig_mask & (exec_counts < caps_fill)
+                dkey = exec_counts * n_pad + key
+                k_min = jnp.min(jnp.where(open_, dkey, INF))
+                hit = open_ & (dkey == k_min) & place
+                node_j = jnp.sum(jnp.where(hit, node_val, 0))
+                execs_row = jnp.where(
+                    (slot_iota == j) & place, node_j, execs_row
+                )
+                exec_counts = exec_counts + hit
+        elif inner_fill == "minimal-fragmentation":
+            cap_ok = caps_fill > 0
+            caps_c = jnp.minimum(caps_fill, count)
+            # Branch A: smallest single node fitting the whole gang
+            # (minimal_fragmentation.go:68-78): min capacity, then best
+            # priority (earliest key) on capacity ties.
+            mask_a = cap_ok & (caps_fill >= count)
+            exists_a = jnp.any(mask_a)
+            min_cap_a = jnp.min(jnp.where(mask_a, caps_fill, INF))
+            tie_a = mask_a & (caps_fill == min_cap_a)
+            rank_a = jnp.min(jnp.where(tie_a, key, INF))
+            sel_a = tie_a & (key == rank_a)
+            # Branch B: consume (clamped capacity desc, priority asc) while
+            # the running total stays <= count (the maximal prefix of the
+            # reference's desc sort), remainder on the smallest
+            # not-consumed node with UNCLAMPED capacity >= remainder
+            # (minimal_fragmentation.go:80-98).
+            use_b = ok & ~exists_a
+            consumed = jnp.zeros(shape, jnp.bool_)
+            placed_total = jnp.int32(0)
+            for _ in range(emax):
+                open_b = cap_ok & ~consumed
+                c_max = jnp.max(jnp.where(open_b, caps_c, -1))
+                tie_k = open_b & (caps_c == c_max)
+                rank_k = jnp.min(jnp.where(tie_k, key, INF))
+                take = use_b & (c_max > 0) & (placed_total + c_max <= count)
+                hit = tie_k & (key == rank_k) & take
+                node_k = jnp.sum(jnp.where(hit, node_val, 0))
+                in_span = (
+                    (slot_iota >= placed_total)
+                    & (slot_iota < placed_total + c_max)
+                    & take
+                )
+                execs_row = jnp.where(in_span, node_k, execs_row)
+                exec_counts = exec_counts + jnp.where(hit, c_max, 0)
+                consumed = consumed | hit
+                placed_total = placed_total + jnp.where(take, c_max, 0)
+            remainder = count - placed_total
+            mask_fin = cap_ok & ~consumed & (caps_fill >= remainder)
+            min_cap_f = jnp.min(jnp.where(mask_fin, caps_fill, INF))
+            tie_f = mask_fin & (caps_fill == min_cap_f)
+            rank_f = jnp.min(jnp.where(tie_f, key, INF))
+            sel_f = tie_f & (key == rank_f)
+            need_fin = use_b & (remainder > 0)
+            fin_take = ok & (exists_a | need_fin)
+            # Logical blend, not jnp.where: Mosaic cannot select between
+            # two i1 vectors.
+            fin_sel = (sel_a & exists_a) | (sel_f & ~exists_a)
+            fin_count = jnp.where(exists_a, count, remainder)
+            fin_hit = fin_sel & fin_take
+            node_fin = jnp.sum(jnp.where(fin_hit, node_val, 0))
+            fin_start = jnp.where(exists_a, 0, placed_total)
+            in_fin = (
+                (slot_iota >= fin_start)
+                & (slot_iota < fin_start + fin_count)
+                & fin_take
+            )
+            # Branch A overwrites any branch-B spans (it is exclusive).
+            execs_row = jnp.where(
+                exists_a & (slot_iota < count) & ok,
+                node_fin,
+                jnp.where(in_fin, node_fin, execs_row),
+            )
+            exec_counts = jnp.where(
+                exists_a & ok,
+                jnp.where(sel_a, count, 0),
+                exec_counts + jnp.where(fin_hit, fin_count, 0),
+            )
+        else:  # pragma: no cover — guarded by the kernel builders
+            raise ValueError(f"unsupported fill for pallas: {inner_fill}")
+        return execs_row, exec_counts
+
+    return run_fill
+
+
+def _make_kernel(
+    fill: str,
+    emax: int,
+    n_pad: int,
+    n_apps: int,
+    rows: int,
+    *,
+    num_zones: int = 0,
+):
     """Build the kernel body. Everything static (fill, emax, padding,
     layout) is closed over; per-app scalars arrive via prefetch refs.
 
     The position axis is laid out 2D row-major — position p lives at
-    [p // cols, p % cols] of a [rows, cols] tile (`_layout_rows`)."""
+    [p // cols, p % cols] of a [rows, cols] tile (`_layout_rows`).
+
+    `fill` may be a plain fill OR a PALLAS_SINGLE_AZ strategy: the
+    single-AZ path runs the inner fill once per zone (restricted to the
+    zone's positions), scores each feasible zone's average packing
+    efficiency against the live availability, and keeps the
+    strictly-greatest (ties to the zone appearing first in driver
+    priority order) — single_az.go:23-97 semantics, entirely in-kernel."""
 
     INF = INT32_INF
     cols = n_pad // rows
+    shape = (rows, cols)
+    single_az = fill in PALLAS_SINGLE_AZ
+    if single_az:
+        inner_fill, az_fallback, include_exec_in_reserved = (
+            PALLAS_SINGLE_AZ[fill]
+        )
+    else:
+        inner_fill, az_fallback, include_exec_in_reserved = fill, False, True
 
     def kernel(
         dreq_ref,  # SMEM [B, 3] i32 — driver request
@@ -112,6 +304,8 @@ def _make_kernel(fill: str, emax: int, n_pad: int, n_apps: int, rows: int):
         elig_d_ref,  # VMEM [rows, cols] i32 — driver eligibility
         drank_ref,  # VMEM [rows, cols] i32 — driver-priority rank per position
         nodeid_ref,  # VMEM [rows, cols] i32 — original node index per position
+        zone_ref,  # VMEM [rows, cols] i32 — zone id per position (single-AZ)
+        sched_ref,  # VMEM [3, rows, cols] i32 — schedulable (single-AZ scoring)
         meta_out,  # VMEM [B, 4] i32 — (driver_node, admitted, packed, 0)
         execs_out,  # VMEM [B, emax] i32
         avail_out,  # VMEM [3, rows, cols] i32 — availability after all admits
@@ -144,7 +338,6 @@ def _make_kernel(fill: str, emax: int, n_pad: int, n_apps: int, rows: int):
         # --- node capacities (ops/capacity.py node_capacities, exact
         # integer semantics: per dim 0 if reserved > avail, INF if req == 0,
         # else floor((avail-reserved)/req); node cap = max(min over dims, 0))
-        shape = (rows, cols)
         cap_e = jnp.full(shape, INF, jnp.int32)  # no reservation
         cap_wd = jnp.full(shape, INF, jnp.int32)  # driver reserved
         fit_d = jnp.ones(shape, jnp.bool_)
@@ -167,126 +360,107 @@ def _make_kernel(fill: str, emax: int, n_pad: int, n_apps: int, rows: int):
         cap_e = jnp.where(elig_e, jnp.maximum(cap_e, 0), 0)
         cap_wd = jnp.where(elig_e, jnp.maximum(cap_wd, 0), 0)
 
-        # --- driver selection via the feasibility identity
-        # (ops/packing.py pack_one_app): reserving the driver on node i only
-        # changes node i's executor capacity.
-        cap_e_c = jnp.minimum(cap_e, count)
-        cap_wd_c = jnp.minimum(cap_wd, count)
-        total_base = jnp.sum(cap_e_c)
-        total_if = total_base - cap_e_c + cap_wd_c
-        feasible = elig_d & fit_d & (total_if >= count)
-        best_rank = jnp.min(jnp.where(feasible, drank, INF))
-        found = best_rank < INF
-        # drank is a permutation rank -> at most one position matches.
-        p_star = jnp.min(jnp.where(feasible & (drank == best_rank), iota, INF))
-        is_drv = iota == p_star
-        driver_node = jnp.sum(jnp.where(is_drv, node_id, 0))
-
-        # Executor capacities with the chosen driver tentatively reserved.
-        caps_fill = jnp.where(is_drv, cap_wd, cap_e)
-
-        # --- executor fill: emax rounds of masked-argmin placement.
+        select_driver = make_driver_selector(
+            count, cap_e, cap_wd, fit_d, elig_d, drank
+        )
         slot_iota = jax.lax.broadcasted_iota(jnp.int32, (1, emax), 1)
-        execs_row = jnp.full((1, emax), -1, jnp.int32)
-        exec_counts = jnp.zeros(shape, jnp.int32)
-        ok = found  # feasibility identity guarantees the fill succeeds
+        # The queue kernel's node axis is pre-permuted into executor
+        # priority order, so the priority KEY is the position itself.
+        run_fill = make_fill_runner(
+            inner_fill, emax, n_pad, shape, count, iota, node_id, slot_iota
+        )
 
-        if fill == "tightly-pack":
-            remaining = caps_fill
-            for j in range(emax):
-                place = ok & (j < count)
-                pos_j = jnp.min(jnp.where(remaining > 0, iota, INF))
-                hit = (iota == pos_j) & place
-                node_j = jnp.sum(jnp.where(hit, node_id, 0))
-                execs_row = jnp.where(
-                    (slot_iota == j) & place, node_j, execs_row
+        if not single_az:
+            found, is_drv, caps_fill = select_driver(
+                jnp.ones(shape, jnp.bool_)
+            )
+            ok = found  # the feasibility identity guarantees the fill
+            execs_row, exec_counts = run_fill(ok, caps_fill, elig_e)
+            driver_node = jnp.sum(jnp.where(is_drv, node_id, 0))
+        else:
+            # --- per-zone pack + strictly-greater efficiency selection
+            # (single_az.go:23-97). Zone "first appearance" rank in driver
+            # priority order breaks efficiency ties (single_az.go:58-73);
+            # zones with no executor-eligible node are skipped
+            # (single_az.go:40-43).
+            zone_pos = zone_ref[:]
+            best_eff = jnp.float32(-1.0)
+            best_first = jnp.int32(INF)
+            any_valid = jnp.bool_(False)
+            is_drv = jnp.zeros(shape, jnp.bool_)
+            execs_row = jnp.full((1, emax), -1, jnp.int32)
+            exec_counts = jnp.zeros(shape, jnp.int32)
+            for z in range(num_zones):
+                zmask = zone_pos == z
+                zone_first = jnp.min(
+                    jnp.where(elig_d & zmask, drank, INF)
                 )
-                remaining = remaining - hit
-                exec_counts = exec_counts + hit
-        elif fill == "distribute-evenly":
-            # key = placed * Npad + position over open positions; placed
-            # never exceeds emax so the key stays far below int32 range.
-            for j in range(emax):
-                place = ok & (j < count)
-                open_ = elig_e & (exec_counts < caps_fill)
-                key = exec_counts * n_pad + iota
-                k_min = jnp.min(jnp.where(open_, key, INF))
-                pos_j = jnp.where(k_min < INF, k_min % n_pad, INF)
-                hit = (iota == pos_j) & place
-                node_j = jnp.sum(jnp.where(hit, node_id, 0))
-                execs_row = jnp.where(
-                    (slot_iota == j) & place, node_j, execs_row
+                zone_has_exec = jnp.any(elig_e & zmask)
+                found_z, is_drv_z, caps_z = select_driver(zmask)
+                execs_z, counts_z = run_fill(
+                    found_z, caps_z, elig_e & zmask
                 )
-                exec_counts = exec_counts + hit
-        elif fill == "minimal-fragmentation":
-            cap_ok = caps_fill > 0
-            caps_c = jnp.minimum(caps_fill, count)
-            # Branch A: smallest single node fitting the whole gang
-            # (minimal_fragmentation.go:68-78): min capacity, then earliest
-            # position on capacity ties.
-            mask_a = cap_ok & (caps_fill >= count)
-            exists_a = jnp.any(mask_a)
-            min_cap_a = jnp.min(jnp.where(mask_a, caps_fill, INF))
-            pos_a = jnp.min(
-                jnp.where(mask_a & (caps_fill == min_cap_a), iota, INF)
-            )
-            # Branch B: consume (clamped capacity desc, position asc) while
-            # the running total stays <= count (the maximal prefix of the
-            # reference's desc sort), remainder on the smallest
-            # not-consumed node with UNCLAMPED capacity >= remainder
-            # (minimal_fragmentation.go:80-98).
-            use_b = ok & ~exists_a
-            consumed = jnp.zeros(shape, jnp.bool_)
-            placed_total = jnp.int32(0)
-            for _ in range(emax):
-                open_b = cap_ok & ~consumed
-                c_max = jnp.max(jnp.where(open_b, caps_c, -1))
-                pos_k = jnp.min(
-                    jnp.where(open_b & (caps_c == c_max), iota, INF)
+                # Zone score: mean over ENTRIES (driver + one per executor
+                # occurrence) of per-node max dim efficiency with the
+                # tentative reservation applied (efficiency.go:85-144).
+                w = counts_z + is_drv_z
+                eff_cpu = jnp.zeros(shape, jnp.float32)
+                eff_mem = jnp.zeros(shape, jnp.float32)
+                eff_gpu = jnp.zeros(shape, jnp.float32)
+                for d in range(3):
+                    sched_d = sched_ref[d]
+                    new_res = jnp.where(
+                        is_drv_z, dreq_ref[b, d], 0
+                    )
+                    if include_exec_in_reserved:
+                        new_res = new_res + counts_z * ereq_ref[b, d]
+                    reserved = (sched_d - avail_scr[d]) + new_res
+                    denom = jnp.maximum(sched_d, 1).astype(jnp.float32)
+                    eff_d = reserved.astype(jnp.float32) / denom
+                    if d == 0:
+                        eff_cpu = eff_d
+                    elif d == 1:
+                        eff_mem = eff_d
+                    else:
+                        gpu_node = sched_d != 0
+                        eff_gpu = jnp.where(gpu_node, eff_d, 0.0)
+                node_max = jnp.maximum(eff_gpu, jnp.maximum(eff_cpu, eff_mem))
+                entries = (count + 1).astype(jnp.float32)
+                eff_z = (
+                    jnp.sum(node_max * w.astype(jnp.float32)) / entries
                 )
-                take = use_b & (c_max > 0) & (placed_total + c_max <= count)
-                hit = (iota == pos_k) & take
-                node_k = jnp.sum(jnp.where(hit, node_id, 0))
-                in_span = (
-                    (slot_iota >= placed_total)
-                    & (slot_iota < placed_total + c_max)
-                    & take
+                valid_z = found_z & (zone_first < INF) & zone_has_exec
+                better = valid_z & (
+                    (eff_z > best_eff)
+                    | ((eff_z == best_eff) & (zone_first < best_first))
                 )
-                execs_row = jnp.where(in_span, node_k, execs_row)
-                exec_counts = exec_counts + jnp.where(hit, c_max, 0)
-                consumed = consumed | hit
-                placed_total = placed_total + jnp.where(take, c_max, 0)
-            remainder = count - placed_total
-            mask_fin = cap_ok & ~consumed & (caps_fill >= remainder)
-            min_cap_f = jnp.min(jnp.where(mask_fin, caps_fill, INF))
-            pos_f = jnp.min(
-                jnp.where(mask_fin & (caps_fill == min_cap_f), iota, INF)
-            )
-            need_fin = use_b & (remainder > 0)
-            chosen_pos = jnp.where(exists_a, pos_a, pos_f)
-            fin_take = ok & (exists_a | need_fin)
-            fin_count = jnp.where(exists_a, count, remainder)
-            fin_hit = (iota == chosen_pos) & fin_take
-            node_fin = jnp.sum(jnp.where(fin_hit, node_id, 0))
-            fin_start = jnp.where(exists_a, 0, placed_total)
-            in_fin = (
-                (slot_iota >= fin_start)
-                & (slot_iota < fin_start + fin_count)
-                & fin_take
-            )
-            # Branch A overwrites any branch-B spans (it is exclusive).
-            execs_row = jnp.where(
-                exists_a & (slot_iota < count) & ok,
-                node_fin,
-                jnp.where(in_fin, node_fin, execs_row),
-            )
-            exec_counts = jnp.where(
-                exists_a & ok,
-                jnp.where(iota == chosen_pos, count, 0),
-                exec_counts + jnp.where(fin_hit, fin_count, 0),
-            )
-        else:  # pragma: no cover — guarded by fifo_pack_pallas
-            raise ValueError(f"unsupported fill for pallas: {fill}")
+                best_eff = jnp.where(better, eff_z, best_eff)
+                best_first = jnp.where(better, zone_first, best_first)
+                any_valid = any_valid | valid_z
+                is_drv = (is_drv_z & better) | (is_drv & ~better)
+                execs_row = jnp.where(better, execs_z, execs_row)
+                exec_counts = jnp.where(better, counts_z, exec_counts)
+            # chooseBestResult starts from WorstAvgPackingEfficiency
+            # (Max=0.0) and replaces only on strictly-greater, so a zone
+            # whose best efficiency is exactly 0.0 is rejected entirely
+            # (single_az.go:84-97).
+            ok = any_valid & (best_eff > 0.0)
+            if az_fallback:
+                # az-aware: plain pack when no single zone fits
+                # (az_aware_pack_tightly.go:27-38).
+                found_p, is_drv_p, caps_p = select_driver(
+                    jnp.ones(shape, jnp.bool_)
+                )
+                execs_p, counts_p = run_fill(found_p, caps_p, elig_e)
+                use_p = ~ok & found_p
+                is_drv = (is_drv_p & use_p) | (is_drv & ~use_p)
+                execs_row = jnp.where(use_p, execs_p, execs_row)
+                exec_counts = jnp.where(use_p, counts_p, exec_counts)
+                ok = ok | found_p
+            is_drv = is_drv & ok
+            execs_row = jnp.where(ok, execs_row, -1)
+            exec_counts = jnp.where(ok, exec_counts, 0)
+            driver_node = jnp.sum(jnp.where(is_drv, node_id, 0))
 
         packed = ok & valid & ~too_big
         admitted = packed & ~blocked_in
@@ -351,14 +525,17 @@ def fifo_pack_pallas(
 ) -> BatchedPacking:
     """Queue-mode `batched_fifo_pack`, executed as one Pallas kernel.
 
-    Only the three plain fills are supported, and only queue mode (no
-    per-app masks, no segmented windows) — exactly the shape of the
-    north-star batched admission. Callers should route through
-    `fifo_pack_auto`, which falls back to the XLA scan everywhere else.
+    All six strategies are supported (plain fills + the single-AZ
+    wrappers, whose per-zone pack and efficiency-scored zone pick run
+    in-kernel), in queue mode only (no per-app masks, no segmented
+    windows) — exactly the shape of the north-star batched admission.
+    Callers should route through `fifo_pack_auto`, which falls back to
+    the XLA scan everywhere else.
     """
     if not pallas_eligible(apps, fill):
         raise ValueError(
-            f"pallas path supports queue mode with {PALLAS_FILLS}, got "
+            f"pallas path supports queue mode with "
+            f"{PALLAS_FILLS + tuple(PALLAS_SINGLE_AZ)}, got "
             f"fill={fill!r} masked={apps.driver_cand is not None or apps.domain is not None} "
             f"segmented={apps.commit is not None}"
         )
@@ -405,12 +582,20 @@ def fifo_pack_pallas(
     elig_d_pos = pos_row(driver_elig.astype(jnp.int32), 0)
     drank_pos = pos_row(d_rank, INT32_INF)
     nodeid_pos = pos_row(jnp.arange(n, dtype=jnp.int32), 0)
+    # Zone ids padded with an out-of-range id (padding matches no zone);
+    # schedulable feeds the single-AZ zone-efficiency scoring.
+    zone_pos = pos_row(cluster.zone_id.astype(jnp.int32), num_zones)
+    sched_pos = (
+        jnp.pad(cluster.schedulable[e_order].T, ((0, 0), (0, pad_cols)))
+        .astype(jnp.int32)
+        .reshape(3, rows, cols)
+    )
 
-    kernel = _make_kernel(fill, emax, n_pad, b, rows)
+    kernel = _make_kernel(fill, emax, n_pad, b, rows, num_zones=num_zones)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(b,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 7,
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -441,6 +626,8 @@ def fifo_pack_pallas(
         elig_d_pos,
         drank_pos,
         nodeid_pos,
+        zone_pos,
+        sched_pos,
     )
 
     # Un-permute the availability back into node order.

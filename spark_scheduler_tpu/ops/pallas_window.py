@@ -50,6 +50,8 @@ from spark_scheduler_tpu.ops.pallas_fifo import (
     _LANES,
     _layout_rows,
     _round_up,
+    make_driver_selector,
+    make_fill_runner,
     pallas_available,
 )
 
@@ -131,7 +133,8 @@ def _make_window_kernel(fill: str, emax: int, n_pad: int, rows: int):
         skippable = skip_ref[b] != 0
         blocked_in = blocked_scr[0] != 0
 
-        # --- node capacities (identical math to the queue kernel)
+        # --- node capacities (ops/capacity.py node_capacities, identical
+        # math to the queue kernel)
         shape = (rows, cols)
         cap_e = jnp.full(shape, INF, jnp.int32)
         cap_wd = jnp.full(shape, INF, jnp.int32)
@@ -155,116 +158,20 @@ def _make_window_kernel(fill: str, emax: int, n_pad: int, rows: int):
         cap_e = jnp.where(elig_e, jnp.maximum(cap_e, 0), 0)
         cap_wd = jnp.where(elig_e, jnp.maximum(cap_wd, 0), 0)
 
-        # --- driver selection via the feasibility identity
-        cap_e_c = jnp.minimum(cap_e, count)
-        cap_wd_c = jnp.minimum(cap_wd, count)
-        total_base = jnp.sum(cap_e_c)
-        total_if = total_base - cap_e_c + cap_wd_c
-        feasible = elig_d & fit_d & (total_if >= count)
-        best_rank = jnp.min(jnp.where(feasible, drank, INF))
-        found = best_rank < INF
-        is_drv = feasible & (drank == best_rank)  # rank is a permutation
+        # Shared gang math (ops/pallas_fifo): the ONE driver-selection and
+        # executor-fill implementation, keyed here on the segment's rank
+        # tensors instead of the queue kernel's pre-permuted positions.
+        select_driver = make_driver_selector(
+            count, cap_e, cap_wd, fit_d, elig_d, drank
+        )
+        found, is_drv, caps_fill = select_driver(jnp.ones(shape, jnp.bool_))
         driver_node = jnp.sum(jnp.where(is_drv, iota, 0))
-
-        caps_fill = jnp.where(is_drv, cap_wd, cap_e)
-
-        # --- executor fill: rank-keyed argmin placement rounds
         slot_iota = jax.lax.broadcasted_iota(jnp.int32, (1, emax), 1)
-        execs_row = jnp.full((1, emax), -1, jnp.int32)
-        exec_counts = jnp.zeros(shape, jnp.int32)
+        run_fill = make_fill_runner(
+            fill, emax, n_pad, shape, count, erank, iota, slot_iota
+        )
         ok = found
-
-        if fill == "tightly-pack":
-            remaining = caps_fill
-            for j in range(emax):
-                place = ok & (j < count)
-                r_j = jnp.min(jnp.where(remaining > 0, erank, INF))
-                hit = (erank == r_j) & (remaining > 0) & place
-                node_j = jnp.sum(jnp.where(hit, iota, 0))
-                execs_row = jnp.where(
-                    (slot_iota == j) & place, node_j, execs_row
-                )
-                remaining = remaining - hit
-                exec_counts = exec_counts + hit
-        elif fill == "distribute-evenly":
-            for j in range(emax):
-                place = ok & (j < count)
-                open_ = elig_e & (exec_counts < caps_fill)
-                key = exec_counts * n_pad + erank
-                k_min = jnp.min(jnp.where(open_, key, INF))
-                hit = open_ & (key == k_min) & place
-                node_j = jnp.sum(jnp.where(hit, iota, 0))
-                execs_row = jnp.where(
-                    (slot_iota == j) & place, node_j, execs_row
-                )
-                exec_counts = exec_counts + hit
-        elif fill == "minimal-fragmentation":
-            cap_ok = caps_fill > 0
-            caps_c = jnp.minimum(caps_fill, count)
-            # Branch A: smallest single node fitting the whole gang; ties by
-            # executor priority (the reference's stable sort over the
-            # priority-ordered slice, minimal_fragmentation.go:68-78).
-            mask_a = cap_ok & (caps_fill >= count)
-            exists_a = jnp.any(mask_a)
-            min_cap_a = jnp.min(jnp.where(mask_a, caps_fill, INF))
-            tie_a = mask_a & (caps_fill == min_cap_a)
-            rank_a = jnp.min(jnp.where(tie_a, erank, INF))
-            sel_a = tie_a & (erank == rank_a)
-            # Branch B: consume (clamped capacity desc, priority asc) while
-            # the running total stays <= count; remainder on the smallest
-            # not-consumed node with UNCLAMPED capacity >= remainder.
-            use_b = ok & ~exists_a
-            consumed = jnp.zeros(shape, jnp.bool_)
-            placed_total = jnp.int32(0)
-            for _ in range(emax):
-                open_b = cap_ok & ~consumed
-                c_max = jnp.max(jnp.where(open_b, caps_c, -1))
-                tie_k = open_b & (caps_c == c_max)
-                rank_k = jnp.min(jnp.where(tie_k, erank, INF))
-                take = use_b & (c_max > 0) & (placed_total + c_max <= count)
-                hit = tie_k & (erank == rank_k) & take
-                node_k = jnp.sum(jnp.where(hit, iota, 0))
-                in_span = (
-                    (slot_iota >= placed_total)
-                    & (slot_iota < placed_total + c_max)
-                    & take
-                )
-                execs_row = jnp.where(in_span, node_k, execs_row)
-                exec_counts = exec_counts + jnp.where(hit, c_max, 0)
-                consumed = consumed | hit
-                placed_total = placed_total + jnp.where(take, c_max, 0)
-            remainder = count - placed_total
-            mask_fin = cap_ok & ~consumed & (caps_fill >= remainder)
-            min_cap_f = jnp.min(jnp.where(mask_fin, caps_fill, INF))
-            tie_f = mask_fin & (caps_fill == min_cap_f)
-            rank_f = jnp.min(jnp.where(tie_f, erank, INF))
-            sel_f = tie_f & (erank == rank_f)
-            need_fin = use_b & (remainder > 0)
-            fin_take = ok & (exists_a | need_fin)
-            # Logical blend, not jnp.where: Mosaic cannot select between
-            # two i1 vectors.
-            fin_sel = (sel_a & exists_a) | (sel_f & ~exists_a)
-            fin_count = jnp.where(exists_a, count, remainder)
-            fin_hit = fin_sel & fin_take
-            node_fin = jnp.sum(jnp.where(fin_hit, iota, 0))
-            fin_start = jnp.where(exists_a, 0, placed_total)
-            in_fin = (
-                (slot_iota >= fin_start)
-                & (slot_iota < fin_start + fin_count)
-                & fin_take
-            )
-            execs_row = jnp.where(
-                exists_a & (slot_iota < count) & ok,
-                node_fin,
-                jnp.where(in_fin, node_fin, execs_row),
-            )
-            exec_counts = jnp.where(
-                exists_a & ok,
-                jnp.where(sel_a, count, 0),
-                exec_counts + jnp.where(fin_hit, fin_count, 0),
-            )
-        else:  # pragma: no cover — guarded by window_pack_pallas
-            raise ValueError(f"unsupported fill for pallas: {fill}")
+        execs_row, exec_counts = run_fill(ok, caps_fill, elig_e)
 
         packed = ok & valid & ~too_big
         admitted = packed & ~blocked_in
@@ -434,6 +341,53 @@ def window_pack_pallas(
     return meta, execs, base_after
 
 
+def segmented_window_from_flat(
+    drv_arr,  # [B, 3] int — flat rows, segment-major
+    exc_arr,  # [B, 3] int
+    counts,  # [B] int
+    skip_arr,  # [B] bool
+    row_counts,  # [S] int — rows per segment (sum == B)
+    cand_masks,  # list/array of [N] bool — per segment
+    domain_masks,  # list/array of [N] bool — per segment
+    *,
+    pad_segments: int,
+    pad_rows: int,
+):
+    """THE SegmentedWindow layout builder (single owner): scatter flat
+    segment-major row arrays into the padded [S, R] shape in a handful of
+    vectorized assignments (per-row Python here would sit on the serving
+    hot path). Returns (SegmentedWindow, seg_idx, row_idx) — the flat->
+    [S, R] index map the fetch side uses to flatten the device blob."""
+    s = len(row_counts)
+    rc = np.asarray(row_counts, np.int64)
+    seg_idx = np.repeat(np.arange(s, dtype=np.int64), rc)
+    row_idx = np.concatenate(
+        [np.arange(k, dtype=np.int64) for k in rc]
+    ) if s else np.zeros(0, np.int64)
+    n = len(cand_masks[0])
+    dreq = np.zeros((pad_segments, pad_rows, 3), np.int32)
+    ereq = np.zeros((pad_segments, pad_rows, 3), np.int32)
+    cnt = np.zeros((pad_segments, pad_rows), np.int32)
+    valid = np.zeros((pad_segments, pad_rows), bool)
+    skip = np.zeros((pad_segments, pad_rows), bool)
+    row_count = np.zeros(pad_segments, np.int32)
+    cand = np.zeros((pad_segments, n), bool)
+    dom = np.zeros((pad_segments, n), bool)
+    dreq[seg_idx, row_idx] = drv_arr
+    ereq[seg_idx, row_idx] = exc_arr
+    cnt[seg_idx, row_idx] = counts
+    valid[seg_idx, row_idx] = True
+    skip[seg_idx, row_idx] = skip_arr
+    row_count[:s] = rc
+    cand[:s] = np.stack(cand_masks)
+    dom[:s] = np.stack(domain_masks)
+    win = SegmentedWindow(
+        driver_req=dreq, exec_req=ereq, exec_count=cnt, valid=valid,
+        skippable=skip, row_count=row_count, driver_cand=cand, domain=dom,
+    )
+    return win, seg_idx, row_idx
+
+
 def make_segmented_window(
     requests_rows,  # list of list[(driver_req[3], exec_req[3], count, skip)]
     cand_masks,  # list of [N] bool — per request
@@ -443,40 +397,30 @@ def make_segmented_window(
     pad_segments: int | None = None,
     pad_rows: int | None = None,
 ) -> SegmentedWindow:
-    """Host helper: segment-major arrays from per-request row lists, rows
-    padded to a bucketed max so the Mosaic grid recompiles only when the
-    bucket changes. `pad_segments`/`pad_rows` override the defaults for
-    callers with their own bucketing policy (the serving solver); padding
-    segments have row_count 0 and are skipped at runtime."""
+    """List-of-rows convenience front-end over `segmented_window_from_flat`
+    (tests, smoke). `pad_segments`/`pad_rows` override the defaults for
+    callers with their own bucketing policy; padding segments have
+    row_count 0 and are skipped at runtime."""
     s = len(requests_rows)
     r = 1
     for rws in requests_rows:
         r = max(r, len(rws))
     r = pad_rows if pad_rows is not None else _round_up(r, row_bucket)
     s_pad = pad_segments if pad_segments is not None else s
-    n = len(cand_masks[0])
-    dreq = np.zeros((s_pad, r, 3), np.int32)
-    ereq = np.zeros((s_pad, r, 3), np.int32)
-    cnt = np.zeros((s_pad, r), np.int32)
-    valid = np.zeros((s_pad, r), bool)
-    skip = np.zeros((s_pad, r), bool)
-    rc = np.zeros(s_pad, np.int32)
-    cand = np.zeros((s_pad, n), bool)
-    dom = np.zeros((s_pad, n), bool)
-    for i, rws in enumerate(requests_rows):
-        rc[i] = len(rws)
-        cand[i] = cand_masks[i]
-        dom[i] = domain_masks[i]
-        for j, (dr, er, c, sk) in enumerate(rws):
-            dreq[i, j] = dr
-            ereq[i, j] = er
-            cnt[i, j] = c
-            valid[i, j] = True
-            skip[i, j] = bool(sk)
-    return SegmentedWindow(
-        driver_req=dreq, exec_req=ereq, exec_count=cnt, valid=valid,
-        skippable=skip, row_count=rc, driver_cand=cand, domain=dom,
+    rc = [len(rws) for rws in requests_rows]
+    flat = [row for rws in requests_rows for row in rws]
+    win, _, _ = segmented_window_from_flat(
+        np.asarray([row[0] for row in flat], np.int32).reshape(-1, 3),
+        np.asarray([row[1] for row in flat], np.int32).reshape(-1, 3),
+        np.asarray([row[2] for row in flat], np.int32),
+        np.asarray([bool(row[3]) for row in flat]),
+        rc,
+        cand_masks,
+        domain_masks,
+        pad_segments=s_pad,
+        pad_rows=r,
     )
+    return win
 
 
 def window_pallas_eligible(fill: str) -> bool:

@@ -96,17 +96,9 @@ def build_scheduler_app(
     config = config or InstallConfig()
     clock = clock or _time.time
     if config.jax_compilation_cache_dir:
-        import jax as _jax
-
-        try:
-            _jax.config.update(
-                "jax_compilation_cache_dir", config.jax_compilation_cache_dir
-            )
-            _jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.5
-            )
-        except Exception:
-            pass  # older jax: compiles stay per-process
+        InstallConfig.enable_jax_compile_cache(
+            config.jax_compilation_cache_dir
+        )
 
     # The scheduler owns its reservation CRD: create-or-upgrade + verify
     # Established before anything consumes it (cmd/server.go:103-109); the

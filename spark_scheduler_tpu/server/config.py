@@ -96,6 +96,21 @@ class InstallConfig:
     # compile stalls. None = per-process compiles.
     jax_compilation_cache_dir: Optional[str] = None
 
+    @staticmethod
+    def enable_jax_compile_cache(cache_dir: str) -> None:
+        """Point jax at a persistent compilation cache (shared helper for
+        the server bootstrap and the bench). No-op on older jax without
+        the knobs."""
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5
+            )
+        except Exception:
+            pass
+
     @classmethod
     def from_dict(cls, raw: dict) -> "InstallConfig":
         fifo_cfg = FifoConfig()

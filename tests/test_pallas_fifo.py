@@ -16,6 +16,7 @@ from spark_scheduler_tpu.models.cluster import ClusterTensors
 from spark_scheduler_tpu.ops.batched import batched_fifo_pack, make_app_batch
 from spark_scheduler_tpu.ops.pallas_fifo import (
     PALLAS_FILLS,
+    PALLAS_SINGLE_AZ,
     fifo_pack_auto,
     fifo_pack_pallas,
 )
@@ -125,13 +126,46 @@ def test_pallas_sublane_folded_layout_matches():
         pf._layout_rows = orig
 
 
-def test_pallas_rejects_masked_and_single_az():
+@pytest.mark.parametrize("fill", sorted(PALLAS_SINGLE_AZ))
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_pallas_single_az_matches_xla_scan(fill, seed):
+    """The in-kernel per-zone pack + efficiency-scored zone pick (VERDICT
+    r3 #4) equals the XLA scan's pack_one_app_single_az step, decision for
+    decision — including az-aware's plain fallback and the
+    minimal-fragmentation driver-only reservation quirk."""
+    rng = np.random.default_rng(seed * 11 + 2)
+    c = random_cluster(rng, 37, num_zones=NUM_ZONES)
+    apps = random_apps(rng, 9, pad_to=12)
+    want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES)
+    got = fifo_pack_pallas(
+        c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES, interpret=True
+    )
+    assert_same(got, want)
+
+
+def test_pallas_single_az_rejects_when_no_zone_fits():
+    """single-az (no fallback): a gang no single zone can hold is
+    rejected; az-aware admits it via the plain fallback."""
+    rng = np.random.default_rng(29)
+    c = random_cluster(rng, 24, num_zones=NUM_ZONES)
+    driver = np.ones((1, 3), np.int32)
+    execs = np.ones((1, 3), np.int32) * 4
+    counts = np.array([EMAX], np.int32)  # spread wider than any one zone
+    apps = make_app_batch(driver, execs, counts)
+    for fill in ("single-az-tightly-pack", "az-aware-tightly-pack"):
+        want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX,
+                                 num_zones=NUM_ZONES)
+        got = fifo_pack_pallas(
+            c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES,
+            interpret=True,
+        )
+        assert_same(got, want)
+
+
+def test_pallas_rejects_masked():
     rng = np.random.default_rng(3)
     c = random_cluster(rng, 16, num_zones=NUM_ZONES)
     apps = random_apps(rng, 4)
-    with pytest.raises(ValueError):
-        fifo_pack_pallas(c, apps, fill="single-az-tightly-pack",
-                         emax=EMAX, num_zones=NUM_ZONES, interpret=True)
     masked = apps._replace(domain=np.ones((4, 16), bool))
     with pytest.raises(ValueError):
         fifo_pack_pallas(c, masked, fill="tightly-pack",
