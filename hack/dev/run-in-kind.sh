@@ -95,3 +95,12 @@ for n in $pod_nodes; do
 done
 
 say "OK: gang of $want pods scheduled on reserved nodes"
+
+# 6. optional REAL Spark stage ---------------------------------------------
+# REAL_SPARK=1 additionally drives an actual spark-submit (k8s cluster
+# mode) through the scheduler — annotation parsing, executor ramp-up and
+# churn as Spark itself produces them. Needs SPARK_HOME (Spark 3.x).
+if [ "${REAL_SPARK:-0}" = "1" ]; then
+  say "running the real spark-submit smoke"
+  "$REPO/hack/dev/spark-submit-test.sh" "$NUM_EXECUTORS"
+fi
