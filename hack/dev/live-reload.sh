@@ -21,20 +21,27 @@ REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/../.." && pwd)"
 say() { echo ">>> $*"; }
 
 reload() {
+  # Explicit '|| return 1' per step: the watch loop calls reload with an
+  # '||' guard, which DISABLES errexit inside the function — without
+  # these, a failed docker build would still kind-load the stale image
+  # and "succeed".
   say "building $IMG"
-  docker build -q -f "$REPO/docker/Dockerfile" -t "$IMG" "$REPO"
+  docker build -q -f "$REPO/docker/Dockerfile" -t "$IMG" "$REPO" || return 1
   say "loading image into kind cluster $CLUSTER"
-  kind load docker-image --name "$CLUSTER" "$IMG"
+  kind load docker-image --name "$CLUSTER" "$IMG" || return 1
   say "restarting $DEPLOY"
-  kubectl -n "$NAMESPACE" rollout restart "deployment/$DEPLOY"
-  kubectl -n "$NAMESPACE" rollout status "deployment/$DEPLOY" --timeout=180s
+  kubectl -n "$NAMESPACE" rollout restart "deployment/$DEPLOY" || return 1
+  kubectl -n "$NAMESPACE" rollout status "deployment/$DEPLOY" \
+    --timeout=180s || return 1
 }
 
 src_hash() {
-  # Hash of everything the image build consumes.
-  find "$REPO/spark_scheduler_tpu" "$REPO/native" "$REPO/docker" \
-    -type f \( -name '*.py' -o -name '*.cpp' -o -name '*.h' \
-      -o -name 'Dockerfile' -o -name '*.yml' \) -print0 \
+  # Hash of everything the image build consumes (docker/Dockerfile COPY
+  # list: pyproject.toml, spark_scheduler_tpu/, native/, docker/var/conf).
+  { find "$REPO/spark_scheduler_tpu" "$REPO/native" "$REPO/docker" \
+      -type f \( -name '*.py' -o -name '*.cpp' -o -name '*.h' \
+        -o -name 'Dockerfile' -o -name '*.yml' \) -print0;
+    printf '%s\0' "$REPO/pyproject.toml"; } \
     | sort -z | xargs -0 sha256sum | sha256sum | cut -d' ' -f1
 }
 

@@ -114,7 +114,7 @@ def test_pallas_sublane_folded_layout_matches():
         rng = np.random.default_rng(21)
         c = random_cluster(rng, 53, num_zones=NUM_ZONES)
         apps = random_apps(rng, 7)
-        for fill in sorted(PALLAS_FILLS):
+        for fill in sorted(PALLAS_FILLS) + sorted(PALLAS_SINGLE_AZ):
             want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX,
                                      num_zones=NUM_ZONES)
             got = fifo_pack_pallas(
@@ -124,6 +124,37 @@ def test_pallas_sublane_folded_layout_matches():
             assert_same(got, want)
     finally:
         pf._layout_rows = orig
+
+
+def test_pallas_single_az_gpu_scoring_parity():
+    """Zone-efficiency scoring with GPU-bearing nodes: the per-node max
+    includes the GPU ratio only where schedulable GPU exists
+    (efficiency.go:139-144) — a GPU-heavy cluster exercises that branch of
+    the in-kernel score."""
+    rng = np.random.default_rng(37)
+    c = random_cluster(rng, 29, num_zones=NUM_ZONES)
+    import dataclasses
+
+    sched = np.asarray(c.schedulable).copy()
+    avail = np.asarray(c.available).copy()
+    sched[::2, 2] = 4  # every other node carries schedulable GPU
+    avail[::2, 2] = rng.integers(0, 5, size=len(avail[::2]))
+    c = dataclasses.replace(
+        c, schedulable=sched, available=np.minimum(avail, sched)
+    )
+    driver = np.ones((6, 3), np.int32)
+    execs = np.ones((6, 3), np.int32)
+    execs[:, 2] = rng.integers(0, 2, size=6)  # some gangs want GPUs
+    counts = rng.integers(1, EMAX + 1, size=6).astype(np.int32)
+    apps = make_app_batch(driver, execs, counts)
+    for fill in sorted(PALLAS_SINGLE_AZ):
+        want = batched_fifo_pack(c, apps, fill=fill, emax=EMAX,
+                                 num_zones=NUM_ZONES)
+        got = fifo_pack_pallas(
+            c, apps, fill=fill, emax=EMAX, num_zones=NUM_ZONES,
+            interpret=True,
+        )
+        assert_same(got, want)
 
 
 @pytest.mark.parametrize("fill", sorted(PALLAS_SINGLE_AZ))

@@ -172,6 +172,54 @@ def test_window_pallas_commit_rows_thread_the_base():
     assert np.asarray(base_after)[0, 0] == 0
 
 
+def test_window_pallas_empty_candidates_and_emax_edges():
+    """A segment whose candidate mask excludes every node rejects without
+    disturbing its neighbors; count == emax and count == 0 rows match the
+    XLA scan exactly."""
+    rng = np.random.default_rng(31)
+    n, emax = 16, 8
+    cluster = _cluster(rng, n)
+    one = np.ones(3, np.int32)
+    requests = [
+        [(one, one, emax, False)],  # full-width gang
+        [(one, one, 0, False)],  # zero-executor gang
+        [(one, one, 2, False)],  # starved: empty candidate mask
+    ]
+    cands = [np.ones(n, bool), np.ones(n, bool), np.zeros(n, bool)]
+    doms = [np.ones(n, bool)] * 3
+    win = make_segmented_window(requests, cands, doms)
+    # XLA twin
+    flat = [r for rows in requests for r in rows]
+    apps = make_app_batch(
+        np.stack([r[0] for r in flat]),
+        np.stack([r[1] for r in flat]),
+        np.asarray([r[2] for r in flat], np.int32),
+        skippable=[r[3] for r in flat],
+        driver_cand=np.stack([cands[i] for i in range(3)]),
+        domain=np.stack([doms[i] for i in range(3)]),
+        commit=[True] * 3,
+        reset=[True] * 3,
+    )
+    ref = batched_fifo_pack(
+        cluster, apps, fill="tightly-pack", emax=emax, num_zones=4
+    )
+    meta, execs, base_after = window_pack_pallas(
+        cluster, win, fill="tightly-pack", emax=emax, num_zones=4,
+        interpret=True,
+    )
+    meta = np.asarray(meta)
+    for bi in range(3):
+        assert meta[bi, 0, 1] == np.asarray(ref.admitted)[bi], bi
+        assert meta[bi, 0, 0] == np.asarray(ref.driver_node)[bi], bi
+        np.testing.assert_array_equal(
+            np.asarray(execs)[bi, 0], np.asarray(ref.executor_nodes)[bi]
+        )
+    assert meta[2, 0, 1] == 0  # starved segment rejected
+    np.testing.assert_array_equal(
+        np.asarray(base_after), np.asarray(ref.available_after)
+    )
+
+
 def test_solver_window_route_parity(monkeypatch):
     """The solver's Pallas window route (pack_window dispatch/fetch through
     _window_blob_pallas) returns byte-identical decisions to the XLA route
