@@ -636,23 +636,41 @@ class PlacementSolver:
 
     def candidate_mask(self, tensors, node_names: Sequence[str]) -> np.ndarray:
         n = tensors.available.shape[0]
-        key = (n, self.registry.epoch, tuple(node_names))
-        mask = self._cand_cache.get(key)
-        if mask is not None:
+        names = tuple(node_names)
+
+        def _build() -> np.ndarray:
+            mask = np.zeros(n, dtype=bool)
+            index_of = self.registry.index_of
+            for name in names:
+                idx = index_of(name)
+                if idx is not None and idx < n:
+                    mask[idx] = True
+            # Shared across callers — must be treated read-only (every
+            # consumer either copies via `&`/stack or hands it straight to
+            # the device).
+            mask.flags.writeable = False
             return mask
-        mask = np.zeros(n, dtype=bool)
-        index_of = self.registry.index_of
-        for name in node_names:
-            idx = index_of(name)
-            if idx is not None and idx < n:
-                mask[idx] = True
-        # Shared across callers — must be treated read-only (every consumer
-        # either copies via `&`/stack or hands it straight to the device).
-        mask.flags.writeable = False
-        if len(self._cand_cache) >= 64:
-            self._cand_cache.clear()
-        self._cand_cache[key] = mask
-        return mask
+
+        for _ in range(4):
+            epoch = self.registry.epoch
+            if epoch & 1:  # mutation in flight: the walk would be torn
+                continue
+            key = (n, epoch, names)
+            mask = self._cand_cache.get(key)
+            if mask is not None:
+                return mask
+            mask = _build()
+            # Seqlock read: the walk is valid only if the epoch is unchanged
+            # after it — otherwise the mask may mix old and new name->index
+            # mappings; rebuild.
+            if self.registry.epoch == epoch:
+                if len(self._cand_cache) >= 64:
+                    self._cand_cache.clear()
+                self._cand_cache[key] = mask
+                return mask
+        # Registry churning continuously: one consistent build under the
+        # registry's lock (uncached — the epoch is stale by construction).
+        return self.registry.read_consistent(_build)
 
     def _num_zones_bucket(self) -> int:
         return _bucket(max(len(self.registry._zone_names), 1), 2)

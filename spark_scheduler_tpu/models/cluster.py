@@ -101,6 +101,10 @@ class NodeRegistry:
         self._zone_names: list[str] = []
         # Bumped on every name->index mapping change; lets derived artifacts
         # (candidate masks, rank tables) cache against a stable mapping.
+        # Seqlock discipline: bumped BEFORE and AFTER each mutation, so an
+        # odd value means a mutation is in flight — lock-free readers must
+        # not cache anything keyed on an odd epoch, and must re-check the
+        # epoch after reading to detect a concurrent mutation.
         self._epoch = 0
 
     @property
@@ -111,6 +115,7 @@ class NodeRegistry:
         with self._intern_lock:
             idx = self._index.get(name)
             if idx is None:
+                self._epoch += 1  # odd: mapping unstable
                 if self._free:
                     idx = self._free.pop()
                     self._names[idx] = name
@@ -118,19 +123,29 @@ class NodeRegistry:
                     idx = len(self._names)
                     self._names.append(name)
                 self._index[name] = idx
-                self._epoch += 1
+                self._epoch += 1  # even: stable again
             return idx
 
     def remove(self, name: str) -> None:
         with self._intern_lock:
-            idx = self._index.pop(name, None)
-            if idx is not None:
-                self._names[idx] = None
-                self._free.append(idx)
-                self._epoch += 1
+            if name not in self._index:
+                return
+            self._epoch += 1  # odd: mapping unstable
+            idx = self._index.pop(name)
+            self._names[idx] = None
+            self._free.append(idx)
+            self._epoch += 1  # even: stable again
 
     def index_of(self, name: str) -> int | None:
         return self._index.get(name)
+
+    def read_consistent(self, fn):
+        """Run `fn()` under the intern lock: a name->index view guaranteed
+        stable for the duration. The fallback for seqlock readers (see
+        `epoch`) when mutations keep the epoch moving — keeps the whole
+        locking protocol inside the registry."""
+        with self._intern_lock:
+            return fn()
 
     def name_of(self, idx: int) -> str | None:
         if 0 <= idx < len(self._names):

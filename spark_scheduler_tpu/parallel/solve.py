@@ -141,7 +141,10 @@ def _grouped_pallas_sharded(
     Pallas at 100k nodes (16.6 ms, PERFORMANCE.md) already beats the
     node-sharded XLA scan, so node-axis scale-out stays on the GSPMD scan
     (`sharded_fifo_pack`) and chip scale-out happens on the group axis."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
 
     g = clusters.available.shape[0]
     n_dev = mesh.shape["groups"]

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from spark_scheduler_tpu.core.extender import ExtenderArgs
@@ -407,6 +408,15 @@ class PredicateBatcher:
         }
 
 
+class UnframeableBody(ValueError):
+    """The request body's length cannot be determined safely (client
+    framing error — mapped to a 400, and the connection is closed)."""
+
+
+class UnsupportedTransferEncoding(UnframeableBody):
+    """Request body uses Transfer-Encoding (no chunked decoder here)."""
+
+
 class _JSONHandler(BaseHTTPRequestHandler):
     """Shared JSON plumbing + the routes both servers serve
     (liveness, POST /convert)."""
@@ -421,29 +431,123 @@ class _JSONHandler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # quiet
         pass
 
+    def _content_length(self) -> int:
+        """Validated Content-Length. Raises UnframeableBody — after flagging
+        the connection for drain+close — on negative or non-numeric values
+        (int() would raise / read(-1) would block to EOF) and on duplicate
+        headers with differing values (RFC 7230 3.3.2: reading only the
+        first would leave the rest of the body to desync the next keep-alive
+        request — request smuggling)."""
+        raws = self.headers.get_all("Content-Length") or []
+        vals = {r.strip() for r in raws}
+        length = None
+        if len(vals) <= 1:
+            raw = next(iter(vals), None)
+            if raw is None:
+                return 0
+            # RFC 7230: 1*DIGIT only. Bare int() also accepts '1_6', '+16'
+            # and Unicode digits — forms an RFC-strict proxy in front of us
+            # would frame differently (the smuggling vector again).
+            if raw.isascii() and raw.isdigit():
+                length = int(raw)
+            else:
+                length = None
+        if length is None or length < 0:
+            self.close_connection = True
+            self._drain_on_close = True
+            raise UnframeableBody("invalid Content-Length")
+        return length
+
+    @staticmethod
+    def _error_code(exc: Exception) -> int:
+        # Client framing errors are 4xx, not server failures (a 500 would
+        # count against server error budgets and invite pointless retries).
+        return 400 if isinstance(exc, UnframeableBody) else 500
+
     def _write(self, code: int, payload) -> None:
         # Keep-alive discipline: a handler that answers without reading the
         # request body (404s, gated debug routes) would leave those bytes
         # in rfile and desync the NEXT request on this persistent
         # connection — drain them first.
         if not getattr(self, "_body_consumed", False):
-            length = int(self.headers.get("Content-Length") or 0)
-            if length:
-                self.rfile.read(length)
+            if self.headers.get("Transfer-Encoding"):
+                # Unframeable (and Content-Length may lie alongside it) —
+                # don't block in read(); close after this response instead.
+                self.close_connection = True
+                self._drain_on_close = True
+            else:
+                try:
+                    length = self._content_length()
+                except UnframeableBody:
+                    length = 0  # flagged: drained + closed after response
+                if length:
+                    self.rfile.read(length)
             self._body_consumed = True
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # Advertise the close so a pipelining client doesn't race its
+            # next request onto a socket we're about to shut.
+            self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
 
     def handle_one_request(self):
         self._body_consumed = False  # per-request, before any handler runs
+        self._drain_on_close = False
         super().handle_one_request()
+        # An unframeable body (Transfer-Encoding, garbage Content-Length)
+        # was answered without being read; close the connection so the
+        # unread bytes can never desync a subsequent request on the
+        # persistent socket.
+        if self._drain_on_close:
+            self.close_connection = True
+            # Drain the unread body so close() sends FIN, not RST (unread
+            # receive data at close resets the connection on Linux and can
+            # destroy the in-flight response). The body usually rode in
+            # with the headers and sits read-ahead in rfile's user-space
+            # buffer — invisible to connection.recv — so consume that
+            # first, non-blocking.
+            try:
+                self.connection.setblocking(False)
+                while self.rfile.read1(65536):
+                    pass
+            except (OSError, ValueError):
+                pass
+            # Then a short timed kernel drain for bytes still in flight,
+            # bounded in bytes and wall time so a client streaming forever
+            # can't pin the handler thread.
+            try:
+                self.connection.settimeout(0.05)
+                budget = 1 << 18
+                deadline = time.monotonic() + 1.0
+                while budget > 0 and time.monotonic() < deadline:
+                    got = self.connection.recv(65536)
+                    if not got:
+                        break
+                    budget -= len(got)
+            except OSError:
+                pass
 
     def _body(self):
-        length = int(self.headers.get("Content-Length") or 0)
+        if self.headers.get("Transfer-Encoding"):
+            # No chunked decoder here — without this, a chunked POST would
+            # parse as an empty body and be answered with a confidently
+            # wrong success. Callers turn this into an error response;
+            # the connection closes after it (advertised by _write).
+            self.close_connection = True
+            self._drain_on_close = True
+            self._body_consumed = True
+            raise UnsupportedTransferEncoding(
+                "Transfer-Encoding not supported; send Content-Length"
+            )
+        try:
+            length = self._content_length()
+        except UnframeableBody:
+            self._body_consumed = True  # never read; drained at close
+            raise
         self._body_consumed = True
         return json.loads(self.rfile.read(length) or b"{}")
 
@@ -584,7 +688,7 @@ class SchedulerHTTPServer:
                     try:
                         pod, node_names = extender_args_from_k8s(self._body())
                     except Exception as exc:
-                        self._write(500, {"Error": str(exc)})
+                        self._write(self._error_code(exc), {"Error": str(exc)})
                         return
                     # Root span continues the caller's b3 trace context
                     # (the witchcraft tracing middleware slot).
@@ -627,8 +731,14 @@ class SchedulerHTTPServer:
 
                     try:
                         body = self._body()
+                    except UnframeableBody as exc:
+                        # The body (with its would-be "dir") was never
+                        # read — reject rather than silently profiling
+                        # into the default dir.
+                        self._write(400, {"error": str(exc)})
+                        return
                     except Exception:
-                        body = {}
+                        body = {}  # empty/garbage body: defaults are fine
                     if not isinstance(body, dict):
                         body = {}
                     log_dir = body.get("dir") or "/tmp/spark-scheduler-jax-trace"
@@ -677,7 +787,7 @@ class SchedulerHTTPServer:
                     else:
                         self._write(404, {"error": "not found"})
                 except Exception as exc:
-                    self._write(500, {"error": str(exc)})
+                    self._write(self._error_code(exc), {"error": str(exc)})
 
             def do_DELETE(self):
                 try:

@@ -169,3 +169,103 @@ def test_non_spark_pod_rejected(server):
         port, "POST", "/predicates", {"Pod": pod, "NodeNames": ["n0"]}
     )
     assert status == 200 and not result["NodeNames"]
+
+
+def _raw_exchange(port, request_bytes, timeout=5.0):
+    """Send raw bytes, read until the server closes or the timeout fires.
+    Returns (response_bytes, closed_cleanly)."""
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", port))
+    s.sendall(request_bytes)
+    s.settimeout(timeout)
+    resp, closed = b"", False
+    try:
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                closed = True
+                break
+            resp += chunk
+    except socket.timeout:
+        pass
+    s.close()
+    return resp, closed
+
+
+def test_chunked_transfer_encoding_rejected_and_connection_closed(server):
+    """No chunked decoder: a Transfer-Encoding body must be answered with an
+    explicit error (never a confidently wrong success computed from an empty
+    body), the response must advertise Connection: close, and the socket must
+    close so the unread chunk bytes can't desync a keep-alive follow-up."""
+    port = server.port
+    payload = b'{"Pod": {}, "NodeNames": ["n0"]}'
+    req = (
+        b"POST /predicates HTTP/1.1\r\nHost: x\r\n"
+        b"Transfer-Encoding: chunked\r\nContent-Type: application/json\r\n\r\n"
+        + hex(len(payload))[2:].encode() + b"\r\n" + payload + b"\r\n0\r\n\r\n"
+    )
+    resp, closed = _raw_exchange(port, req)
+    first_line = resp.split(b"\r\n", 1)[0]
+    assert first_line.startswith(b"HTTP/1.1 5") or first_line.startswith(
+        b"HTTP/1.1 4"
+    ), resp[:200]
+    assert resp.count(b"HTTP/1.1") == 1  # exactly one response, no desync
+    assert b"Transfer-Encoding not supported" in resp
+    assert b"Connection: close" in resp
+    assert closed
+
+    # The server is still healthy for the next (fresh) connection.
+    status, body = _request(port, "GET", "/status/liveness")
+    assert status == 200 and body["status"] == "up"
+
+
+def test_transfer_encoding_on_no_body_route_answers_fast(server):
+    """A TE request to a route that never reads the body (404) must not block
+    on a lying Content-Length; it gets its error response, then close."""
+    import time
+
+    t0 = time.monotonic()
+    resp, closed = _raw_exchange(
+        server.port,
+        b"POST /nope HTTP/1.1\r\nHost: x\r\nTransfer-Encoding: chunked\r\n"
+        b"Content-Length: 1000000\r\n\r\n2\r\n{}\r\n0\r\n\r\n",
+        timeout=10.0,
+    )
+    assert resp.split(b"\r\n", 1)[0] == b"HTTP/1.1 404 Not Found"
+    assert b"Connection: close" in resp and closed
+    assert time.monotonic() - t0 < 5.0  # bounded drain, not a 30s stall
+
+
+def test_garbage_content_length_rejected_and_closed(server):
+    """Negative / non-numeric / mismatched-duplicate Content-Length cannot
+    frame a body — the server answers 400 (not a success fabricated from an
+    empty body, not a read(-1) to EOF) and closes the connection."""
+    for headers in (
+        b"Content-Length: -1\r\n",
+        b"Content-Length: abc\r\n",
+        # RFC 7230 3.3.2: differing duplicates must be rejected, else the
+        # unread tail desyncs the next keep-alive request (smuggling).
+        b"Content-Length: 4\r\nContent-Length: 28\r\n",
+    ):
+        # A real body rides along unread — the post-response drain must
+        # consume it so close() sends FIN, not RST.
+        resp, closed = _raw_exchange(
+            server.port,
+            b"POST /predicates HTTP/1.1\r\nHost: x\r\n" + headers
+            + b"\r\n" + b'{"Pod": {}, "NodeNames": []}',
+        )
+        assert resp.split(b"\r\n", 1)[0] == b"HTTP/1.1 400 Bad Request", (
+            headers, resp[:200])
+        assert resp.count(b"HTTP/1.1") == 1, (headers, resp[:200])
+        assert b"Connection: close" in resp and closed
+
+    # Duplicate but IDENTICAL Content-Length values frame fine.
+    body = b'{"Pod": {}, "NodeNames": []}'
+    resp, _ = _raw_exchange(
+        server.port,
+        b"POST /predicates HTTP/1.1\r\nHost: x\r\nConnection: close\r\n"
+        b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+        b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body,
+    )
+    assert resp.split(b"\r\n", 1)[0] == b"HTTP/1.1 200 OK", resp[:200]
