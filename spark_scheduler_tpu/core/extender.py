@@ -333,9 +333,20 @@ class SparkSchedulerExtender:
             if t.handle is not None:
                 self._complete_driver_window(t)
             args_list, results, roles = t.args_list, t.results, t.roles
+            # Consecutive executor requests are served as ONE grouped ladder
+            # pass + one grouped reschedule solve (_serve_executor_window);
+            # a non-executor request between them flushes the run so the
+            # arrival-order serialization is preserved.
+            run: list[int] = []
             for i, args in enumerate(args_list):
                 if results[i] is not None:
                     continue
+                if roles[i] == ROLE_EXECUTOR:
+                    run.append(i)
+                    continue
+                if run:
+                    self._serve_executor_window(t, run)
+                    run = []
                 pod = args.pod
                 with tracer().span(
                     "select-node", role=roles[i] or "unknown",
@@ -352,6 +363,8 @@ class SparkSchedulerExtender:
                     results[i] = ExtenderFilterResult(
                         node_names=[node], failed_nodes={}, outcome=outcome
                     )
+            if run:
+                self._serve_executor_window(t, run)
         return results
 
     def _dispatch_driver_window(self, t: WindowTicket, driver_ids) -> None:
@@ -833,6 +846,258 @@ class SparkSchedulerExtender:
         return pod.creation_timestamp + age_gate > self._clock()
 
     # ------------------------------------------------------------- executor
+
+    def _serve_executor_window(self, t: WindowTicket, ids: list[int]) -> None:
+        """Serve a run of consecutive executor requests of a window with
+        grouped passes instead of one full ladder per request:
+
+        1. Per app: ONE pass over the reservation/soft stores resolves
+           already-bound / unbound / needs-spot for the whole batch
+           (rrm.executor_ladder_batch — one fetch, one active-pod listing,
+           one cache write per app per window).
+        2. ONE grouped device solve places all reschedule stragglers
+           (pack_window, one 1-executor segment per straggler; each segment
+           commits into the threaded base, so later stragglers see earlier
+           placements — replacing one `pack` device round trip per
+           straggler with one for the whole window).
+
+        Decisions match serving the run serially through
+        _select_executor_node, with two documented conservative deviations:
+        a straggler's slot-move frees its OLD node only after this window
+        (a later straggler in the same window does not see that freed
+        capacity), and when a straggler's solve fails, later same-app
+        executors that were classified no-spots fail failure-fit (the
+        outcome the serial re-attempt would reach) without re-solving.
+        Anchor: resource.go:376-428."""
+        from spark_scheduler_tpu.tracing import tracer
+
+        args_list, results = t.args_list, t.results
+
+        def finish(i, node, outcome, message=""):
+            pod = args_list[i].pod
+            with tracer().span(
+                "select-node", role=ROLE_EXECUTOR,
+                pod=f"{pod.namespace}/{pod.name}",
+            ) as sp:
+                sp.tag("outcome", outcome)
+            self._mark_outcome(pod, ROLE_EXECUTOR, outcome, t.timer_start)
+            if node is None:
+                results[i] = self._fail(args_list[i], outcome, message or outcome)
+            else:
+                self._demands.delete_demand_if_exists(pod)
+                results[i] = ExtenderFilterResult(
+                    node_names=[node], failed_nodes={}, outcome=outcome
+                )
+
+        by_app: dict[tuple[str, str], list[int]] = {}
+        for i in ids:
+            pod = args_list[i].pod
+            key = (pod.namespace, pod.labels.get(SPARK_APP_ID_LABEL, ""))
+            by_app.setdefault(key, []).append(i)
+
+        stragglers: list[dict] = []
+        straggler_by_pod: dict[tuple[str, str], dict] = {}
+        dup_waiters: dict[tuple[str, str], list[int]] = {}
+        deferred_no_spots: dict[tuple[str, str], list[int]] = {}
+        app_ctx: dict[tuple[str, str], tuple] = {}
+        for key, app_ids in by_app.items():
+            namespace, app_id = key
+            try:
+                rungs = self._rrm.executor_ladder_batch(
+                    app_id, namespace,
+                    [(args_list[i].pod, args_list[i].node_names) for i in app_ids],
+                )
+            except ReservationError as exc:
+                for i in app_ids:
+                    finish(
+                        i, None, FAILURE_INTERNAL,
+                        f"error when looking for already bound reservations: {exc}",
+                    )
+                continue
+            for i, (kind, val) in zip(app_ids, rungs):
+                pod = args_list[i].pod
+                if kind == "already":
+                    finish(i, val, SUCCESS_ALREADY_BOUND)
+                elif kind == "bound":
+                    finish(i, val, SUCCESS)
+                elif kind == "no-spots":
+                    deferred_no_spots.setdefault(key, []).append(i)
+                elif kind == "dup-reschedule":
+                    # Same pod submitted twice in one window; resolved from
+                    # the first occurrence's result after the solve.
+                    dup_waiters.setdefault(
+                        (pod.namespace, pod.name), []
+                    ).append(i)
+                else:  # reschedule
+                    ctx = app_ctx.get(key)
+                    if ctx is None:
+                        ctx = app_ctx[key] = self._reschedule_context(pod)
+                    pod_key = (pod.namespace, pod.name)
+                    if ctx[0] is None:
+                        finish(i, None, FAILURE_INTERNAL, ctx[1])
+                        straggler_by_pod[pod_key] = {
+                            "result": ("internal", ctx[1])
+                        }
+                        continue
+                    exec_res, zone = ctx
+                    names = [
+                        n.name
+                        for name in args_list[i].node_names
+                        if (n := self._backend.get_node(name)) is not None
+                        and (zone is None or n.zone == zone)
+                    ]
+                    entry = {
+                        "i": i, "key": key, "exec_res": exec_res,
+                        "zone": zone, "names": names, "is_extra": not val,
+                        "result": None,
+                    }
+                    stragglers.append(entry)
+                    straggler_by_pod[pod_key] = entry
+        # Solve stragglers in ARRIVAL order: pack_window commits segment
+        # placements sequentially, so under capacity contention the earlier
+        # request must win the spot exactly as serial serving would.
+        stragglers.sort(key=lambda s: s["i"])
+
+        app_failed: set[tuple[str, str]] = set()
+        if stragglers:
+            from spark_scheduler_tpu.models.resources import Resources as _R
+
+            all_nodes = self._backend.list_nodes()
+            usage = self._rrm.reserved_usage()
+            overhead = self._overhead.get_overhead(all_nodes)
+            tensors = self._build_serving_tensors(all_nodes, usage, overhead)
+            decisions = self._solver.pack_window(
+                "tightly-pack",
+                tensors,
+                [
+                    WindowRequest(
+                        rows=[(_R.zero(), s["exec_res"], 1, False)],
+                        driver_candidate_names=s["names"],
+                        domain_node_names=s["names"],
+                    )
+                    for s in stragglers
+                ],
+            )
+            rescheduled = False
+            for s, d in zip(stragglers, decisions):
+                i = s["i"]
+                pod = args_list[i].pod
+                if d.admitted and d.packing.executor_nodes:
+                    node = d.packing.executor_nodes[0]
+                    try:
+                        self._rrm.reserve_for_executor_on_rescheduled_node(
+                            pod, node
+                        )
+                    except ReservationError as exc:
+                        msg = f"failed to reserve node for rescheduled executor: {exc}"
+                        finish(i, None, FAILURE_INTERNAL, msg)
+                        s["result"] = ("internal", msg)
+                        app_failed.add(s["key"])
+                        continue
+                    rescheduled = True
+                    s["result"] = ("ok", node)
+                    finish(
+                        i, node,
+                        SUCCESS_SCHEDULED_EXTRA_EXECUTOR
+                        if s["is_extra"]
+                        else SUCCESS_RESCHEDULED,
+                    )
+                else:
+                    if s["zone"] is not None:
+                        self._demands.create_demand_for_executor(
+                            pod, s["exec_res"], zone=s["zone"]
+                        )
+                    else:
+                        self._demands.create_demand_for_executor(
+                            pod, s["exec_res"]
+                        )
+                    s["result"] = ("fit", None)
+                    finish(
+                        i, None, FAILURE_FIT,
+                        "not enough capacity to reschedule the executor",
+                    )
+                    app_failed.add(s["key"])
+            if rescheduled:
+                # New usage on nodes the reservations did not cover: stale
+                # in-flight windows must re-solve (one bump covers the run).
+                self._capacity_epoch += 1
+
+        # Duplicate submissions resolve from their first occurrence: success
+        # means the bind has applied, so the serial rung 1 would now return
+        # already-bound; a failed first occurrence means the retry would
+        # re-attempt the identical reschedule and fail the identical way.
+        for pod_key, idxs in dup_waiters.items():
+            first = straggler_by_pod.get(pod_key)
+            result = first.get("result") if first is not None else None
+            for i in idxs:
+                if result is not None and result[0] == "ok":
+                    finish(i, result[1], SUCCESS_ALREADY_BOUND)
+                elif result is not None and result[0] == "internal":
+                    finish(i, None, FAILURE_INTERNAL, result[1])
+                else:
+                    finish(
+                        i, None, FAILURE_FIT,
+                        "not enough capacity to reschedule the executor",
+                    )
+
+        for key, idxs in deferred_no_spots.items():
+            ctx = app_ctx.get(key)
+            if ctx is not None and ctx[0] is None:
+                # Serial equivalence: the spot was only pre-consumed by an
+                # executor whose reschedule context failed (spot never
+                # actually used), so these would have re-attempted and hit
+                # the same internal error.
+                for i in idxs:
+                    finish(i, None, FAILURE_INTERNAL, ctx[1])
+            elif key in app_failed:
+                # Serial equivalence: the failed straggler left its spot
+                # unconsumed, so these executors would have re-attempted the
+                # identical reschedule and failed the identical way.
+                for i in idxs:
+                    pod = args_list[i].pod
+                    if ctx is not None and ctx[0] is not None:
+                        exec_res, zone = ctx
+                        if zone is not None:
+                            self._demands.create_demand_for_executor(
+                                pod, exec_res, zone=zone
+                            )
+                        else:
+                            self._demands.create_demand_for_executor(
+                                pod, exec_res
+                            )
+                    finish(
+                        i, None, FAILURE_FIT,
+                        "not enough capacity to reschedule the executor",
+                    )
+            else:
+                for i in idxs:
+                    finish(
+                        i, None, FAILURE_UNBOUND,
+                        "application has no free executor spots to schedule this one",
+                    )
+
+    def _reschedule_context(self, executor: Pod) -> tuple:
+        """Per-app context for reschedule stragglers: (exec_resources,
+        single-az zone restriction | None), or (None, error message)."""
+        driver = self._pod_lister.get_driver_for_executor(executor)
+        if driver is None:
+            return None, "failed to get driver pod for executor"
+        try:
+            app_resources = spark_resources(driver)
+        except SparkPodError as exc:
+            return None, str(exc)
+        zone = None
+        if (
+            self.binpacker.is_single_az
+            and self._config.schedule_dynamically_allocated_executors_in_same_az
+        ):
+            try:
+                z, all_same_az = self._common_zone_for_app(executor)
+            except ReservationError as exc:
+                return None, str(exc)
+            if all_same_az:
+                zone = z
+        return app_resources.executor_resources, zone
 
     def _select_executor_node(
         self, executor: Pod, node_names: list[str]

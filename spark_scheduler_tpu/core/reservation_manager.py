@@ -179,6 +179,131 @@ class ResourceReservationManager:
                             return chosen, len(unbound)
             return None, len(unbound)
 
+    def executor_ladder_batch(
+        self, app_id: str, namespace: str, items: list[tuple[Pod, list[str]]]
+    ) -> list[tuple[str, object]]:
+        """Rungs 1-2 of the executor binding ladder for EVERY executor of
+        one app in a serving window, in arrival order, under ONE mutex hold
+        with one reservation fetch, one active-pod listing, and one cache
+        write (the serial per-request ladder re-derived the active pod set
+        and re-wrote the reservation once per executor — the serving path's
+        host bottleneck at high executor arrival rates).
+
+        `items` = [(executor_pod, offered_node_names)]. Returns one rung per
+        executor, in order:
+          ("already", node)      idempotent retry: bound (hard or soft) on an
+                                 OFFERED node (resource.go:377-388)
+          ("bound", node)        bound to an unbound slot on an offered node
+                                 (resource.go:389-400)
+          ("reschedule", had_unbound)
+                                 a free spot exists and was pre-consumed from
+                                 the working view; the caller solves the
+                                 placement and applies the bind via
+                                 reserve_for_executor_on_rescheduled_node
+          ("dup-reschedule", None)
+                                 duplicate submission of a pod already
+                                 granted a reschedule in this batch — no
+                                 second spot is consumed; the caller resolves
+                                 it from the first occurrence's result (the
+                                 serial path's rung 1 would return
+                                 already-bound after the first bind applied)
+          ("no-spots", None)     no unbound slots, no free soft spots
+
+        Raises ReservationError when the app has no reservation or the
+        batched cache write fails — the caller fails the app's whole batch
+        failure-internal, as the solo rungs would.
+
+        Documented deviation from strict arrival serialization: a
+        reschedule's actual slot move (applied after the caller's grouped
+        solve) picks from the then-committed unbound map, which can be a
+        different — semantically equivalent — slot than a strict serial
+        interleaving would have moved (any unbound slot satisfies the
+        reservation; resourcereservations.go:202-225 itself picks
+        arbitrarily)."""
+        with self._mutex:
+            rr = self.get_resource_reservation(app_id, namespace)
+            if rr is None:
+                raise ReservationError("failed to get resource reservations")
+            active = self._get_active_pods(app_id, namespace)
+            # Working views — binds made earlier in this batch must be
+            # visible to later executors (duplicate submissions included).
+            bound_by_pod: dict[str, str] = {}
+            unbound: dict[str, str] = {}
+            for res_name, res in rr.spec.reservations.items():
+                pod_name = rr.status.pods.get(res_name)
+                pod = active.get(pod_name) if pod_name is not None else None
+                if (
+                    pod_name is None
+                    or pod is None
+                    or (pod.node_name and pod.node_name != res.node)
+                ):
+                    unbound[res_name] = res.node
+                if pod_name is not None:
+                    bound_by_pod[pod_name] = res.node
+            free_soft = self._get_free_soft_reservation_spots(app_id, namespace)
+            binds: list[tuple[str, str, str]] = []  # (pod, slot, node)
+            offered_sets: dict[int, frozenset] = {}
+            resched_pods: set[str] = set()
+            out: list[tuple[str, object]] = []
+            for executor, node_names in items:
+                offered = offered_sets.get(id(node_names))
+                if offered is None:
+                    offered = frozenset(node_names)
+                    offered_sets[id(node_names)] = offered
+                # Rung 1: already bound (hard slot or soft reservation).
+                node = bound_by_pod.get(executor.name)
+                if node is None:
+                    sr = self.soft_store.get_executor_soft_reservation(executor)
+                    if sr is not None:
+                        node = sr.node
+                if node is not None and node in offered:
+                    out.append(("already", node))
+                    continue
+                # Bound but not offered falls through (resource.go:377-388).
+                # Rung 2: first OFFERED candidate holding an unbound slot
+                # (node_names order, matching the solo rung exactly).
+                if unbound:
+                    values = set(unbound.values())
+                    chosen = next(
+                        (n for n in node_names if n in values), None
+                    )
+                    if chosen is not None:
+                        for res_name, res_node in unbound.items():
+                            if res_node == chosen:
+                                del unbound[res_name]
+                                break
+                        bound_by_pod[executor.name] = chosen
+                        binds.append((executor.name, res_name, chosen))
+                        out.append(("bound", chosen))
+                        continue
+                # Rung 3 classification: pre-consume a spot so later
+                # executors of this window see the serialized budget. A
+                # duplicate of a pod already granted a reschedule consumes
+                # nothing (serially it would find itself already bound).
+                if executor.name in resched_pods:
+                    out.append(("dup-reschedule", None))
+                    continue
+                had_unbound = bool(unbound)
+                if len(unbound) + free_soft > 0:
+                    if unbound:
+                        unbound.pop(next(iter(unbound)))
+                    else:
+                        free_soft -= 1
+                    resched_pods.add(executor.name)
+                    out.append(("reschedule", had_unbound))
+                else:
+                    out.append(("no-spots", None))
+            if binds:
+                updated = rr.copy()
+                for pod_name, res_name, node in binds:
+                    updated.spec.reservations[res_name].node = node
+                    updated.status.pods[res_name] = pod_name
+                if not self.rr_cache.update(updated):
+                    raise ReservationError(
+                        "failed to update resource reservation"
+                    )
+            return out
+
     def reserve_for_executor_on_rescheduled_node(
         self, executor: Pod, node: str
     ) -> None:

@@ -435,3 +435,215 @@ def test_http_concurrent_requests_are_batched():
         assert hist and hist[0].get("count", 0) >= 1, snap
     finally:
         server.stop()
+
+
+# ------------------------------------------------- batched executor ladder
+
+
+def _exec_equivalence(build_scenario, strategy="tightly-pack", n_nodes=8,
+                      same_az_da=False):
+    """Run `build_scenario(h) -> list[ExtenderArgs]` on two identical
+    harnesses; serve the returned executor window via predicate_batch on
+    one and via solo predicate() in the same order on the other; assert
+    outcomes, nodes, and reservation state match."""
+    hs = []
+    for _ in range(2):
+        h = Harness(
+            binpack_algo=strategy,
+            fifo=True,
+            same_az_dynamic_allocation=same_az_da,
+        )
+        h.add_nodes(
+            *[new_node(f"n{i}", zone=f"zone{i % 2}") for i in range(n_nodes)]
+        )
+        hs.append(h)
+    h_win, h_seq = hs
+    win_args = build_scenario(h_win)
+    seq_args = build_scenario(h_seq)
+    win_results = h_win.extender.predicate_batch(win_args)
+    seq_results = [h_seq.extender.predicate(a) for a in seq_args]
+    assert len(win_results) == len(seq_results)
+    for k, (w, s) in enumerate(zip(win_results, seq_results)):
+        assert w.outcome == s.outcome, f"request {k}: {w.outcome} != {s.outcome}"
+        assert w.node_names == s.node_names, f"request {k} node"
+    apps = {
+        (a.pod.namespace, a.pod.labels.get("spark-app-id", ""))
+        for a in win_args
+    }
+    for ns, app_id in apps:
+        rr_w = h_win.get_reservation(ns, app_id)
+        rr_s = h_seq.get_reservation(ns, app_id)
+        assert (rr_w is None) == (rr_s is None), app_id
+        if rr_w is not None:
+            assert {
+                k: v.node for k, v in rr_w.spec.reservations.items()
+            } == {k: v.node for k, v in rr_s.spec.reservations.items()}, app_id
+            assert rr_w.status.pods == rr_s.status.pods, app_id
+    return h_win, h_seq
+
+
+def test_executor_window_binds_match_sequential():
+    """A window of executors binding onto their app's unbound reservations
+    (the rung-2 hot path) + one over-count straggler -> failure-unbound."""
+    names = [f"n{i}" for i in range(8)]
+
+    def scenario(h):
+        pods = static_allocation_spark_pods("xw-app", 4)
+        h.schedule(pods[0], names)  # driver reserves 4 executor slots
+        for p in pods[1:]:
+            h.add_pods(p)
+        extra = static_allocation_spark_pods("xw-app", 5)[5]
+        h.add_pods(extra)
+        return [
+            ExtenderArgs(pod=p, node_names=list(names))
+            for p in pods[1:] + [extra]
+        ]
+
+    _exec_equivalence(scenario)
+
+
+def test_executor_window_reschedule_group_matches_sequential():
+    """Executors whose reserved nodes are NOT offered (kube-scheduler
+    filtered them) reschedule via ONE grouped solve; decisions must match
+    solving them one at a time."""
+    def scenario(h):
+        pods = static_allocation_spark_pods("xr-app", 3)
+        h.schedule(pods[0], [f"n{i}" for i in range(4)])  # reserve on n0-n3
+        for p in pods[1:]:
+            h.add_pods(p)
+        # Offer ONLY nodes outside the reservation footprint.
+        offered = ["n4", "n5", "n6", "n7"]
+        return [
+            ExtenderArgs(pod=p, node_names=list(offered)) for p in pods[1:]
+        ]
+
+    h_win, _ = _exec_equivalence(scenario)
+    # The grouped path actually rescheduled (not bound to original slots).
+    rr = h_win.get_reservation("namespace", "xr-app")
+    rescheduled_nodes = {
+        v.node for k, v in rr.spec.reservations.items() if k != "driver"
+    }
+    assert rescheduled_nodes <= {"n4", "n5", "n6", "n7"}
+
+
+def test_executor_window_dynamic_allocation_extras_match_sequential():
+    """Dynamic-allocation window: min executors bind hard slots, extras get
+    soft reservations, over-max fails — all in one window."""
+    from spark_scheduler_tpu.testing.harness import (
+        dynamic_allocation_spark_pods,
+    )
+
+    names = [f"n{i}" for i in range(8)]
+
+    def scenario(h):
+        pods = dynamic_allocation_spark_pods("xd-app", 2, 4)
+        h.schedule(pods[0], names)  # 2 hard slots + up to 2 soft
+        execs = pods[1:] + [dynamic_allocation_spark_pods("xd-app", 2, 5)[5]]
+        for p in execs:
+            h.add_pods(p)
+        return [ExtenderArgs(pod=p, node_names=list(names)) for p in execs]
+
+    h_win, h_seq = _exec_equivalence(scenario)
+    for h in (h_win, h_seq):
+        sr, ok = h.app.soft_store.get_soft_reservation("xd-app")
+        assert ok and len(sr.reservations) == 2, sr.reservations if ok else ok
+
+
+def test_executor_window_mixed_apps_interleaved():
+    """Executors of several apps interleaved in one window group per app
+    without cross-talk."""
+    names = [f"n{i}" for i in range(8)]
+
+    def scenario(h):
+        args = []
+        pods_by_app = {}
+        for a in range(3):
+            pods = static_allocation_spark_pods(f"xm-{a}", 2)
+            h.schedule(pods[0], names)
+            pods_by_app[a] = pods
+            for p in pods[1:]:
+                h.add_pods(p)
+        for k in range(2):
+            for a in range(3):
+                args.append(
+                    ExtenderArgs(
+                        pod=pods_by_app[a][1 + k], node_names=list(names)
+                    )
+                )
+        return args
+
+    _exec_equivalence(scenario)
+
+
+def test_executor_window_contention_preserves_arrival_order():
+    """Under capacity contention, reschedule stragglers must win spots in
+    ARRIVAL order across apps — window [a1, b1, a2] with room for exactly
+    two executors gives the spots to a1 and b1, like serial serving."""
+    names = [f"n{i}" for i in range(8)]
+
+    def scenario(h):
+        # Fill n7 so exactly 2 executors (1cpu/1Gi each) still fit.
+        filler = static_allocation_spark_pods("xc-filler", 5)
+        h.schedule(filler[0], ["n7"])
+        for p in filler[1:]:
+            h.schedule(p, ["n7"])
+        a = static_allocation_spark_pods("xc-a", 2)
+        b = static_allocation_spark_pods("xc-b", 1)
+        h.schedule(a[0], names[:4])
+        h.schedule(b[0], names[:4])
+        for p in a[1:] + b[1:]:
+            h.add_pods(p)
+        # Offer ONLY the nearly-full node: every executor needs a reschedule.
+        return [
+            ExtenderArgs(pod=a[1], node_names=["n7"]),
+            ExtenderArgs(pod=b[1], node_names=["n7"]),
+            ExtenderArgs(pod=a[2], node_names=["n7"]),
+        ]
+
+    h_win, _ = _exec_equivalence(scenario)
+
+
+def test_executor_window_duplicate_submission_single_spot():
+    """The same executor pod twice in one window (client retry coalesced):
+    one reschedule, the retry resolves already-bound, ONE spot consumed."""
+    names = [f"n{i}" for i in range(8)]
+
+    def scenario(h):
+        pods = static_allocation_spark_pods("xdup-app", 1)
+        h.schedule(pods[0], names[:2])  # reserve on n0/n1
+        h.add_pods(pods[1])
+        offered = ["n4", "n5"]
+        return [
+            ExtenderArgs(pod=pods[1], node_names=list(offered)),
+            ExtenderArgs(pod=pods[1], node_names=list(offered)),
+        ]
+
+    h_win, h_seq = _exec_equivalence(scenario)
+    for h in (h_win, h_seq):
+        rr = h.get_reservation("namespace", "xdup-app")
+        bound = [
+            k for k, v in rr.status.pods.items() if v == "xdup-app-exec-1"
+        ]
+        assert len(bound) == 1, rr.status.pods
+
+
+def test_executor_window_driverless_reschedule_fails_internal():
+    """Reschedule context failure (driver pod gone) fails ALL the app's
+    spot-seeking executors failure-internal — including one classified
+    no-spots by the pre-consumed budget — matching serial serving."""
+    names = [f"n{i}" for i in range(8)]
+
+    def scenario(h):
+        pods = static_allocation_spark_pods("xgone-app", 1)
+        h.schedule(pods[0], names[:2])
+        h.add_pods(pods[1])
+        dup = static_allocation_spark_pods("xgone-app", 2)[2]
+        h.add_pods(dup)
+        h.delete_pod(pods[0])  # driver vanishes
+        offered = ["n4", "n5"]
+        return [
+            ExtenderArgs(pod=pods[1], node_names=list(offered)),
+            ExtenderArgs(pod=dup, node_names=list(offered)),
+        ]
+
+    _exec_equivalence(scenario)
