@@ -256,13 +256,15 @@ def run() -> dict:
 
     # -- Pallas SEGMENTED WINDOW path on silicon (VERDICT r3 #3): the
     #    scan-over-segments Mosaic program must equal the segmented XLA
-    #    scan decision-for-decision for every plain fill.
+    #    scan decision-for-decision for all six strategies (plain fills,
+    #    and since r5 the single-AZ wrappers through make_gang_solver).
     if pallas_available():
         from tests.test_pallas_window import _cluster as _pw_cluster
         from tests.test_pallas_window import _random_window as _pw_window
+        from spark_scheduler_tpu.ops.pallas_fifo import PALLAS_SINGLE_AZ
         from spark_scheduler_tpu.ops.pallas_window import window_pack_pallas
 
-        for fill in PALLAS_FILLS:
+        for fill in PALLAS_FILLS + tuple(PALLAS_SINGLE_AZ):
             prng = np.random.default_rng(97 + len(fill))
             c = _pw_cluster(prng, N_NODES)
             apps, win, flat_map = _pw_window(

@@ -180,6 +180,7 @@ def main(argv=None) -> int:
             CacheReporter(
                 registry,
                 {"resourcereservations": app.rr_cache, "demands": app.demand_cache},
+                backend=backend,
             ),
             SoftReservationReporter(registry, app.soft_store),
             QueueReporter(registry, backend, config.instance_group_label),
@@ -196,6 +197,7 @@ def main(argv=None) -> int:
         client_ca_files=config.client_ca_files,
         request_timeout_s=config.request_timeout_s,
         debug_routes=config.debug_routes,
+        request_log=config.request_log,
     )
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)

@@ -959,6 +959,7 @@ class SparkSchedulerExtender:
         stragglers.sort(key=lambda s: s["i"])
 
         app_failed: set[tuple[str, str]] = set()
+        app_internal: dict[tuple[str, str], str] = {}
         if stragglers:
             from spark_scheduler_tpu.models.resources import Resources as _R
 
@@ -992,7 +993,11 @@ class SparkSchedulerExtender:
                         msg = f"failed to reserve node for rescheduled executor: {exc}"
                         finish(i, None, FAILURE_INTERNAL, msg)
                         s["result"] = ("internal", msg)
-                        app_failed.add(s["key"])
+                        # NOT app_failed: capacity exists (the solve
+                        # admitted); a serial re-attempt by a later same-app
+                        # executor would hit the same write failure, so
+                        # those fail internal below, not failure-fit.
+                        app_internal[s["key"]] = msg
                         continue
                     rescheduled = True
                     s["result"] = ("ok", node)
@@ -1024,14 +1029,24 @@ class SparkSchedulerExtender:
 
         # Duplicate submissions resolve from their first occurrence: success
         # means the bind has applied, so the serial rung 1 would now return
-        # already-bound; a failed first occurrence means the retry would
-        # re-attempt the identical reschedule and fail the identical way.
+        # already-bound (only for an OFFERED node — rung 1 checks the
+        # request's own candidates; a non-offered node fails unbound, a
+        # conservative stand-in for the serial path's rebind-on-new-spot,
+        # and the client's next retry walks the full ladder); a failed
+        # first occurrence means the retry would re-attempt the identical
+        # reschedule and fail the identical way.
         for pod_key, idxs in dup_waiters.items():
             first = straggler_by_pod.get(pod_key)
             result = first.get("result") if first is not None else None
             for i in idxs:
                 if result is not None and result[0] == "ok":
-                    finish(i, result[1], SUCCESS_ALREADY_BOUND)
+                    if result[1] in args_list[i].node_names:
+                        finish(i, result[1], SUCCESS_ALREADY_BOUND)
+                    else:
+                        finish(
+                            i, None, FAILURE_UNBOUND,
+                            "application has no free executor spots to schedule this one",
+                        )
                 elif result is not None and result[0] == "internal":
                     finish(i, None, FAILURE_INTERNAL, result[1])
                 else:
@@ -1049,6 +1064,12 @@ class SparkSchedulerExtender:
                 # the same internal error.
                 for i in idxs:
                     finish(i, None, FAILURE_INTERNAL, ctx[1])
+            elif key in app_internal:
+                # The spot was freed by a reservation-write failure, not a
+                # capacity shortage — a serial re-attempt hits the same
+                # write failure.
+                for i in idxs:
+                    finish(i, None, FAILURE_INTERNAL, app_internal[key])
             elif key in app_failed:
                 # Serial equivalence: the failed straggler left its spot
                 # unconsumed, so these executors would have re-attempted the

@@ -26,6 +26,9 @@ LIFECYCLE_P50 = "foundry.spark.scheduler.pod.lifecycle.p50"
 LIFECYCLE_COUNT = "foundry.spark.scheduler.pod.lifecycle.count"
 CACHED_OBJECTS = "foundry.spark.scheduler.cache.objects.count"
 INFLIGHT_REQUESTS = "foundry.spark.scheduler.cache.inflight.count"
+UNEXPLAINED_DIFFERENCE = "foundry.spark.scheduler.cache.unexplained.difference"
+# Size skew explained by informer propagation delay (cache.go:33-34).
+INFORMER_DELAY_BUFFER = 5
 SOFT_RESERVATION_COUNT = "foundry.spark.scheduler.softreservation.count"
 SOFT_RESERVATION_EXECUTORS = "foundry.spark.scheduler.softreservation.executorcount"
 
@@ -56,22 +59,92 @@ class UsageReporter:
 
 
 class CacheReporter:
-    """Cache depth vs backend truth + inflight write-queue lengths
-    (metrics/cache.go:32-141)."""
+    """Cache depth vs backend truth + inflight write-queue lengths + drift
+    detection (metrics/cache.go:32-141).
 
-    def __init__(self, registry: MetricRegistry, caches: dict[str, object]):
+    With a `backend`, each tick also lists the backend's truth for every
+    cached type and compares: a size skew larger than the inflight write
+    queue plus the informer-delay buffer is UNEXPLAINED — exactly the
+    failure mode the async fire-and-forget write path can produce — and is
+    surfaced as a warning (with per-object only-in-cache / only-in-backend
+    lines, cache.go:115-127) plus the `cache.unexplained.difference`
+    gauge."""
+
+    def __init__(
+        self,
+        registry: MetricRegistry,
+        caches: dict[str, object],
+        backend=None,
+    ):
         self._registry = registry
         self._caches = caches  # {object_type: WriteThroughCache}
+        self._backend = backend
 
     def report_once(self) -> None:
+        from spark_scheduler_tpu.tracing import svc1log
+
         for obj_type, cache in self._caches.items():
-            self._registry.gauge(CACHED_OBJECTS, objectType=obj_type).set(
-                len(cache.list())
-            )
+            crd_gate = getattr(cache, "crd_exists", None)
+            if crd_gate is not None and not crd_gate():
+                continue  # SafeDemandCache before the CRD appears
+            cached = cache.list()
+            self._registry.gauge(
+                CACHED_OBJECTS, objectType=obj_type, source="cache"
+            ).set(len(cached))
+            total_queue = 0
             for i, depth in enumerate(cache.queue_lengths()):
+                total_queue += depth
                 self._registry.gauge(
                     INFLIGHT_REQUESTS, objectType=obj_type, queueIndex=str(i)
                 ).set(depth)
+            if self._backend is None:
+                continue
+            try:
+                actual = self._backend.list(obj_type)
+            except Exception as exc:
+                svc1log().error(
+                    "failed to list backend objects for cache drift check",
+                    objectType=obj_type, error=repr(exc),
+                )
+                continue
+            self._registry.gauge(
+                CACHED_OBJECTS, objectType=obj_type, source="lister"
+            ).set(len(actual))
+            skew = abs(len(actual) - len(cached))
+            unexplained = skew > total_queue + INFORMER_DELAY_BUFFER
+            self._registry.gauge(
+                UNEXPLAINED_DIFFERENCE, objectType=obj_type
+            ).set(skew if unexplained else 0)
+            if unexplained:
+                svc1log().warn(
+                    "found unexplained cache size difference",
+                    objectType=obj_type,
+                    cached=len(cached), actual=len(actual),
+                    inflight=total_queue,
+                )
+                def _key(obj):
+                    return getattr(obj, "uid", None) or (
+                        getattr(obj, "namespace", ""), getattr(obj, "name", "")
+                    )
+
+                cached_keys = {_key(o) for o in cached}
+                actual_keys = {_key(o) for o in actual}
+                for obj in actual:
+                    if _key(obj) not in cached_keys:
+                        svc1log().warn(
+                            "object only exists in backend",
+                            objectType=obj_type,
+                            name=getattr(obj, "name", ""),
+                            namespace=getattr(obj, "namespace", ""),
+                        )
+                for obj in cached:
+                    if _key(obj) not in actual_keys:
+                        svc1log().warn(
+                            "object only exists in cache",
+                            objectType=obj_type,
+                            name=getattr(obj, "name", ""),
+                            namespace=getattr(obj, "namespace", ""),
+                        )
 
 
 class SoftReservationReporter:

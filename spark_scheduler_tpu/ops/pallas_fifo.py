@@ -260,6 +260,156 @@ def make_fill_runner(
     return run_fill
 
 
+def make_gang_solver(
+    fill: str,
+    *,
+    num_zones: int,
+    emax: int,
+    n_pad: int,
+    shape,
+    count,
+    cap_e,
+    cap_wd,
+    fit_d,
+    elig_e,
+    elig_d,
+    drank,
+    key,
+    node_val,
+    slot_iota,
+    zone,
+    sched3,
+    avail3,
+    dreq3,
+    ereq3,
+):
+    """THE per-gang solve shared by BOTH Mosaic kernels (queue and
+    segmented-window): driver selection + executor fill for the plain
+    fills, and for the single-AZ wrappers the per-zone pack,
+    efficiency-scored strictly-greater zone pick, and az-aware plain
+    fallback (single_az.go:23-97 / az_aware_pack_tightly.go:27-38) —
+    ONE implementation so the two kernels cannot drift.
+
+    `key`/`node_val` parameterize the priority walk exactly as
+    make_fill_runner documents (position iota for the pre-permuted queue
+    kernel, the per-segment executor rank for the window kernel);
+    `zone`/`sched3`/`avail3` feed the zone loop and its efficiency scoring
+    (`sched3`/`avail3`/`dreq3`/`ereq3` are per-dim reads hoisted by the
+    caller — nothing here mutates between zones).
+
+    Returns ``solve() -> (ok, is_drv, execs_row, exec_counts,
+    driver_node)``."""
+    INF = INT32_INF
+    single_az = fill in PALLAS_SINGLE_AZ
+    if single_az:
+        inner_fill, az_fallback, include_exec_in_reserved = (
+            PALLAS_SINGLE_AZ[fill]
+        )
+    else:
+        inner_fill, az_fallback, include_exec_in_reserved = fill, False, True
+
+    select_driver = make_driver_selector(
+        count, cap_e, cap_wd, fit_d, elig_d, drank
+    )
+    run_fill = make_fill_runner(
+        inner_fill, emax, n_pad, shape, count, key, node_val, slot_iota
+    )
+
+    def solve():
+        if not single_az:
+            found, is_drv, caps_fill = select_driver(
+                jnp.ones(shape, jnp.bool_)
+            )
+            ok = found  # the feasibility identity guarantees the fill
+            execs_row, exec_counts = run_fill(ok, caps_fill, elig_e)
+        else:
+            # --- per-zone pack + strictly-greater efficiency selection
+            # (single_az.go:23-97). Zone "first appearance" rank in driver
+            # priority order breaks efficiency ties (single_az.go:58-73);
+            # zones with no executor-eligible node are skipped
+            # (single_az.go:40-43).
+            best_eff = jnp.float32(-1.0)
+            best_first = jnp.int32(INF)
+            any_valid = jnp.bool_(False)
+            is_drv = jnp.zeros(shape, jnp.bool_)
+            execs_row = jnp.full((1, emax), -1, jnp.int32)
+            exec_counts = jnp.zeros(shape, jnp.int32)
+            for z in range(num_zones):
+                zmask = zone == z
+                zone_first = jnp.min(
+                    jnp.where(elig_d & zmask, drank, INF)
+                )
+                zone_has_exec = jnp.any(elig_e & zmask)
+                found_z, is_drv_z, caps_z = select_driver(zmask)
+                execs_z, counts_z = run_fill(
+                    found_z, caps_z, elig_e & zmask
+                )
+                # Zone score: mean over ENTRIES (driver + one per executor
+                # occurrence) of per-node max dim efficiency with the
+                # tentative reservation applied (efficiency.go:85-144).
+                w = counts_z + is_drv_z
+                eff_cpu = jnp.zeros(shape, jnp.float32)
+                eff_mem = jnp.zeros(shape, jnp.float32)
+                eff_gpu = jnp.zeros(shape, jnp.float32)
+                for d in range(3):
+                    sched_d = sched3[d]
+                    new_res = jnp.where(is_drv_z, dreq3[d], 0)
+                    if include_exec_in_reserved:
+                        new_res = new_res + counts_z * ereq3[d]
+                    reserved = (sched_d - avail3[d]) + new_res
+                    denom = jnp.maximum(sched_d, 1).astype(jnp.float32)
+                    eff_d = reserved.astype(jnp.float32) / denom
+                    if d == 0:
+                        eff_cpu = eff_d
+                    elif d == 1:
+                        eff_mem = eff_d
+                    else:
+                        gpu_node = sched_d != 0
+                        eff_gpu = jnp.where(gpu_node, eff_d, 0.0)
+                node_max = jnp.maximum(
+                    eff_gpu, jnp.maximum(eff_cpu, eff_mem)
+                )
+                entries = (count + 1).astype(jnp.float32)
+                eff_z = (
+                    jnp.sum(node_max * w.astype(jnp.float32)) / entries
+                )
+                valid_z = found_z & (zone_first < INF) & zone_has_exec
+                better = valid_z & (
+                    (eff_z > best_eff)
+                    | ((eff_z == best_eff) & (zone_first < best_first))
+                )
+                best_eff = jnp.where(better, eff_z, best_eff)
+                best_first = jnp.where(better, zone_first, best_first)
+                any_valid = any_valid | valid_z
+                is_drv = (is_drv_z & better) | (is_drv & ~better)
+                execs_row = jnp.where(better, execs_z, execs_row)
+                exec_counts = jnp.where(better, counts_z, exec_counts)
+            # chooseBestResult starts from WorstAvgPackingEfficiency
+            # (Max=0.0) and replaces only on strictly-greater, so a zone
+            # whose best efficiency is exactly 0.0 is rejected entirely
+            # (single_az.go:84-97).
+            ok = any_valid & (best_eff > 0.0)
+            if az_fallback:
+                # az-aware: plain pack when no single zone fits
+                # (az_aware_pack_tightly.go:27-38).
+                found_p, is_drv_p, caps_p = select_driver(
+                    jnp.ones(shape, jnp.bool_)
+                )
+                execs_p, counts_p = run_fill(found_p, caps_p, elig_e)
+                use_p = ~ok & found_p
+                is_drv = (is_drv_p & use_p) | (is_drv & ~use_p)
+                execs_row = jnp.where(use_p, execs_p, execs_row)
+                exec_counts = jnp.where(use_p, counts_p, exec_counts)
+                ok = ok | found_p
+            is_drv = is_drv & ok
+            execs_row = jnp.where(ok, execs_row, -1)
+            exec_counts = jnp.where(ok, exec_counts, 0)
+        driver_node = jnp.sum(jnp.where(is_drv, node_val, 0))
+        return ok, is_drv, execs_row, exec_counts, driver_node
+
+    return solve
+
+
 def _make_kernel(
     fill: str,
     emax: int,
@@ -280,18 +430,12 @@ def _make_kernel(
     zone's positions), scores each feasible zone's average packing
     efficiency against the live availability, and keeps the
     strictly-greatest (ties to the zone appearing first in driver
-    priority order) — single_az.go:23-97 semantics, entirely in-kernel."""
+    priority order) — single_az.go:23-97 semantics, entirely in-kernel
+    (make_gang_solver, shared with the segmented-window kernel)."""
 
     INF = INT32_INF
     cols = n_pad // rows
     shape = (rows, cols)
-    single_az = fill in PALLAS_SINGLE_AZ
-    if single_az:
-        inner_fill, az_fallback, include_exec_in_reserved = (
-            PALLAS_SINGLE_AZ[fill]
-        )
-    else:
-        inner_fill, az_fallback, include_exec_in_reserved = fill, False, True
 
     def kernel(
         dreq_ref,  # SMEM [B, 3] i32 — driver request
@@ -360,107 +504,22 @@ def _make_kernel(
         cap_e = jnp.where(elig_e, jnp.maximum(cap_e, 0), 0)
         cap_wd = jnp.where(elig_e, jnp.maximum(cap_wd, 0), 0)
 
-        select_driver = make_driver_selector(
-            count, cap_e, cap_wd, fit_d, elig_d, drank
-        )
         slot_iota = jax.lax.broadcasted_iota(jnp.int32, (1, emax), 1)
         # The queue kernel's node axis is pre-permuted into executor
         # priority order, so the priority KEY is the position itself.
-        run_fill = make_fill_runner(
-            inner_fill, emax, n_pad, shape, count, iota, node_id, slot_iota
+        solve = make_gang_solver(
+            fill,
+            num_zones=num_zones, emax=emax, n_pad=n_pad, shape=shape,
+            count=count, cap_e=cap_e, cap_wd=cap_wd, fit_d=fit_d,
+            elig_e=elig_e, elig_d=elig_d, drank=drank,
+            key=iota, node_val=node_id, slot_iota=slot_iota,
+            zone=zone_ref[:],
+            sched3=[sched_ref[0], sched_ref[1], sched_ref[2]],
+            avail3=[avail_scr[0], avail_scr[1], avail_scr[2]],
+            dreq3=[dreq_ref[b, 0], dreq_ref[b, 1], dreq_ref[b, 2]],
+            ereq3=[ereq_ref[b, 0], ereq_ref[b, 1], ereq_ref[b, 2]],
         )
-
-        if not single_az:
-            found, is_drv, caps_fill = select_driver(
-                jnp.ones(shape, jnp.bool_)
-            )
-            ok = found  # the feasibility identity guarantees the fill
-            execs_row, exec_counts = run_fill(ok, caps_fill, elig_e)
-            driver_node = jnp.sum(jnp.where(is_drv, node_id, 0))
-        else:
-            # --- per-zone pack + strictly-greater efficiency selection
-            # (single_az.go:23-97). Zone "first appearance" rank in driver
-            # priority order breaks efficiency ties (single_az.go:58-73);
-            # zones with no executor-eligible node are skipped
-            # (single_az.go:40-43).
-            zone_pos = zone_ref[:]
-            best_eff = jnp.float32(-1.0)
-            best_first = jnp.int32(INF)
-            any_valid = jnp.bool_(False)
-            is_drv = jnp.zeros(shape, jnp.bool_)
-            execs_row = jnp.full((1, emax), -1, jnp.int32)
-            exec_counts = jnp.zeros(shape, jnp.int32)
-            for z in range(num_zones):
-                zmask = zone_pos == z
-                zone_first = jnp.min(
-                    jnp.where(elig_d & zmask, drank, INF)
-                )
-                zone_has_exec = jnp.any(elig_e & zmask)
-                found_z, is_drv_z, caps_z = select_driver(zmask)
-                execs_z, counts_z = run_fill(
-                    found_z, caps_z, elig_e & zmask
-                )
-                # Zone score: mean over ENTRIES (driver + one per executor
-                # occurrence) of per-node max dim efficiency with the
-                # tentative reservation applied (efficiency.go:85-144).
-                w = counts_z + is_drv_z
-                eff_cpu = jnp.zeros(shape, jnp.float32)
-                eff_mem = jnp.zeros(shape, jnp.float32)
-                eff_gpu = jnp.zeros(shape, jnp.float32)
-                for d in range(3):
-                    sched_d = sched_ref[d]
-                    new_res = jnp.where(
-                        is_drv_z, dreq_ref[b, d], 0
-                    )
-                    if include_exec_in_reserved:
-                        new_res = new_res + counts_z * ereq_ref[b, d]
-                    reserved = (sched_d - avail_scr[d]) + new_res
-                    denom = jnp.maximum(sched_d, 1).astype(jnp.float32)
-                    eff_d = reserved.astype(jnp.float32) / denom
-                    if d == 0:
-                        eff_cpu = eff_d
-                    elif d == 1:
-                        eff_mem = eff_d
-                    else:
-                        gpu_node = sched_d != 0
-                        eff_gpu = jnp.where(gpu_node, eff_d, 0.0)
-                node_max = jnp.maximum(eff_gpu, jnp.maximum(eff_cpu, eff_mem))
-                entries = (count + 1).astype(jnp.float32)
-                eff_z = (
-                    jnp.sum(node_max * w.astype(jnp.float32)) / entries
-                )
-                valid_z = found_z & (zone_first < INF) & zone_has_exec
-                better = valid_z & (
-                    (eff_z > best_eff)
-                    | ((eff_z == best_eff) & (zone_first < best_first))
-                )
-                best_eff = jnp.where(better, eff_z, best_eff)
-                best_first = jnp.where(better, zone_first, best_first)
-                any_valid = any_valid | valid_z
-                is_drv = (is_drv_z & better) | (is_drv & ~better)
-                execs_row = jnp.where(better, execs_z, execs_row)
-                exec_counts = jnp.where(better, counts_z, exec_counts)
-            # chooseBestResult starts from WorstAvgPackingEfficiency
-            # (Max=0.0) and replaces only on strictly-greater, so a zone
-            # whose best efficiency is exactly 0.0 is rejected entirely
-            # (single_az.go:84-97).
-            ok = any_valid & (best_eff > 0.0)
-            if az_fallback:
-                # az-aware: plain pack when no single zone fits
-                # (az_aware_pack_tightly.go:27-38).
-                found_p, is_drv_p, caps_p = select_driver(
-                    jnp.ones(shape, jnp.bool_)
-                )
-                execs_p, counts_p = run_fill(found_p, caps_p, elig_e)
-                use_p = ~ok & found_p
-                is_drv = (is_drv_p & use_p) | (is_drv & ~use_p)
-                execs_row = jnp.where(use_p, execs_p, execs_row)
-                exec_counts = jnp.where(use_p, counts_p, exec_counts)
-                ok = ok | found_p
-            is_drv = is_drv & ok
-            execs_row = jnp.where(ok, execs_row, -1)
-            exec_counts = jnp.where(ok, exec_counts, 0)
-            driver_node = jnp.sum(jnp.where(is_drv, node_id, 0))
+        ok, is_drv, execs_row, exec_counts, driver_node = solve()
 
         packed = ok & valid & ~too_big
         admitted = packed & ~blocked_in

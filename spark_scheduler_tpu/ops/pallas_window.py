@@ -47,11 +47,11 @@ from spark_scheduler_tpu.ops.packing import _rank_of_position
 from spark_scheduler_tpu.ops.sorting import priority_order, zone_ranks
 from spark_scheduler_tpu.ops.pallas_fifo import (
     PALLAS_FILLS,
+    PALLAS_SINGLE_AZ,
     _LANES,
     _layout_rows,
     _round_up,
-    make_driver_selector,
-    make_fill_runner,
+    make_gang_solver,
     pallas_available,
 )
 
@@ -82,14 +82,17 @@ class SegmentedWindow(NamedTuple):
     domain: jnp.ndarray  # [S, N] bool — the request's affinity domain
 
 
-def _make_window_kernel(fill: str, emax: int, n_pad: int, rows: int):
+def _make_window_kernel(
+    fill: str, emax: int, n_pad: int, rows: int, *, num_zones: int = 0
+):
     """Per-SEGMENT row walk in NODE order with rank-key argmins.
 
     Mirrors ops/pallas_fifo._make_kernel's math (capacities, driver
-    feasibility identity, the three executor fills, strict-FIFO blocking)
-    with two deltas: positions are node indices (no pre-permutation), and
-    every priority walk keys on the segment's rank tensors (drank/erank)
-    instead of position order."""
+    feasibility identity, the three executor fills, the single-AZ zone
+    loop — all through the shared make_gang_solver — and strict-FIFO
+    blocking) with two deltas: positions are node indices (no
+    pre-permutation), and every priority walk keys on the segment's rank
+    tensors (drank/erank) instead of position order."""
 
     INF = INT32_INF
     cols = n_pad // rows
@@ -105,6 +108,8 @@ def _make_window_kernel(fill: str, emax: int, n_pad: int, rows: int):
         elig_d_ref,  # VMEM [rows, cols] i32
         drank_ref,  # VMEM [rows, cols] i32 — driver priority rank per node
         erank_ref,  # VMEM [rows, cols] i32 — executor priority rank per node
+        zone_ref,  # VMEM [rows, cols] i32 — zone id per node (single-AZ)
+        sched_ref,  # VMEM [3, rows, cols] i32 — schedulable (single-AZ)
         meta_out,  # VMEM [R, 4] i32
         execs_out,  # VMEM [R, emax] i32 (node ids)
         avail_scr,  # VMEM [3, rows, cols] i32 scratch
@@ -158,20 +163,24 @@ def _make_window_kernel(fill: str, emax: int, n_pad: int, rows: int):
         cap_e = jnp.where(elig_e, jnp.maximum(cap_e, 0), 0)
         cap_wd = jnp.where(elig_e, jnp.maximum(cap_wd, 0), 0)
 
-        # Shared gang math (ops/pallas_fifo): the ONE driver-selection and
-        # executor-fill implementation, keyed here on the segment's rank
-        # tensors instead of the queue kernel's pre-permuted positions.
-        select_driver = make_driver_selector(
-            count, cap_e, cap_wd, fit_d, elig_d, drank
-        )
-        found, is_drv, caps_fill = select_driver(jnp.ones(shape, jnp.bool_))
-        driver_node = jnp.sum(jnp.where(is_drv, iota, 0))
+        # Shared gang math (ops/pallas_fifo.make_gang_solver): the ONE
+        # driver-selection / executor-fill / single-AZ-zone-pick
+        # implementation, keyed here on the segment's rank tensors instead
+        # of the queue kernel's pre-permuted positions.
         slot_iota = jax.lax.broadcasted_iota(jnp.int32, (1, emax), 1)
-        run_fill = make_fill_runner(
-            fill, emax, n_pad, shape, count, erank, iota, slot_iota
+        solve = make_gang_solver(
+            fill,
+            num_zones=num_zones, emax=emax, n_pad=n_pad, shape=shape,
+            count=count, cap_e=cap_e, cap_wd=cap_wd, fit_d=fit_d,
+            elig_e=elig_e, elig_d=elig_d, drank=drank,
+            key=erank, node_val=iota, slot_iota=slot_iota,
+            zone=zone_ref[:],
+            sched3=[sched_ref[0], sched_ref[1], sched_ref[2]],
+            avail3=[avail_scr[0], avail_scr[1], avail_scr[2]],
+            dreq3=[dreq_ref[b, 0], dreq_ref[b, 1], dreq_ref[b, 2]],
+            ereq3=[ereq_ref[b, 0], ereq_ref[b, 1], ereq_ref[b, 2]],
         )
-        ok = found
-        execs_row, exec_counts = run_fill(ok, caps_fill, elig_e)
+        ok, is_drv, execs_row, exec_counts, driver_node = solve()
 
         packed = ok & valid & ~too_big
         admitted = packed & ~blocked_in
@@ -188,7 +197,7 @@ def _make_window_kernel(fill: str, emax: int, n_pad: int, rows: int):
         ).astype(jnp.int32)
 
         m_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 4), 1)
-        out_driver = jnp.where(admitted & found, driver_node, -1)
+        out_driver = jnp.where(admitted, driver_node, -1)
         meta = jnp.where(
             m_iota == 0,
             out_driver,
@@ -223,8 +232,11 @@ def window_pack_pallas(
     Returns (meta [S,R,4] i32, execs [S,R,emax] i32, base_after [N,3]) —
     meta rows are (driver_node, admitted, packed, 0), exactly the queue
     kernel's contract, in node indices."""
-    if fill not in PALLAS_FILLS:
-        raise ValueError(f"pallas window path supports {PALLAS_FILLS}")
+    if fill not in PALLAS_FILLS and fill not in PALLAS_SINGLE_AZ:
+        raise ValueError(
+            f"pallas window path supports "
+            f"{PALLAS_FILLS + tuple(PALLAS_SINGLE_AZ)}"
+        )
     n = cluster.available.shape[0]
     s, r = win.exec_count.shape
     rows = _layout_rows(n)
@@ -233,11 +245,11 @@ def window_pack_pallas(
     cols = n_pad // rows
     pad = n_pad - n
 
-    kernel = _make_window_kernel(fill, emax, n_pad, rows)
+    kernel = _make_window_kernel(fill, emax, n_pad, rows, num_zones=num_zones)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(r,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 7,
         out_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -279,6 +291,16 @@ def window_pack_pallas(
                 jnp.pad(base.T.astype(jnp.int32), ((0, 0), (0, pad)))
                 .reshape(3, rows, cols)
             )
+            # Zone ids padded with an out-of-range id (padding matches no
+            # zone); schedulable feeds the single-AZ zone-efficiency
+            # scoring — node order, same fold as every other tile.
+            zone_tile = fold(cluster.zone_id.astype(jnp.int32), num_zones)
+            sched_tile = (
+                jnp.pad(
+                    jnp.asarray(cluster.schedulable).T.astype(jnp.int32),
+                    ((0, 0), (0, pad)),
+                ).reshape(3, rows, cols)
+            )
             return pl.pallas_call(
                 kernel,
                 out_shape=[
@@ -298,6 +320,8 @@ def window_pack_pallas(
                 fold(driver_elig.astype(jnp.int32), 0),
                 fold(drank, INT32_INF),
                 fold(erank, INT32_INF),
+                zone_tile,
+                sched_tile,
             )
 
         def dead_segment():
@@ -425,6 +449,9 @@ def make_segmented_window(
 
 def window_pallas_eligible(fill: str) -> bool:
     """Whether the segmented serving-window Pallas path can serve this
-    strategy (plain fills; the single-AZ wrappers stay on the XLA scan in
-    window mode) on this backend."""
-    return fill in PALLAS_FILLS and pallas_available()
+    strategy on this backend — all six (the plain fills, and since r5 the
+    single-AZ wrappers: per-zone fill + efficiency-scored zone pick through
+    the shared make_gang_solver)."""
+    return (
+        fill in PALLAS_FILLS or fill in PALLAS_SINGLE_AZ
+    ) and pallas_available()

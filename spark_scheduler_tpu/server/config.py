@@ -79,6 +79,11 @@ class InstallConfig:
     # Expose /debug/* (trace dump + JAX profiler control). Off by default:
     # on the cluster-exposed port these routes are unauthenticated.
     debug_routes: bool = False
+    # Structured per-request access logging (the witchcraft req2log slot):
+    # one request.2 line per HTTP call with method, path, status, duration,
+    # trace id. Off by default (one log line per predicate call is real
+    # I/O at serving rates).
+    request_log: bool = False
     # Predicate window tuning: max coalesced requests per device solve, and
     # the busy-period accumulation hold (how long the dispatcher waits for
     # stragglers after a coalesced window — a throughput/latency tradeoff;
@@ -173,6 +178,7 @@ class InstallConfig:
             kube_api_burst=int(raw.get("burst", 10)),
             request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
             debug_routes=bool(raw.get("debug-routes", False)),
+            request_log=bool(raw.get("request-log", False)),
             predicate_max_window=int(raw.get("predicate-max-window", 32)),
             predicate_hold_ms=float(raw.get("predicate-hold-ms", 25.0)),
             runtime_config_path=raw.get("runtime-config-path"),

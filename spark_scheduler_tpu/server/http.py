@@ -428,8 +428,19 @@ class _JSONHandler(BaseHTTPRequestHandler):
     # HTTP/1.1 persistent connections require.
     protocol_version = "HTTP/1.1"
 
-    def log_message(self, *args):  # quiet
+    # Per-request structured access log (the witchcraft req2log slot,
+    # middleware/route.go:28-48). Opt-in per server via config
+    # `request-log` — flipped onto the Handler subclass at construction.
+    request_log = False
+
+    def log_message(self, *args):  # stdlib's unstructured stderr lines: quiet
         pass
+
+    def log_request(self, code="-", size="-"):
+        # Called by send_response mid-request; capture the status and defer
+        # the log line to handle_one_request so it carries the FULL
+        # duration (handler + response write).
+        self._log_status = code
 
     def _content_length(self) -> int:
         """Validated Content-Length. Raises UnframeableBody — after flagging
@@ -494,10 +505,40 @@ class _JSONHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def parse_request(self):
+        # Request-log clock: started AFTER the request line arrived, so a
+        # keep-alive connection's idle wait for the client's next request
+        # never counts into the logged duration.
+        self._req_start = time.monotonic()
+        return super().parse_request()
+
     def handle_one_request(self):
         self._body_consumed = False  # per-request, before any handler runs
         self._drain_on_close = False
+        self._log_status = None
+        self._req_start = None
         super().handle_one_request()
+        start = self._req_start
+        if self.request_log and self._log_status is not None and start is not None:
+            from spark_scheduler_tpu.tracing import svc1log
+
+            headers = getattr(self, "headers", None)
+            try:
+                status = int(self._log_status)
+            except (TypeError, ValueError):  # send_error's "-" placeholder
+                status = 0
+            svc1log().request(
+                getattr(self, "command", "-") or "-",
+                getattr(self, "path", "-") or "-",
+                status,
+                int((time.monotonic() - start) * 1e6),
+                protocol=self.protocol_version,
+                trace_id=(
+                    headers.get("X-B3-TraceId") or headers.get("x-b3-traceid")
+                )
+                if headers
+                else None,
+            )
         # An unframeable body (Transfer-Encoding, garbage Content-Length)
         # was answered without being read; close the connection so the
         # unread bytes can never desync a subsequent request on the
@@ -637,10 +678,12 @@ class SchedulerHTTPServer:
         client_ca_files=None,
         request_timeout_s: float = 30.0,
         debug_routes: bool = False,
+        request_log: bool = False,
     ):
         self.app = app
         self.registry = registry
         self._request_timeout_s = request_timeout_s
+        self.request_log = request_log
         # /debug/* (trace dump, JAX profiler control) is an explicit opt-in:
         # on the cluster-exposed extender port it would let any peer start
         # profiler writes to server-side paths.
@@ -809,6 +852,7 @@ class SchedulerHTTPServer:
         # handler thread forever (the extender protocol budget is 30 s,
         # examples/extender.yml:59).
         Handler.timeout = request_timeout_s
+        Handler.request_log = request_log
         self._server = _Server((host, port), Handler)
         self.tls = _maybe_wrap_tls(
             self._server, cert_file, key_file, client_ca_files,
@@ -884,6 +928,7 @@ class ConversionWebhookServer:
         key_file: str | None = None,
         client_ca_files=None,
         request_timeout_s: float = 30.0,
+        request_log: bool = False,
     ):
         class Handler(_JSONHandler):
             def do_GET(self):
@@ -899,6 +944,7 @@ class ConversionWebhookServer:
                     self._write(404, {"error": "not found"})
 
         Handler.timeout = request_timeout_s
+        Handler.request_log = request_log
         self._server = _Server((host, port), Handler)
         self.tls = _maybe_wrap_tls(
             self._server, cert_file, key_file, client_ca_files,

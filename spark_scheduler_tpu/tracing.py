@@ -362,6 +362,36 @@ class Svc1Logger:
         with self._lock:
             self._stream.write(json.dumps(entry) + "\n")
 
+    def request(
+        self,
+        method: str,
+        path: str,
+        status: int,
+        duration_us: int,
+        *,
+        protocol: str = "HTTP/1.1",
+        trace_id: str | None = None,
+    ) -> None:
+        """Structured per-request access log — the witchcraft req2log slot
+        (middleware/route.go:28-48): every HTTP call gets one line with
+        method, path, status, duration (microseconds) and trace id.
+        Bypasses the service-log level filter (request logs are their own
+        stream type in the reference)."""
+        entry = {
+            "type": "request.2",
+            "time": self._clock(),
+            "origin": self._origin,
+            "method": method,
+            "protocol": protocol,
+            "path": path,
+            "status": int(status),
+            "duration": int(duration_us),
+        }
+        if trace_id:
+            entry["traceId"] = trace_id
+        with self._lock:
+            self._stream.write(json.dumps(entry) + "\n")
+
     def debug(self, message: str, **params) -> None:
         self._log("DEBUG", message, params)
 

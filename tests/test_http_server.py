@@ -269,3 +269,41 @@ def test_garbage_content_length_rejected_and_closed(server):
         b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body,
     )
     assert resp.split(b"\r\n", 1)[0] == b"HTTP/1.1 200 OK", resp[:200]
+
+
+def test_request_log_emits_structured_lines(server):
+    """The req2log slot: with request-log enabled, every HTTP call emits one
+    request.2 line with method, path, status, duration, and the caller's b3
+    trace id."""
+    import io
+
+    from spark_scheduler_tpu.tracing import Svc1Logger, set_svc1log, svc1log
+
+    stream = io.StringIO()
+    old_logger = svc1log()
+    set_svc1log(Svc1Logger(stream=stream))
+    # Flip the flag on the running server's handler class.
+    handler_cls = server._server.RequestHandlerClass
+    handler_cls.request_log = True
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/status/liveness",
+            headers={"X-B3-TraceId": "abc123def456"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 200
+        _request(server.port, "GET", "/nope")
+    finally:
+        handler_cls.request_log = False
+        set_svc1log(old_logger)
+    lines = [
+        json.loads(l)
+        for l in stream.getvalue().splitlines()
+        if '"request.2"' in l
+    ]
+    assert len(lines) == 2, stream.getvalue()
+    live, missing = lines
+    assert live["method"] == "GET" and live["path"] == "/status/liveness"
+    assert live["status"] == 200 and live["duration"] >= 0
+    assert live["traceId"] == "abc123def456"
+    assert missing["status"] == 404 and missing["path"] == "/nope"

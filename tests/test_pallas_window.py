@@ -22,6 +22,12 @@ from spark_scheduler_tpu.ops.pallas_window import (
 )
 
 FILLS = ("tightly-pack", "distribute-evenly", "minimal-fragmentation")
+# All six (r5): the single-AZ wrappers run in-kernel on the window path too.
+STRATEGIES = FILLS + (
+    "single-az-tightly-pack",
+    "single-az-minimal-fragmentation",
+    "az-aware-tightly-pack",
+)
 
 
 def _cluster(rng, n, num_zones=4):
@@ -83,7 +89,7 @@ def _random_window(rng, n, n_requests, max_rows, emax):
     return apps, win, flat_map
 
 
-@pytest.mark.parametrize("fill", FILLS)
+@pytest.mark.parametrize("fill", STRATEGIES)
 @pytest.mark.parametrize("seed", [0, 1, 2])
 def test_window_pallas_matches_xla_scan(fill, seed):
     rng = np.random.default_rng(seed * 7 + 3)
