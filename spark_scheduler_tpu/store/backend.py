@@ -70,6 +70,10 @@ class InMemoryBackend(ClusterBackend):
         self._objects: dict[str, dict[tuple[str, str], Any]] = {k: {} for k in KINDS}
         self._handlers: dict[str, _Handlers] = {k: _Handlers() for k in KINDS}
         self._rv_counter = 0
+        # Bumped on every NODE add/update/delete: lets serving-path
+        # consumers (domain caches, the solver's arena sync) skip O(nodes)
+        # re-walks between requests when the topology hasn't changed.
+        self.nodes_version = 0
         self._crds: set[str] = {RESERVATION_CRD}
         # Full CRD manifests (openAPI schemas etc.) keyed by CRD name; the
         # reference ships complete CustomResourceDefinition objects
@@ -173,6 +177,8 @@ class InMemoryBackend(ClusterBackend):
             self._objects[kind][k] = obj
             if kind == "pods":
                 self._pod_index_add(obj)
+            elif kind == "nodes":
+                self.nodes_version += 1
             self._on_committed(kind, "create", obj)
         self._fire(kind, "add", obj)
         return obj
@@ -195,6 +201,8 @@ class InMemoryBackend(ClusterBackend):
             if kind == "pods":
                 self._pod_index_remove(old)
                 self._pod_index_add(obj)
+            elif kind == "nodes":
+                self.nodes_version += 1
             self._on_committed(kind, "update", obj)
         self._fire(kind, "update", old, obj)
         return obj
@@ -207,6 +215,8 @@ class InMemoryBackend(ClusterBackend):
                 raise NotFoundError(f"{kind} {(namespace, name)}")
             if kind == "pods":
                 self._pod_index_remove(cur)
+            elif kind == "nodes":
+                self.nodes_version += 1
             self._on_committed(kind, "delete", (namespace, name))
         self._fire(kind, "delete", cur)
 

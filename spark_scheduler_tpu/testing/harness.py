@@ -202,3 +202,39 @@ class Harness:
 
     def demands(self):
         return self.app.demand_cache.list()
+
+
+def overcommit_violations(app, backend) -> list[tuple[str, str]]:
+    """[(node_name, dimension)] wherever hard+soft reservations + overhead
+    exceed allocatable — THE over-commit invariant, shared by bench.py's
+    10k serving bench and tests/test_invariant_soak.py so the definition
+    cannot drift. A reservation on a node the backend no longer knows is
+    reported as ("<name>", "missing-node")."""
+    from spark_scheduler_tpu.models.resources import Resources
+
+    all_nodes = backend.list_nodes()
+    known = {n.name for n in all_nodes}
+    overhead = app.overhead_computer.get_overhead(all_nodes)
+    registry = app.solver.registry
+    reserved = app.reservation_manager.get_reserved_resources()
+    out: list[tuple[str, str]] = []
+    for node in all_nodes:
+        res = reserved.get(node.name)
+        if res is None:
+            continue
+        if isinstance(overhead, dict):
+            ov = overhead.get(node.name, Resources.zero()).as_array()
+        else:
+            idx = registry.index_of(node.name)
+            ov = overhead[idx] if idx is not None else (0, 0, 0)
+        alloc = node.allocatable
+        if res.cpu_milli + int(ov[0]) > alloc.cpu_milli:
+            out.append((node.name, "cpu"))
+        if res.mem_kib + int(ov[1]) > alloc.mem_kib:
+            out.append((node.name, "memory"))
+        if res.gpu_milli + int(ov[2]) > alloc.gpu_milli:
+            out.append((node.name, "gpu"))
+    for name in reserved:
+        if name not in known:
+            out.append((name, "missing-node"))
+    return out
