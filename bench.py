@@ -688,9 +688,9 @@ def _bench_serving_concurrent(
         # after the metrics are emitted.
         from spark_scheduler_tpu.testing.harness import overcommit_violations
 
+        server.stop()  # quiesce first; a failing walk must not skip this
         violations = overcommit_violations(app, backend)
         overcommitted = len({name for name, _ in violations})
-        server.stop()
     total = n_clients * per_client * repeats
     # Aggregate = total requests / total wall time (NOT the arithmetic mean
     # of per-repeat rates, which overstates throughput when repeats vary).

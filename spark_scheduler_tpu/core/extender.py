@@ -188,6 +188,20 @@ class SparkSchedulerExtender:
         # order.
         self._capacity_epoch = 0
 
+
+    def _list_nodes_versioned(self):
+        """(all_nodes, topo_version|None) — THE capture-before-list +
+        recheck-after dance every versioned cache rests on: the version is
+        read before the list and re-validated after, so a concurrent node
+        mutation can only make the version look stale (extra walk / cache
+        miss), never fresh over an unsynced list. Single owner; do not
+        inline at call sites."""
+        topo = getattr(self._backend, "nodes_version", None)
+        all_nodes = self._backend.list_nodes()
+        if topo != getattr(self._backend, "nodes_version", None):
+            topo = None  # raced a node mutation: treat as unversioned
+        return all_nodes, topo
+
     # ------------------------------------------------------------------ API
 
     def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
@@ -386,10 +400,8 @@ class SparkSchedulerExtender:
         # Topology version BEFORE the node snapshot (capture-before-list):
         # a concurrent mutation then makes the version look stale (extra
         # walk / cache miss, safe), never fresh over an unsynced list.
-        topo = getattr(self._backend, "nodes_version", None)
-        all_nodes = t.all_nodes = self._backend.list_nodes()
-        if topo != getattr(self._backend, "nodes_version", None):
-            topo = None  # raced a node mutation: treat as unversioned
+        all_nodes, topo = self._list_nodes_versioned()
+        t.all_nodes = all_nodes
         by_name = t.by_name = {n.name: n for n in all_nodes}
         usage = self._rrm.reserved_usage()
         overhead = self._overhead.get_overhead(all_nodes)
@@ -693,10 +705,7 @@ class SparkSchedulerExtender:
             # absent from the candidate list (resource.go:273-286).
             return rr.spec.reservations[DRIVER_RESERVATION].node, SUCCESS, ""
 
-        topo = getattr(self._backend, "nodes_version", None)
-        all_nodes = self._backend.list_nodes()
-        if topo != getattr(self._backend, "nodes_version", None):
-            topo = None  # raced a node mutation: treat as unversioned
+        all_nodes, topo = self._list_nodes_versioned()
         available_nodes = [n for n in all_nodes if pod_matches_node(driver, n)]
         usage = self._rrm.reserved_usage()
 
@@ -1001,10 +1010,7 @@ class SparkSchedulerExtender:
         if stragglers:
             from spark_scheduler_tpu.models.resources import Resources as _R
 
-            topo = getattr(self._backend, "nodes_version", None)
-            all_nodes = self._backend.list_nodes()
-            if topo != getattr(self._backend, "nodes_version", None):
-                topo = None  # raced a node mutation: treat as unversioned
+            all_nodes, topo = self._list_nodes_versioned()
             usage = self._rrm.reserved_usage()
             overhead = self._overhead.get_overhead(all_nodes)
             tensors = self._build_serving_tensors(
@@ -1245,10 +1251,7 @@ class SparkSchedulerExtender:
                 single_az_zone = zone
 
         usage = self._rrm.reserved_usage()
-        topo = getattr(self._backend, "nodes_version", None)
-        all_nodes = self._backend.list_nodes()
-        if topo != getattr(self._backend, "nodes_version", None):
-            topo = None  # raced a node mutation: treat as unversioned
+        all_nodes, topo = self._list_nodes_versioned()
         overhead = self._overhead.get_overhead(all_nodes)
         tensors = self._build_serving_tensors(
             all_nodes, usage, overhead, topo

@@ -86,7 +86,15 @@ class FailoverReconciler:
 
     def _unreserved_spark_pods(self, rrs, pods) -> dict[str, _StaleAppPods]:
         """Scheduled spark pods claimed by no reservation, grouped by app
-        (failover.go:233-270)."""
+        (failover.go:233-270).
+
+        Documented deviation: TERMINATED pods are skipped. The reference's
+        filter (failover.go:272-274) checks only scheduler/deletion/node,
+        so until a dead executor's object is deleted it would re-claim a
+        slot or re-add a soft reservation for the corpse — over-committing
+        the node against the live pods that replaced it (caught by the
+        invariant soak). Terminated pods free their resources; reconciling
+        them back is never right."""
         claimed = set()
         for rr in rrs:
             claimed.update(rr.status.pods.values())
@@ -95,6 +103,7 @@ class FailoverReconciler:
             if (
                 pod.scheduler_name != SPARK_SCHEDULER_NAME
                 or pod.deletion_timestamp is not None
+                or pod.is_terminated()
                 or not pod.node_name
                 or pod.name in claimed
             ):

@@ -4,6 +4,15 @@ Rebuilds internal/extender/overhead.go:32-209 — overhead(node) = requests of
 pods on the node that have no hard or soft reservation; non-schedulable
 overhead additionally counts only pods of OTHER schedulers.
 
+Documented deviation: TERMINATED pods contribute nothing. The reference
+keeps counting a terminated pod's requests until the pod object is deleted
+(overhead.go:163-174 tracks by pod event, never checks the phase), but
+kube-scheduler itself releases Succeeded/Failed pods' resources — counting
+them both under-reports capacity and double-counts a dead executor whose
+freed slot has been re-bound (reservation usage for the new holder + the
+corpse's requests as overhead). The invariant soak caught exactly that
+double-count (tests/test_invariant_soak.py).
+
 The reference recomputes membership per node at query time (overhead.go:
 120-168, an O(pods-on-node) walk with a cache lookup per pod). This rebuild
 maintains the aggregates INCREMENTALLY, because at the 10k-node x 1k-app
@@ -129,8 +138,8 @@ class OverheadComputer:
                     peers.discard(key)
                     if not peers:
                         del self._by_name[name]
-            if pod is None or not pod.node_name:
-                return
+            if pod is None or not pod.node_name or pod.is_terminated():
+                return  # terminated pods free their resources (see module doc)
             state = _PodState(pod.node_name, pod.request())
             unreserved = not self._rrm.pod_has_reservation(pod)
             if unreserved:
@@ -180,7 +189,7 @@ class OverheadComputer:
         overhead = Resources.zero()
         non_schedulable = Resources.zero()
         for pod in self._backend.list_pods():
-            if pod.node_name != node_name:
+            if pod.node_name != node_name or pod.is_terminated():
                 continue
             if not self._rrm.pod_has_reservation(pod):
                 overhead.add(pod.request())

@@ -215,18 +215,14 @@ def overcommit_violations(app, backend) -> list[tuple[str, str]]:
     all_nodes = backend.list_nodes()
     known = {n.name for n in all_nodes}
     overhead = app.overhead_computer.get_overhead(all_nodes)
-    registry = app.solver.registry
+    assert isinstance(overhead, dict), type(overhead)  # the one provider
     reserved = app.reservation_manager.get_reserved_resources()
     out: list[tuple[str, str]] = []
     for node in all_nodes:
         res = reserved.get(node.name)
         if res is None:
             continue
-        if isinstance(overhead, dict):
-            ov = overhead.get(node.name, Resources.zero()).as_array()
-        else:
-            idx = registry.index_of(node.name)
-            ov = overhead[idx] if idx is not None else (0, 0, 0)
+        ov = overhead.get(node.name, Resources.zero()).as_array()
         alloc = node.allocatable
         if res.cpu_milli + int(ov[0]) > alloc.cpu_milli:
             out.append((node.name, "cpu"))

@@ -101,13 +101,16 @@ class Soak:
                     # tracked by the op that submitted it
                     continue
                 entry["node"] = node
-                self.h.backend.bind_pod(pod, node)
+                if self.h.backend.get("pods", pod.namespace, pod.name) is not None:
+                    self.h.backend.bind_pod(pod, node)
             elif role == "executor":
-                assert node in self.nodes or True  # node may have churned
                 entry = self.admitted.get(app_id)
                 if entry is not None:
                     entry["bound"][pod.name] = node
-                self.h.backend.bind_pod(pod, node)
+                # The app may have been torn down while this window was in
+                # flight (its pods deleted) — a dead pod can't bind.
+                if self.h.backend.get("pods", pod.namespace, pod.name) is not None:
+                    self.h.backend.bind_pod(pod, node)
         return results
 
     def drain(self):
@@ -202,17 +205,27 @@ class Soak:
         if r < 0.5 or len(self.nodes) < 8:
             self._add_node()
         elif r < 0.8:
-            # cordon/uncordon a random node
+            # cordon/uncordon with a REPLACEMENT object, like the real
+            # watch path — an in-place mutation would defeat the solver's
+            # identity-based arena sync and test nothing.
+            import dataclasses as _dc
+
             name = list(self.nodes)[int(self.rng.integers(0, len(self.nodes)))]
-            node = self.nodes[name]
-            node.unschedulable = not node.unschedulable
+            node = _dc.replace(
+                self.nodes[name],
+                unschedulable=not self.nodes[name].unschedulable,
+            )
+            self.nodes[name] = node
             self.h.backend.update("nodes", node)
         else:
-            # delete a node with no reservations on it
+            # delete a node with no reservations on it (hard OR soft)
             used = set()
             for rr in self.h.app.rr_cache.list():
                 for res in rr.spec.reservations.values():
                     used.add(res.node)
+            for _app_id, sr in self.h.app.soft_store.get_all_copy().items():
+                for r in sr.reservations.values():
+                    used.add(r.node)
             free = [n for n in self.nodes if n not in used]
             if free:
                 name = free[int(self.rng.integers(0, len(free)))]
