@@ -681,16 +681,18 @@ def _bench_serving_concurrent(
     finally:
         stats = server.batcher.stats()
         dev_stats = dict(app.solver.device_state_stats)
-        # System-level invariant at this scale: no node over-committed by
-        # the reservations the run left behind (reservations + overhead <=
-        # allocatable per node) — the served decisions are valid, not just
-        # fast. Shared definition with the invariant soak; ENFORCED below
-        # after the metrics are emitted.
-        from spark_scheduler_tpu.testing.harness import overcommit_violations
+        server.stop()  # quiesce before the invariant walk below
+    # System-level invariant at this scale: no node over-committed by the
+    # reservations the run left behind (reservations + overhead <=
+    # allocatable per node) — the served decisions are valid, not just
+    # fast. Shared definition with the invariant soak; ENFORCED below after
+    # the metrics are emitted. Success path only: a run that already raised
+    # keeps its own (actionable) exception instead of a walk over
+    # half-applied state chaining on top of it.
+    from spark_scheduler_tpu.testing.harness import overcommit_violations
 
-        server.stop()  # quiesce first; a failing walk must not skip this
-        violations = overcommit_violations(app, backend)
-        overcommitted = len({name for name, _ in violations})
+    violations = overcommit_violations(app, backend)
+    overcommitted = len({name for name, _ in violations})
     total = n_clients * per_client * repeats
     # Aggregate = total requests / total wall time (NOT the arithmetic mean
     # of per-repeat rates, which overstates throughput when repeats vary).

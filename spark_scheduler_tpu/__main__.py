@@ -52,6 +52,11 @@ def main(argv=None) -> int:
     cw.add_argument("--port", type=int, default=8485)
     cw.add_argument("--cert-file", default=None)
     cw.add_argument("--key-file", default=None)
+    cw.add_argument(
+        "--request-log",
+        action="store_true",
+        help="emit a structured request.2 access-log line per HTTP call",
+    )
     args = parser.parse_args(argv)
 
     if args.command == "version":
@@ -83,6 +88,7 @@ def main(argv=None) -> int:
             port=args.port,
             cert_file=args.cert_file,
             key_file=args.key_file,
+            request_log=args.request_log,
         )
         print(
             f"conversion webhook serving on {args.host}:{server.port}", file=sys.stderr

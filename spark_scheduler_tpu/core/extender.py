@@ -1057,14 +1057,9 @@ class SparkSchedulerExtender:
                         else SUCCESS_RESCHEDULED,
                     )
                 else:
-                    if s["zone"] is not None:
-                        self._demands.create_demand_for_executor(
-                            pod, s["exec_res"], zone=s["zone"]
-                        )
-                    else:
-                        self._demands.create_demand_for_executor(
-                            pod, s["exec_res"]
-                        )
+                    self._demands.create_demand_for_executor(
+                        pod, s["exec_res"], zone=s["zone"]
+                    )
                     s["result"] = ("fit", None)
                     finish(
                         i, None, FAILURE_FIT,
@@ -1127,14 +1122,9 @@ class SparkSchedulerExtender:
                     pod = args_list[i].pod
                     if ctx is not None and ctx[0] is not None:
                         exec_res, zone = ctx
-                        if zone is not None:
-                            self._demands.create_demand_for_executor(
-                                pod, exec_res, zone=zone
-                            )
-                        else:
-                            self._demands.create_demand_for_executor(
-                                pod, exec_res
-                            )
+                        self._demands.create_demand_for_executor(
+                            pod, exec_res, zone=zone
+                        )
                     finish(
                         i, None, FAILURE_FIT,
                         "not enough capacity to reschedule the executor",
@@ -1219,36 +1209,21 @@ class SparkSchedulerExtender:
     ) -> tuple[Optional[str], str, str]:
         """First executor-priority-ordered node with room (resource.go:565-639),
         optionally restricted to the app's common AZ for single-AZ dynamic
-        allocation."""
-        driver = self._pod_lister.get_driver_for_executor(executor)
-        if driver is None:
-            return None, FAILURE_INTERNAL, "failed to get driver pod for executor"
-        try:
-            app_resources = spark_resources(driver)
-        except SparkPodError as exc:
-            return None, FAILURE_INTERNAL, str(exc)
-        exec_res = app_resources.executor_resources
+        allocation. Context derivation (driver lookup, resources, single-AZ
+        zone — incl. the reference's error-the-request semantics,
+        resource.go:583-586) is shared with the windowed path via
+        _reschedule_context so the two ladders cannot drift."""
+        exec_res, single_az_zone = self._reschedule_context(executor)
+        if exec_res is None:
+            return None, FAILURE_INTERNAL, single_az_zone
 
         nodes = [
             n
             for name in node_names
             if (n := self._backend.get_node(name)) is not None
         ]
-        single_az_zone: Optional[str] = None
-        if (
-            self.binpacker.is_single_az
-            and self._config.schedule_dynamically_allocated_executors_in_same_az
-        ):
-            try:
-                zone, all_same_az = self._common_zone_for_app(executor)
-            except ReservationError as exc:
-                # Reference errors the whole request here (resource.go:583-586)
-                # rather than falling back to any-AZ, preserving the
-                # single-AZ invariant; we surface it as failure-internal.
-                return None, FAILURE_INTERNAL, str(exc)
-            if all_same_az:
-                nodes = [n for n in nodes if n.zone == zone]
-                single_az_zone = zone
+        if single_az_zone is not None:
+            nodes = [n for n in nodes if n.zone == single_az_zone]
 
         usage = self._rrm.reserved_usage()
         all_nodes, topo = self._list_nodes_versioned()
@@ -1271,10 +1246,9 @@ class SparkSchedulerExtender:
             outcome = SUCCESS_SCHEDULED_EXTRA_EXECUTOR if is_extra else SUCCESS_RESCHEDULED
             return packing.executor_nodes[0], outcome, ""
 
-        if single_az_zone is not None:
-            self._demands.create_demand_for_executor(executor, exec_res, zone=single_az_zone)
-        else:
-            self._demands.create_demand_for_executor(executor, exec_res)
+        self._demands.create_demand_for_executor(
+            executor, exec_res, zone=single_az_zone
+        )
         return None, FAILURE_FIT, "not enough capacity to reschedule the executor"
 
     def _common_zone_for_app(self, executor: Pod) -> tuple[Optional[str], bool]:
