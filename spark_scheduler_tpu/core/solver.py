@@ -113,17 +113,20 @@ class _DaemonFetchPool:
     dead tunnel must never block interpreter exit, which
     ThreadPoolExecutor's non-daemon workers (joined by its atexit hook)
     would. Futures are concurrent.futures.Future — result()/done()
-    compatible with the executor API the handles expose."""
+    compatible with the executor API the handles expose.
+
+    ONE pool is shared by every solver in the process
+    (_shared_fetch_pool): the workers run stateless jax.device_get calls,
+    so there is nothing per-solver about them, and a pool per solver
+    accumulates leaked daemon threads wherever solvers are created without
+    a paired close() (each test harness, every rebuilt app). A full test
+    run leaked 100+ such threads and died with a native-thread segfault;
+    the shared pool bounds the cost at `workers` threads per process."""
 
     def __init__(self, workers: int = 4, name: str = "window-blob-fetch"):
         import queue as _queue
 
         self._q: "_queue.Queue" = _queue.Queue()
-        self._shutdown = False
-        # Serializes the shutdown-flag check against shutdown itself: an
-        # unsynchronized check-then-put could slip an item in behind the
-        # worker-exit sentinels, recreating the forever-pending future.
-        self._lock = threading.Lock()
         self._threads = []
         for i in range(workers):
             t = threading.Thread(
@@ -134,10 +137,7 @@ class _DaemonFetchPool:
 
     def _run(self) -> None:
         while True:
-            item = self._q.get()
-            if item is None:
-                return
-            fut, fn = item
+            fut, fn = self._q.get()
             if not fut.set_running_or_notify_cancel():
                 continue
             try:
@@ -148,23 +148,29 @@ class _DaemonFetchPool:
     def submit(self, fn, *args):
         from concurrent.futures import Future
 
-        with self._lock:
-            if self._shutdown:
-                # Fail fast like ThreadPoolExecutor: a submit after
-                # shutdown must not enqueue a Future no worker will ever
-                # run (the caller would block forever on .result()).
-                raise RuntimeError(
-                    "cannot schedule new futures after shutdown"
-                )
-            fut: Future = Future()
-            self._q.put((fut, lambda: fn(*args)))
-            return fut
+        fut: Future = Future()
+        self._q.put((fut, lambda: fn(*args)))
+        return fut
 
-    def shutdown(self) -> None:
-        with self._lock:
-            self._shutdown = True
-            for _ in self._threads:
-                self._q.put(None)
+
+_shared_pool: _DaemonFetchPool | None = None
+_shared_pool_lock = threading.Lock()
+
+
+def _shared_fetch_pool() -> _DaemonFetchPool:
+    """The process-wide blob-fetch pool, created on first use. Never shut
+    down: the workers are daemon threads idling on a queue, so they cost
+    nothing and cannot block interpreter exit. Solver.close() fail-fasts
+    new submits at the solver level instead of tearing the pool down under
+    other solvers."""
+    global _shared_pool
+    with _shared_pool_lock:
+        if _shared_pool is None:
+            # Several workers: over the tunnel, concurrent device_get RPCs
+            # overlap almost perfectly (4 fetches take ~1 RTT), so a
+            # depth-N serving pipeline divides the round trip.
+            _shared_pool = _DaemonFetchPool(workers=4)
+        return _shared_pool
 
 
 @jax.jit
@@ -342,7 +348,7 @@ class PlacementSolver:
         # (the predicate batcher is the serialization point); the fetch pool
         # only runs stateless jax.device_get calls.
         self._pipe: dict | None = None
-        self._fetch_pool = None
+        self._closed = False
         # Candidate-mask memo: serving windows pass the same (usually
         # cluster-wide) candidate list once per request, and building the
         # [N] bool mask is a Python walk over every name. Keyed by the full
@@ -480,13 +486,12 @@ class PlacementSolver:
         return tensors
 
     def close(self) -> None:
-        """Release the blob-fetch pool. Workers are daemon threads
-        (_DaemonFetchPool), so a transfer stuck on a dead tunnel can never
-        block interpreter exit; shutdown just tells idle workers to
-        finish."""
-        if self._fetch_pool is not None:
-            self._fetch_pool.shutdown()
-            self._fetch_pool = None
+        """Stop accepting new pipelined fetch submits (they would enqueue a
+        Future whose result nobody will pull). The fetch pool itself is
+        process-shared (_shared_fetch_pool) and stays up for other
+        solvers; its workers are daemon threads, so a transfer stuck on a
+        dead tunnel can never block interpreter exit."""
+        self._closed = True
 
     def discard_pipeline(self) -> None:
         """Drop the pipelined device state: the next build_tensors_pipelined
@@ -858,6 +863,12 @@ class PlacementSolver:
         device saw."""
         if strategy not in BATCHABLE_STRATEGIES:
             raise ValueError(f"strategy {strategy!r} is not batchable")
+        if self._closed:
+            # Fail fast like ThreadPoolExecutor after shutdown — and BEFORE
+            # any device work or pipeline mutation, so a raised dispatch
+            # leaves no committed-but-orphaned window behind for a retry to
+            # double-commit.
+            raise RuntimeError("cannot schedule new futures after shutdown")
         if not requests:
             return WindowHandle(
                 strategy=strategy, blob=None, requests=(), flat_rows=[],
@@ -988,12 +999,9 @@ class PlacementSolver:
             # Start the device->host pull NOW on the fetch thread: over a
             # tunneled device the transfer RTT dominates, and starting it at
             # dispatch lets it elapse under the next window's host build.
-            if self._fetch_pool is None:
-                # Several workers: over the tunnel, concurrent device_get
-                # RPCs overlap almost perfectly (4 fetches take ~1 RTT), so
-                # a depth-N serving pipeline divides the round trip.
-                self._fetch_pool = _DaemonFetchPool(workers=4)
-            handle.blob_future = self._fetch_pool.submit(jax.device_get, blob)
+            handle.blob_future = _shared_fetch_pool().submit(
+                jax.device_get, blob
+            )
         return handle
 
     def pack_window_fetch(self, handle: "WindowHandle") -> list[WindowDecision]:

@@ -14,5 +14,23 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Bound the process's virtual-memory map count across the suite.
+
+    Every XLA:CPU compile JIT-loads code pages as a handful of mmap
+    regions, and compiled executables are cached for the life of the
+    process — a full run accumulates tens of thousands of mappings and
+    crosses the kernel's default vm.max_map_count (65530), at which point
+    the next compile's mmap fails and XLA segfaults (observed at ~62k maps,
+    deterministically in whichever test compiles next — historically the
+    8-device sharded window test). Dropping the executable caches at module
+    boundaries keeps the count bounded; cross-module recompiles are cheap
+    next to the suite's own per-module compiles."""
+    yield
+    jax.clear_caches()

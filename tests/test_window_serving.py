@@ -647,3 +647,43 @@ def test_executor_window_driverless_reschedule_fails_internal():
         ]
 
     _exec_equivalence(scenario)
+
+
+def test_fetch_pool_is_shared_across_solvers():
+    """Regression: every solver used to lazily create its OWN 4-worker
+    fetch pool, and harness-style callers (every test, every rebuilt app)
+    never close the solver — a full test run accumulated 100+ leaked
+    daemon threads and segfaulted in a native thread. The blob-fetch pool
+    is process-shared now: N live solvers serving pipelined windows keep
+    at most one pool's worth of fetch threads."""
+    names = [f"n{i}" for i in range(4)]
+    for k in range(6):
+        h = Harness("tightly-pack", fifo=False)
+        h.add_nodes(*[new_node(n) for n in names])
+        pods = static_allocation_spark_pods(f"pool-{k}", 2)
+        h.add_pods(pods[0])
+        results = h.extender.predicate_batch(
+            [ExtenderArgs(pod=pods[0], node_names=list(names))]
+        )
+        assert results[0].ok
+    fetch_threads = [
+        t for t in threading.enumerate()
+        if t.name.startswith("window-blob-fetch")
+    ]
+    assert len(fetch_threads) <= 4, [t.name for t in fetch_threads]
+
+
+def test_solver_close_fails_fast_on_pipelined_dispatch():
+    """After close(), a pipelined dispatch must raise instead of enqueuing
+    a Future nobody serves (ThreadPoolExecutor-after-shutdown semantics);
+    the shared pool itself stays up for other solvers."""
+    names = [f"n{i}" for i in range(4)]
+    h = Harness("tightly-pack", fifo=False)
+    h.add_nodes(*[new_node(n) for n in names])
+    pods = static_allocation_spark_pods("pool-close", 2)
+    h.add_pods(pods[0])
+    h.app.solver.close()
+    with pytest.raises(RuntimeError, match="after shutdown"):
+        h.extender.predicate_batch(
+            [ExtenderArgs(pod=pods[0], node_names=list(names))]
+        )
