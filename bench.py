@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 
 import numpy as np
@@ -600,52 +601,79 @@ def bench_serving_http_concurrent_10k(rng):
     _bench_serving_concurrent(
         rng, n_nodes=10_000, n_clients=100, per_client=5, warmup_rounds=1,
         repeats=2, suffix="10k_nodes", max_window=128,
-        rows_buckets=(128, 256, 512, 1024),
+        inprocess_control=True,
+    )
+
+
+def bench_serving_http_concurrent_64c(rng):
+    """The windowed design's intended regime: MORE concurrency per core.
+    At 64 colocated clients the mean window doubles (16 vs 7.8 at 32
+    clients) and both throughput AND p50 improve — amortization beats
+    queueing. Kept alongside the 32-client config (the round-over-round
+    comparable) so the artifact shows the windowing thesis directly."""
+    _bench_serving_concurrent(
+        rng, n_nodes=500, n_clients=64, per_client=4, warmup_rounds=2,
+        repeats=3, suffix="500_nodes_64_clients",
     )
 
 
 def _bench_serving_concurrent(
     rng, *, n_nodes, n_clients, per_client, warmup_rounds, repeats, suffix,
-    max_window=None, rows_buckets=(32, 64, 128, 256, 512, 1024, 2048),
+    max_window=None, inprocess_control=False,
 ):
     backend, app, server, node_names = _serving_fixture(
         n_nodes, max_window=max_window
     )
 
     def precompile_window_buckets():
-        """Force the XLA compiles for every pack_window row bucket the run
+        """Force the device compiles for every window SHAPE BUCKET the run
         can hit, so measurement never stalls on a fresh compile (a real
-        deployment pre-warms the same way)."""
+        deployment pre-warms the same way; the compiles persist in the
+        .jax_cache across processes).
+
+        The Pallas window path buckets a window of S requests x R max rows
+        to (s_pad in 4*8^k, r_pad in 16*4^k) — see
+        solver._build_segmented_window. Under FIFO a request re-packs all
+        its PENDING earlier drivers, so live row depth reaches the
+        in-flight client count and S reaches the batcher max window:
+        enumerate the full (s_pad, r_pad) grid up to those bounds (an
+        earlier version warmed only a handful of flat row-count buckets,
+        missed the deep-row shapes, and the 10k run ate several 20-40 s
+        mid-measurement compiles — p95 blew out to 42 s)."""
         from spark_scheduler_tpu.core.solver import WindowRequest
         from spark_scheduler_tpu.models.resources import Resources
 
         solver = app.solver
         tensors = solver.build_tensors_cached(backend.list_nodes(), {}, {})
         one = Resources.from_quantities("1", "1Gi")
-        for rows_total in rows_buckets:
-            per_req = max(1, rows_total // n_clients)
-            reqs = [
-                WindowRequest(
-                    rows=[(one, one, 8, False)] * per_req,
-                    driver_candidate_names=node_names,
-                )
-                for _ in range(min(n_clients, rows_total))
-            ]
-            solver.pack_window("tightly-pack", tensors, reqs)
-        # Small-window shape buckets (straggler windows on the Pallas
-        # path): few requests x shallow AND deep FIFO rows.
-        for depth in (1, 20):
-            solver.pack_window(
-                "tightly-pack",
-                tensors,
-                [
+        window_cap = max_window or 32  # batcher default max_window
+        s_buckets = []
+        s = 4
+        while True:
+            s_buckets.append(s)
+            if s >= window_cap:
+                break
+            s *= 8
+        # Max FIFO row depth ~= in-flight clients (every earlier pending
+        # driver is a hypothetical row) + the request's own row.
+        r_buckets = []
+        r = 16
+        while True:
+            r_buckets.append(r)
+            if r >= n_clients + 1:
+                break
+            r *= 4
+        for s_pad in s_buckets:
+            for r_pad in r_buckets:
+                reqs = [
                     WindowRequest(
-                        rows=[(one, one, 8, False)] * depth,
+                        rows=[(one, one, 8, True)] * (r_pad - 1)
+                        + [(one, one, 8, False)],
                         driver_candidate_names=node_names,
                     )
-                    for _ in range(2)
-                ],
-            )
+                    for _ in range(s_pad)
+                ]
+                solver.pack_window("tightly-pack", tensors, reqs)
 
     from spark_scheduler_tpu.tracing import tracer
 
@@ -678,6 +706,66 @@ def _bench_serving_concurrent(
             solve_spans.extend(
                 s for s in tracer().finished_spans() if s["name"] == "solve"
             )
+        # In-process control at the same scale: windows of driver gang
+        # admissions through the REAL windowed path (dispatch/complete on
+        # the live app — reservations, overhead, epoch machinery, write
+        # caches) with no HTTP framing, so the artifact separates the
+        # scheduler's decision rate from the 1-core rig's request rate.
+        # Before server.stop() (stop closes the solver).
+        inproc = None
+        if inprocess_control:
+            from spark_scheduler_tpu.core.extender import ExtenderArgs
+            from spark_scheduler_tpu.testing.harness import (
+                static_allocation_spark_pods,
+            )
+
+            ext = app.extender
+            window, n_windows = 32, 10
+
+            def dispatch_window(tag, k):
+                drivers = []
+                for j in range(window):
+                    pods = static_allocation_spark_pods(
+                        f"inw-{tag}-{k}-{j}", 8
+                    )
+                    backend.add_pod(pods[0])
+                    drivers.append(pods[0])
+                return drivers, ext.predicate_window_dispatch(
+                    [
+                        ExtenderArgs(pod=d, node_names=list(node_names))
+                        for d in drivers
+                    ]
+                )
+
+            def complete_window(drivers, t):
+                results = ext.predicate_window_complete(t)
+                for d, r in zip(drivers, results):
+                    if not r.node_names:
+                        raise RuntimeError(f"{d.name}: {r.outcome}")
+                    backend.bind_pod(d, r.node_names[0])
+
+            # PIPELINED like the serving batcher: dispatch k+1 before
+            # completing k, so the decision pull's tunnel RTT overlaps the
+            # next window's host build (serially the control measures RTT,
+            # not the scheduler).
+            complete_window(*dispatch_window("warm", 0))
+            t0 = time.perf_counter()
+            prev = dispatch_window("run", 0)
+            for k in range(1, n_windows):
+                nxt = dispatch_window("run", k)
+                complete_window(*prev)
+                prev = nxt
+            complete_window(*prev)
+            inproc_wall = time.perf_counter() - t0
+            inproc = {
+                "decisions_per_s": round(window * n_windows / inproc_wall, 1),
+                "windows_of": window,
+                "windows": n_windows,
+                "pipelined": True,
+                "path": (
+                    "predicate_window_dispatch/complete, no HTTP framing"
+                ),
+            }
     finally:
         stats = server.batcher.stats()
         dev_stats = dict(app.solver.device_state_stats)
@@ -740,6 +828,11 @@ def _bench_serving_concurrent(
         # segmented Pallas path serves /predicates on TPU).
         "window_path_counts": dict(app.solver.window_path_counts),
         "device_rtt_floor_ms": rtt_floor_ms,
+        # Same rig, null handler: what the 1-core HTTP harness itself can
+        # carry — decisions/s saturating this floor is a rig limit, not a
+        # scheduler limit (cf. executor bench's http_rig_utilization).
+        "http_rig_ceiling_req_per_s": _http_rig_ceiling(),
+        "host_cpus": os.cpu_count(),
         # Per-WINDOW server-side solve span (dispatch + blocking decision
         # pull actually awaited — ~0 when the pipeline hides the fetch),
         # over the spans surviving the tracer ring; the window COUNT comes
@@ -751,6 +844,28 @@ def _bench_serving_concurrent(
         "path": "concurrent HTTP /predicates -> windowed pack_window solve",
         "r02": "unbatched serving: 8.4 decisions/s, p50 119.7 ms",
     }
+    if inproc is not None:
+        detail["inprocess_control"] = inproc
+        _record(
+            f"serving_inprocess_decisions_per_s_{suffix}",
+            inproc["decisions_per_s"], "decisions/s",
+            round(inproc["decisions_per_s"] / 100.0, 2),
+            detail=inproc,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"serving_inprocess_decisions_per_s_{suffix}",
+                    "value": inproc["decisions_per_s"],
+                    "unit": "decisions/s",
+                    "vs_baseline": round(
+                        inproc["decisions_per_s"] / 100.0, 2
+                    ),
+                    "detail": inproc,
+                }
+            ),
+            flush=True,
+        )
     _emit(f"serving_http_concurrent_p50_ms_{suffix}", p50, 1, detail)
     # The windowing headline: decisions/s under concurrent load
     # (vs_baseline > 1 = beats the 100 decisions/s target).
@@ -782,12 +897,89 @@ def _bench_serving_concurrent(
         )
 
 
+_RIG_CEILING: dict = {}
+
+
+def _http_rig_ceiling(n_threads: int = 16, per: int = 30) -> float:
+    """Control measurement: the SAME client rig (colocated threads,
+    keep-alive http.client, ~10 KB predicate-shaped bodies) against a
+    null handler that only reads the body and returns a canned decision —
+    zero scheduler work. On a 1-core bench box the stdlib HTTP stack +
+    client rig alone cap the measurable request rate; serving throughput
+    bars must be read against this harness floor the same way solo p50 is
+    read against the tunnel RTT floor. Memoized (one measurement per
+    bench process)."""
+    if "req_per_s" in _RIG_CEILING:
+        return _RIG_CEILING["req_per_s"]
+    import http.client
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Null(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            resp = b'{"NodeNames": ["bench-node-00000"]}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Null)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    names = [f"bench-node-{i:05d}" for i in range(500)]
+    body = json.dumps({"Pod": {"metadata": {}}, "NodeNames": names}).encode()
+
+    errors: list = []
+
+    def client():
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            for _ in range(per):
+                conn.request(
+                    "POST", "/predicates", body,
+                    {"Content-Type": "application/json"},
+                )
+                conn.getresponse().read()
+            conn.close()
+        except Exception as exc:  # fail LOUDLY: a silently-dead client
+            errors.append(exc)    # thread would skew the memoized ceiling
+            raise
+
+    ths = [threading.Thread(target=client) for _ in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    wall = time.perf_counter() - t0
+    srv.shutdown()
+    srv.server_close()
+    if errors:
+        raise RuntimeError(f"rig-ceiling client failed: {errors[0]!r}")
+    _RIG_CEILING["req_per_s"] = round(n_threads * per / wall, 1)
+    return _RIG_CEILING["req_per_s"]
+
+
 def bench_serving_http_executors(rng):
     """Executor binding throughput: after a driver's gang admission, every
     executor request walks the reservation ladder (already-bound / unbound /
     reschedule, resource.go:376-428) — host-side state work with no device
     solve in the common case. Concurrent executor requests ride the same
-    predicate batcher; this measures the served executor path end to end."""
+    predicate batcher; this measures the served executor path end to end.
+
+    Alongside the HTTP number the bench emits two controls: the null-handler
+    rig ceiling (_http_rig_ceiling) and an IN-PROCESS binding phase — the
+    same extender/stores/windowed path, no HTTP framing — so the artifact
+    separates what the scheduler can bind from what the 1-core bench rig
+    can carry."""
     import http.client
 
     from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
@@ -823,21 +1015,95 @@ def bench_serving_http_executors(rng):
     ]
     try:
         lats, wall_s = _threaded_phase(server.port, backend, sequences)
+        # In-process control: bind another fleet of executors through the
+        # REAL windowed path (predicate_window_dispatch/complete on the
+        # same live app + stores) with no HTTP framing. Runs before
+        # server.stop() (stop closes the solver).
+        from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+        ext = app.extender
+        inproc_pods = []
+        for i in range(n_apps):
+            pods = static_allocation_spark_pods(f"exi-{i}", execs_per_app)
+            backend.add_pod(pods[0])
+            r = ext.predicate(
+                ExtenderArgs(pod=pods[0], node_names=list(node_names))
+            )
+            if not r.node_names:
+                raise RuntimeError(f"driver exi-{i} failed: {r.outcome}")
+            backend.bind_pod(pods[0], r.node_names[0])
+            inproc_pods.extend(pods[1:])
+
+        def bind_window(pods):
+            for p in pods:
+                backend.add_pod(p)
+            t = ext.predicate_window_dispatch(
+                [
+                    ExtenderArgs(pod=p, node_names=list(node_names))
+                    for p in pods
+                ]
+            )
+            results = ext.predicate_window_complete(t)
+            for p, r in zip(pods, results):
+                if not r.node_names:
+                    raise RuntimeError(f"{p.name}: {r.outcome}")
+                backend.bind_pod(p, r.node_names[0])
+
+        window = n_workers
+        bind_window(inproc_pods[:window])  # warm
+        rest = inproc_pods[window:]
+        t0 = time.perf_counter()
+        for i in range(0, len(rest), window):
+            bind_window(rest[i : i + window])
+        inproc_wall = time.perf_counter() - t0
+        inproc_bps = round(len(rest) / inproc_wall, 1)
     finally:
         server.stop()
+    rig_ceiling = _http_rig_ceiling()
     p50 = float(np.percentile(lats, 50))
+    bps = len(lats) / wall_s
+    detail = {
+        "nodes": 500,
+        "executors": len(lats),
+        "p95_ms": round(float(np.percentile(lats, 95)), 3),
+        "bindings_per_s": round(bps, 1),
+        # Same rig, null handler: the 1-core HTTP harness floor the HTTP
+        # number saturates (bindings_per_s / ceiling = scheduler share).
+        "http_rig_ceiling_req_per_s": rig_ceiling,
+        "http_rig_utilization": round(bps / rig_ceiling, 3),
+        "host_cpus": os.cpu_count(),
+        "load_generator": "colocated threads, prebuilt bodies (see _threaded_phase)",
+        "path": "concurrent executor /predicates -> reservation ladder (host-side)",
+    }
     _emit(
         "serving_http_executor_p50_ms_500_nodes",
         p50,
         1,
-        {
-            "nodes": 500,
-            "executors": len(lats),
-            "p95_ms": round(float(np.percentile(lats, 95)), 3),
-            "bindings_per_s": round(len(lats) / wall_s, 1),
-            "load_generator": "colocated threads, prebuilt bodies (see _threaded_phase)",
-            "path": "concurrent executor /predicates -> reservation ladder (host-side)",
+        detail,
+    )
+    # The scheduler-side capability, free of the rig floor: the same
+    # windowed executor path in process.
+    _record(
+        "serving_executor_bindings_per_s_inprocess_500_nodes",
+        inproc_bps, "bindings/s", round(inproc_bps / 500.0, 2),
+        detail={
+            "windows_of": window,
+            "executors": len(rest),
+            "path": "predicate_window_dispatch/complete, no HTTP framing",
+            "target": "VERDICT r4 #2: >= 500 bindings/s",
         },
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "serving_executor_bindings_per_s_inprocess_500_nodes",
+                "value": inproc_bps,
+                "unit": "bindings/s",
+                "vs_baseline": round(inproc_bps / 500.0, 2),
+                "detail": {"windows_of": window, "executors": len(rest)},
+            }
+        ),
+        flush=True,
     )
 
 
@@ -941,6 +1207,7 @@ def main() -> None:
     # process state, so measure them early.
     bench_serving_http_executors(rng)
     bench_serving_http_concurrent(rng)
+    bench_serving_http_concurrent_64c(rng)
     # North-star SCALE through the served stack (VERDICT r4 #1).
     bench_serving_http_concurrent_10k(rng)
     bench_config5(rng)  # north star — the headline metric

@@ -981,12 +981,12 @@ class SparkSchedulerExtender:
                         ctx = app_ctx[key] = self._reschedule_context(pod)
                     pod_key = (pod.namespace, pod.name)
                     if ctx[0] is None:
-                        finish(i, None, FAILURE_INTERNAL, ctx[1])
+                        finish(i, None, FAILURE_INTERNAL, ctx[2])
                         straggler_by_pod[pod_key] = {
-                            "result": ("internal", ctx[1])
+                            "result": ("internal", ctx[2])
                         }
                         continue
-                    exec_res, zone = ctx
+                    exec_res, zone, _ = ctx
                     names = [
                         n.name
                         for name in args_list[i].node_names
@@ -1107,7 +1107,7 @@ class SparkSchedulerExtender:
                 # actually used), so these would have re-attempted and hit
                 # the same internal error.
                 for i in idxs:
-                    finish(i, None, FAILURE_INTERNAL, ctx[1])
+                    finish(i, None, FAILURE_INTERNAL, ctx[2])
             elif key in app_internal:
                 # The spot was freed by a reservation-write failure, not a
                 # capacity shortage — a serial re-attempt hits the same
@@ -1121,7 +1121,7 @@ class SparkSchedulerExtender:
                 for i in idxs:
                     pod = args_list[i].pod
                     if ctx is not None and ctx[0] is not None:
-                        exec_res, zone = ctx
+                        exec_res, zone, _ = ctx
                         self._demands.create_demand_for_executor(
                             pod, exec_res, zone=zone
                         )
@@ -1136,16 +1136,20 @@ class SparkSchedulerExtender:
                         "application has no free executor spots to schedule this one",
                     )
 
-    def _reschedule_context(self, executor: Pod) -> tuple:
-        """Per-app context for reschedule stragglers: (exec_resources,
-        single-az zone restriction | None), or (None, error message)."""
+    def _reschedule_context(
+        self, executor: Pod
+    ) -> tuple[Optional["Resources"], Optional[str], Optional[str]]:
+        """Per-app context for reschedule stragglers:
+        (exec_resources, single-az zone restriction | None, None) on
+        success, (None, None, error message) on failure — the error rides
+        its own slot so no caller can mistake it for a zone name."""
         driver = self._pod_lister.get_driver_for_executor(executor)
         if driver is None:
-            return None, "failed to get driver pod for executor"
+            return None, None, "failed to get driver pod for executor"
         try:
             app_resources = spark_resources(driver)
         except SparkPodError as exc:
-            return None, str(exc)
+            return None, None, str(exc)
         zone = None
         if (
             self.binpacker.is_single_az
@@ -1154,10 +1158,10 @@ class SparkSchedulerExtender:
             try:
                 z, all_same_az = self._common_zone_for_app(executor)
             except ReservationError as exc:
-                return None, str(exc)
+                return None, None, str(exc)
             if all_same_az:
                 zone = z
-        return app_resources.executor_resources, zone
+        return app_resources.executor_resources, zone, None
 
     def _select_executor_node(
         self, executor: Pod, node_names: list[str]
@@ -1213,9 +1217,11 @@ class SparkSchedulerExtender:
         zone — incl. the reference's error-the-request semantics,
         resource.go:583-586) is shared with the windowed path via
         _reschedule_context so the two ladders cannot drift."""
-        exec_res, single_az_zone = self._reschedule_context(executor)
+        exec_res, single_az_zone, ctx_error = self._reschedule_context(
+            executor
+        )
         if exec_res is None:
-            return None, FAILURE_INTERNAL, single_az_zone
+            return None, FAILURE_INTERNAL, ctx_error
 
         nodes = [
             n

@@ -113,6 +113,22 @@ class InstallConfig:
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 0.5
             )
+            # Without this, MLIR op locations embed the FULL Python call
+            # stack, and the Mosaic custom-call payload serializes those
+            # locations where the cache key's strip-debuginfo pass cannot
+            # reach (it only strips the outer module). Any difference in
+            # the call path into pack_window — server dispatcher vs bench
+            # precompile vs a shifted line number after an edit — then
+            # changes every Pallas program's cache key, and each shape
+            # recompiles 20-40 s on the live serving path. Primitive-frame
+            # locations are stable (they point inside this package), keep
+            # errors attributable, and make the persistent cache actually
+            # persistent for Mosaic kernels. Verified: identical
+            # canonicalized IR across shifted call sites with this off,
+            # differing bytes with it on.
+            jax.config.update(
+                "jax_include_full_tracebacks_in_locations", False
+            )
         except Exception:
             pass
 
