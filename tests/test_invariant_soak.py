@@ -47,7 +47,15 @@ CHECK_EVERY = 50  # full invariant sweep cadence (every step would be O(n^2))
 class Soak:
     def __init__(self, rng, strategy):
         self.rng = rng
-        self.h = Harness(binpack_algo=strategy, fifo=True)
+        # same_az under single-az strategies: without it the extender's
+        # zone-restriction gate (is_single_az AND same-az-dynalloc config)
+        # stays False and the zone-restricted executor-reschedule ladder —
+        # the very path the single-az matrix slot exists to soak — never
+        # executes (verified by instrumentation in review).
+        self.h = Harness(
+            binpack_algo=strategy, fifo=True,
+            same_az_dynamic_allocation="single-az" in strategy,
+        )
         self.node_seq = 0
         self.nodes: dict[str, object] = {}
         for _ in range(12):
@@ -362,15 +370,20 @@ class Soak:
         self.check_drained_mirror()
 
 
-@pytest.mark.parametrize("strategy", ["tightly-pack", "az-aware-tightly-pack"])
+@pytest.mark.parametrize(
+    "strategy",
+    ["tightly-pack", "az-aware-tightly-pack", "single-az-tightly-pack"],
+)
 def test_invariant_soak(strategy):
-    """Seeded soak on both window paths' strategies (the XLA scan serves
-    both here on CPU; the same programs run in-kernel on TPU — parity
-    pinned elsewhere). STEPS ops total, invariants swept every
+    """Seeded soak across the three strategy families (plain fill,
+    az-aware wrapper, single-AZ wrapper — the zone-restricted executor
+    reschedule path only runs under single-az). The XLA scan serves all
+    of them here on CPU; the same programs run in-kernel on TPU — parity
+    pinned elsewhere. STEPS ops total, invariants swept every
     CHECK_EVERY."""
     rng = np.random.default_rng(20260731)
     soak = Soak(rng, strategy)
-    # Split the budget between the two strategies so the default CI run
-    # totals ~SOAK_STEPS ops across the matrix.
-    soak.run(STEPS // 2)
+    # Split the budget across the matrix so the default CI run totals
+    # ~SOAK_STEPS ops.
+    soak.run(STEPS // 3)
     assert soak.app_seq > 0 and soak.op_counts, soak.op_counts
