@@ -1281,14 +1281,44 @@ def main() -> None:
     set_svc1log(Svc1Logger(stream=open(_os.devnull, "w")))
 
     rng = np.random.default_rng(0)
-    bench_tpu_parity()
-    bench_tpu_soak()
-    bench_config1(rng)
-    bench_config2(rng)
-    bench_config2_az_aware(rng)
-    bench_config3(rng)
-    bench_config4(rng)
-    bench_config6_beyond_baseline(rng)
+    failed_sections: list = []
+
+    def guarded(name, fn, *args):
+        """Degrade gracefully on ENVIRONMENT failures ONLY: the tunnel's
+        remote-compile service 500s intermittently (observed
+        JaxRuntimeError: INTERNAL ... remote_compile HTTP 500), and one
+        flaky section must not cost the round its entire artifact. The
+        failure is recorded loudly as its own metric line (value 0,
+        vs_baseline 0) and in the final all-metrics summary, every other
+        section still runs, and the process exits non-zero.
+        AssertionError is NOT caught — parity-oracle mismatches and soak
+        invariant violations are correctness signal and abort the run."""
+        try:
+            return fn(*args)
+        except AssertionError:
+            raise
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            failed_sections.append(name)
+            entry = {
+                "metric": f"{name}_FAILED",
+                "value": 0,
+                "unit": "error",
+                "vs_baseline": 0.0,
+                "detail": {"error": err[:500]},
+            }
+            _RESULTS.append(entry)
+            print(json.dumps(entry), flush=True)
+            return None
+
+    guarded("tpu_parity", bench_tpu_parity)
+    guarded("tpu_invariant_soak", bench_tpu_soak)
+    guarded("config1", bench_config1, rng)
+    guarded("config2", bench_config2, rng)
+    guarded("config2b", bench_config2_az_aware, rng)
+    guarded("config3", bench_config3, rng)
+    guarded("config4", bench_config4, rng)
+    guarded("config6", bench_config6_beyond_baseline, rng)
     # North-star MEASUREMENT here — after the small kernel configs (whose
     # short chains are the jitter-sensitive ones: config1 measured 1.5 ms
     # quiet vs 4.7 ms after a config5 measurement) but BEFORE the serving
@@ -1298,28 +1328,43 @@ def main() -> None:
     # from the shared stream here would shift the serving benches' random
     # mix and break round-over-round comparability (the kernel is
     # data-independent, so config5's own timing is seed-insensitive).
-    emit_config5 = bench_config5(np.random.default_rng(5), defer=True)
-    bench_serving_http(rng)
+    emit_config5 = guarded(
+        "config5", bench_config5, np.random.default_rng(5), True
+    )
+    guarded("serving_http", bench_serving_http, rng)
     # In-process (subprocess, local cpu backend): runs alone, before the
     # concurrent benches, so nothing contends with it or them.
-    bench_serving_inprocess(rng)
+    guarded("serving_inprocess", bench_serving_inprocess, rng)
     # Executor bench BEFORE the long concurrent bench: the host-only
     # ladder numbers are the most sensitive to box heat / accumulated
     # process state, so measure them early.
-    bench_serving_http_executors(rng)
-    bench_serving_http_concurrent(rng)
-    bench_serving_http_concurrent_64c(rng)
+    guarded("serving_http_executors", bench_serving_http_executors, rng)
+    guarded("serving_http_concurrent", bench_serving_http_concurrent, rng)
+    guarded(
+        "serving_http_concurrent_64c", bench_serving_http_concurrent_64c, rng
+    )
     # North-star SCALE through the served stack (VERDICT r4 #1).
-    bench_serving_http_concurrent_10k(rng)
-    emit_config5()  # north star — the headline metric, measured up top
+    guarded(
+        "serving_http_concurrent_10k", bench_serving_http_concurrent_10k, rng
+    )
+    if emit_config5 is not None:
+        emit_config5()  # north star — the headline, measured up top
 
     # FINAL line, re-stating the headline with EVERY metric of the run
     # embedded compactly: the driver records the output tail, and earlier
     # per-metric lines have been lost to truncation in past rounds
     # (VERDICT r3 #6). One line now carries the whole round. The headline
-    # is the LAST recorded metric — bench_config5 emits the north-star
-    # gang_placement line last (its xla-scan companion precedes it).
-    headline = _RESULTS[-1] if _RESULTS else None
+    # is selected BY NAME (the north-star gang_placement metric) rather
+    # than positionally, so a degraded run cannot promote a serving
+    # metric — or an error stub — to the round's headline.
+    headline = next(
+        (
+            r
+            for r in reversed(_RESULTS)
+            if r["metric"].startswith("gang_placement_p50")
+        ),
+        _RESULTS[-1] if _RESULTS else None,
+    )
     if headline is not None:
         print(
             json.dumps(
@@ -1327,12 +1372,17 @@ def main() -> None:
                     **headline,
                     "detail": {
                         "summary": "all metrics of this bench run",
+                        "failed_sections": failed_sections,
                         "all_metrics": _RESULTS,
                     },
                 }
             ),
             flush=True,
         )
+    if failed_sections:
+        # A degraded artifact is still a FAILED run to any exit-code
+        # watcher (the metric lines above carry the detail).
+        raise SystemExit(1)
 
 
 if __name__ == "__main__":
