@@ -1223,12 +1223,16 @@ def bench_tpu_soak(total_steps: int = 1200):
     strategies_completed = 0
     env_error = None
     per = total_steps // 3
-    for seed, strategy in (
-        (42, "tightly-pack"),
-        (43, "az-aware-tightly-pack"),
-        (44, "single-az-tightly-pack"),
+    # Third leg at 500 nodes: production-scale candidate masks and window
+    # shapes through the kernel under churn (the 12-node legs keep the op
+    # mix dense; fresh-seed 500- and 1000-node soaks ran green before this
+    # landed).
+    for seed, strategy, n_nodes in (
+        (42, "tightly-pack", 12),
+        (43, "az-aware-tightly-pack", 12),
+        (44, "single-az-tightly-pack", 500),
     ):
-        soak = Soak(np.random.default_rng(seed), strategy)
+        soak = Soak(np.random.default_rng(seed), strategy, n_nodes=n_nodes)
         try:
             soak.run(per)
         except AssertionError:

@@ -40,7 +40,7 @@ CHECK_EVERY = 50  # full invariant sweep cadence (every step would be O(n^2))
 
 
 class Soak:
-    def __init__(self, rng, strategy):
+    def __init__(self, rng, strategy, n_nodes: int = 12):
         self.rng = rng
         # same_az under single-az strategies: without it the extender's
         # zone-restriction gate (is_single_az AND same-az-dynalloc config)
@@ -53,7 +53,7 @@ class Soak:
         )
         self.node_seq = 0
         self.nodes: dict[str, object] = {}
-        for _ in range(12):
+        for _ in range(n_nodes):
             self._add_node()
         self.app_seq = 0
         # app_id -> {"driver": Pod, "execs": [Pod], "node": str,
