@@ -35,6 +35,13 @@ def main(argv=None) -> int:
         default=None,
         help="apiserver base URL for list+watch ingestion (informer slot)",
     )
+    srv.add_argument(
+        "--autoscaler",
+        action="store_true",
+        help="enable the in-process elastic autoscaler: consume pending "
+        "Demand CRDs, provision simulated nodes, drain idle ones "
+        "(see the install config's `autoscaler:` block for knobs)",
+    )
     pc = sub.add_parser(
         "print-crds",
         help="emit the CustomResourceDefinition manifests as YAML "
@@ -127,6 +134,8 @@ def main(argv=None) -> int:
         config.durable_store_path = args.durable_store
     if args.kube_api_url is not None:
         config.kube_api_url = args.kube_api_url
+    if args.autoscaler:
+        config.autoscaler_enabled = True
 
     registry = MetricRegistry()
     metrics = SchedulerMetrics(registry, config.instance_group_label)

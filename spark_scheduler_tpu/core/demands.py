@@ -30,13 +30,17 @@ POD_DEMAND_CREATED_CONDITION = "PodDemandCreated"
 
 class DemandManager:
     def __init__(self, backend, demand_cache, instance_group_label: str,
-                 is_single_az_binpacker: bool = False, events=None, waste=None):
+                 is_single_az_binpacker: bool = False, events=None, waste=None,
+                 clock=None):
+        import time as _time
+
         self._backend = backend
         self._cache = demand_cache
         self._instance_group_label = instance_group_label
         self._is_single_az = is_single_az_binpacker
         self._events = events
         self._waste = waste
+        self._clock = clock or _time.time
 
     def deferred_sync(self):
         """Window-scoped write-back batching (WriteThroughCache.deferred_sync)
@@ -94,6 +98,10 @@ class DemandManager:
             namespace=pod.namespace,
             labels={SPARK_APP_ID_LABEL: app_id},
             owner_pod_uid=pod.uid,
+            # creationTimestamp rides the uninterpreted-metadata slot (it
+            # survives webhook conversion verbatim); the autoscaler anchors
+            # demand-to-fulfilled latency on it.
+            metadata_extra={"creationTimestamp": self._clock()},
             spec=DemandSpec(
                 instance_group=instance_group,
                 units=units,

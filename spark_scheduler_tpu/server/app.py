@@ -50,6 +50,7 @@ class SchedulerApp:
     demand_crd_watcher: LazyDemandCRDWatcher
     ingestion: object | None = None  # KubeIngestion when kube_api_url is set
     runtime_manager: object | None = None  # RuntimeConfigManager when configured
+    autoscaler: object | None = None  # ElasticAutoscaler when enabled
     _background_started: bool = False
 
     def start_background(self) -> None:
@@ -66,12 +67,16 @@ class SchedulerApp:
         self.rr_cache.start()
         self.unschedulable_marker.start()
         self.demand_crd_watcher.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start()
         if self.runtime_manager is not None:
             self.runtime_manager.start()
 
     def stop(self) -> None:
         if self.runtime_manager is not None:
             self.runtime_manager.stop()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self.ingestion is not None:
             self.ingestion.stop()
         self.demand_crd_watcher.stop()
@@ -131,6 +136,7 @@ def build_scheduler_app(
         is_single_az_binpacker=binpacker.is_single_az,
         events=events,
         waste=waste,
+        clock=clock,
     )
     # Demand features activate only once the Demand CRD exists — it belongs
     # to the external autoscaler and may appear any time after startup
@@ -158,8 +164,10 @@ def build_scheduler_app(
         from spark_scheduler_tpu.models.demands import DEMAND_NAME_PREFIX
 
         def _on_demand_update(old, new):
-            # External autoscaler flips the phase to fulfilled
-            # (waste.go:235-243 OnDemandFulfilled).
+            # The autoscaler flips the phase to fulfilled — the in-process
+            # ElasticAutoscaler when enabled, the external one otherwise
+            # (waste.go:235-243 OnDemandFulfilled). Either way it arrives
+            # here as a backend demand update.
             if new.is_fulfilled() and not old.is_fulfilled():
                 pod_name = new.name[len(DEMAND_NAME_PREFIX):]
                 waste.on_demand_fulfilled((new.namespace, pod_name))
@@ -243,6 +251,51 @@ def build_scheduler_app(
             clock=clock,
             insecure_skip_tls_verify=config.kube_api_insecure_skip_tls_verify,
         )
+    autoscaler = None
+    if config.autoscaler_enabled:
+        # In-process elastic autoscaler: consumes the pending demands this
+        # scheduler emits, provisions simulated nodes through the same
+        # backend, and drains idle ones — replacing the external cluster
+        # autoscaler (and the hand-rolled phase flips tests used to do).
+        from spark_scheduler_tpu.autoscaler import (
+            AutoscalerMetrics,
+            ElasticAutoscaler,
+            NodeProvisioner,
+            ScaleDownDrainer,
+        )
+        from spark_scheduler_tpu.models.resources import Resources
+
+        autoscaler = ElasticAutoscaler(
+            backend,
+            provisioner=NodeProvisioner(
+                backend,
+                config.instance_group_label,
+                Resources.from_quantities(
+                    config.autoscaler_node_cpu,
+                    config.autoscaler_node_memory,
+                    config.autoscaler_node_gpu,
+                    round_up=False,
+                ),
+                zones=config.autoscaler_zones,
+                clock=clock,
+            ),
+            drainer=ScaleDownDrainer(
+                backend,
+                rr_cache,
+                soft_store,
+                idle_ttl_s=config.autoscaler_idle_ttl_s,
+                clock=clock,
+            ),
+            max_cluster_size=config.autoscaler_max_cluster_size,
+            poll_interval_s=config.autoscaler_poll_interval_s,
+            metrics=AutoscalerMetrics(
+                metrics.registry if metrics is not None else None
+            ),
+            clock=clock,
+        )
+        # The demand-add wakeup waits for the Demand CRD like every other
+        # demand consumer.
+        demand_crd_watcher.on_ready(autoscaler.attach)
     # A pre-existing Demand CRD (registered before the app was built)
     # activates demand features synchronously; otherwise the background
     # poll in start_background() picks it up.
@@ -263,6 +316,7 @@ def build_scheduler_app(
         unschedulable_marker=marker,
         demand_crd_watcher=demand_crd_watcher,
         ingestion=ingestion,
+        autoscaler=autoscaler,
     )
     if config.runtime_config_path:
         from spark_scheduler_tpu.server.runtime import RuntimeConfigManager

@@ -90,6 +90,28 @@ class InstallConfig:
     # a lone request on an idle server is never held).
     predicate_max_window: int = 32
     predicate_hold_ms: float = 25.0
+    # In-process elastic autoscaler (spark_scheduler_tpu/autoscaler/): when
+    # enabled, pending Demand CRDs are consumed IN PROCESS — simulated
+    # nodes are provisioned (zone-affine, template-shaped) and demand
+    # phases flip pending -> fulfilled / cannot-fulfill; nodes idle past
+    # the TTL are cordoned then drained, never a node holding a hard or
+    # soft reservation. Off by default: on a real cluster the Demand CRD
+    # belongs to the external autoscaler.
+    autoscaler_enabled: bool = False
+    # Hard cap on total node count; demands that would push past it are
+    # marked cannot-fulfill.
+    autoscaler_max_cluster_size: int = 1000
+    # A node idle (no reservations, no bound pods) this long is cordoned,
+    # then removed on the next pass if still idle.
+    autoscaler_idle_ttl_s: float = 300.0
+    autoscaler_poll_interval_s: float = 2.0
+    # Template shape of provisioned nodes (k8s quantity strings).
+    autoscaler_node_cpu: str = "8"
+    autoscaler_node_memory: str = "8Gi"
+    autoscaler_node_gpu: str = "1"
+    # Zones provisioned nodes spread across (round-robin) when a demand
+    # doesn't pin one; empty = the default zone.
+    autoscaler_zones: list[str] = dataclasses.field(default_factory=list)
     # Path to the REFRESHABLE runtime-config YAML (the witchcraft Runtime
     # embed, config.go:24-47): log level, fifo, batched-admission, and the
     # async retry budget reload live on file change or SIGHUP
@@ -161,6 +183,13 @@ class InstallConfig:
         # (examples/extender.yml:73-80); flat keys also accepted.
         server_block = raw.get("server") or {}
         ca_files = server_block.get("client-ca-files") or []
+        autoscaler_block = raw.get("autoscaler") or {}
+
+        def autoscaler_key(key, default):
+            # Present-but-null keys (`zones:` with no value — a common
+            # YAML idiom) must read as the default, not None.
+            v = autoscaler_block.get(key)
+            return default if v is None else v
         return cls(
             fifo=bool(raw.get("fifo", False)),
             fifo_config=fifo_cfg,
@@ -197,6 +226,20 @@ class InstallConfig:
             request_log=bool(raw.get("request-log", False)),
             predicate_max_window=int(raw.get("predicate-max-window", 32)),
             predicate_hold_ms=float(raw.get("predicate-hold-ms", 25.0)),
+            autoscaler_enabled=bool(autoscaler_key("enabled", False)),
+            autoscaler_max_cluster_size=int(
+                autoscaler_key("max-cluster-size", 1000)
+            ),
+            autoscaler_idle_ttl_s=_parse_duration(
+                autoscaler_key("idle-ttl", 300.0)
+            ),
+            autoscaler_poll_interval_s=_parse_duration(
+                autoscaler_key("poll-interval", 2.0)
+            ),
+            autoscaler_node_cpu=str(autoscaler_key("node-cpu", "8")),
+            autoscaler_node_memory=str(autoscaler_key("node-memory", "8Gi")),
+            autoscaler_node_gpu=str(autoscaler_key("node-gpu", "1")),
+            autoscaler_zones=list(autoscaler_key("zones", [])),
             runtime_config_path=raw.get("runtime-config-path"),
             jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),
         )

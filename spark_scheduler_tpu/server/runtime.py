@@ -7,10 +7,13 @@ without a restart. This module is that slot: a `RuntimeConfig` read from a
 YAML file, re-applied live when the file changes (mtime poll) or on SIGHUP.
 
 Reloadable knobs:
-  logging.level            -> svc1log minimum level
-  fifo                     -> ExtenderConfig.fifo
-  batched-admission        -> ExtenderConfig.batched_admission
-  async-client-retry-count -> write-back retry budget of both caches
+  logging.level                -> svc1log minimum level
+  fifo                         -> ExtenderConfig.fifo
+  batched-admission            -> ExtenderConfig.batched_admission
+  async-client-retry-count     -> write-back retry budget of both caches
+  autoscaler.idle-ttl          -> ScaleDownDrainer idle TTL (live resize of
+                                  the scale-down window)
+  autoscaler.max-cluster-size  -> ElasticAutoscaler provisioning cap
 
 Unknown keys are ignored (forward compatibility); a missing/unparseable
 file keeps the last good config (witchcraft behaviour: a bad runtime refresh
@@ -34,6 +37,8 @@ class RuntimeConfig:
     fifo: Optional[bool] = None
     batched_admission: Optional[bool] = None
     async_client_retry_count: Optional[int] = None
+    autoscaler_idle_ttl_s: Optional[float] = None
+    autoscaler_max_cluster_size: Optional[int] = None
 
     @classmethod
     def from_dict(cls, raw: dict) -> "RuntimeConfig":
@@ -42,11 +47,22 @@ class RuntimeConfig:
         fifo = raw.get("fifo")
         batched = raw.get("batched-admission")
         retries = raw.get("async-client-retry-count")
+        autoscaler_block = raw.get("autoscaler") or {}
+        idle_ttl = autoscaler_block.get("idle-ttl")
+        max_cluster = autoscaler_block.get("max-cluster-size")
+        if idle_ttl is not None:
+            from spark_scheduler_tpu.server.config import _parse_duration
+
+            idle_ttl = _parse_duration(idle_ttl)
         return cls(
             log_level=str(level) if level is not None else None,
             fifo=bool(fifo) if fifo is not None else None,
             batched_admission=bool(batched) if batched is not None else None,
             async_client_retry_count=int(retries) if retries is not None else None,
+            autoscaler_idle_ttl_s=idle_ttl,
+            autoscaler_max_cluster_size=(
+                int(max_cluster) if max_cluster is not None else None
+            ),
         )
 
 
@@ -134,6 +150,12 @@ class RuntimeConfigManager:
                 setter = getattr(cache, "set_max_retries", None)
                 if setter is not None:
                     setter(cfg.async_client_retry_count)
+        autoscaler = getattr(app, "autoscaler", None)
+        if autoscaler is not None:
+            if cfg.autoscaler_idle_ttl_s is not None:
+                autoscaler.drainer.idle_ttl_s = cfg.autoscaler_idle_ttl_s
+            if cfg.autoscaler_max_cluster_size is not None:
+                autoscaler.max_cluster_size = cfg.autoscaler_max_cluster_size
         self.current = cfg
         self.reloads += 1
         svc1log().info(
@@ -142,4 +164,6 @@ class RuntimeConfigManager:
             fifo=cfg.fifo,
             batched_admission=cfg.batched_admission,
             async_client_retry_count=cfg.async_client_retry_count,
+            autoscaler_idle_ttl_s=cfg.autoscaler_idle_ttl_s,
+            autoscaler_max_cluster_size=cfg.autoscaler_max_cluster_size,
         )

@@ -131,6 +131,7 @@ class Harness:
         events=None,
         waste=None,
         backend=None,
+        clock=None,
         **config_kw,
     ):
         # An injected backend (e.g. DurableBackend for restart tests) is
@@ -152,6 +153,7 @@ class Harness:
             metrics=metrics,
             events=events,
             waste=waste,
+            clock=clock,
         )
         self.extender = self.app.extender
         # suppress time-gap reconciliation in deterministic tests
@@ -202,6 +204,11 @@ class Harness:
 
     def demands(self):
         return self.app.demand_cache.list()
+
+    @property
+    def autoscaler(self):
+        """The ElasticAutoscaler when built with autoscaler_enabled=True."""
+        return self.app.autoscaler
 
 
 def overcommit_violations(app, backend) -> list[tuple[str, str]]:

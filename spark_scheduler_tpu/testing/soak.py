@@ -24,6 +24,8 @@ engine. Anchor: extendertest harness pattern
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from spark_scheduler_tpu.core.extender import ExtenderArgs
@@ -39,17 +41,51 @@ from spark_scheduler_tpu.testing.harness import (
 CHECK_EVERY = 50  # full invariant sweep cadence (every step would be O(n^2))
 
 
+class SoakClock:
+    """Monotonic wall clock with a manual offset. Real elapsed time flows
+    through (so demand-to-fulfilled latencies the bench reports are real),
+    while elastic ops advance the offset to cross the drainer's idle TTL
+    deterministically without sleeping."""
+
+    def __init__(self):
+        self._offset = 0.0
+
+    def __call__(self) -> float:
+        return time.monotonic() + self._offset
+
+    def advance(self, dt: float) -> None:
+        self._offset += dt
+
+
 class Soak:
-    def __init__(self, rng, strategy, n_nodes: int = 12):
+    def __init__(self, rng, strategy, n_nodes: int = 12, elastic: bool = False):
         self.rng = rng
+        self.elastic = elastic
+        self.clock = SoakClock() if elastic else None
         # same_az under single-az strategies: without it the extender's
         # zone-restriction gate (is_single_az AND same-az-dynalloc config)
         # stays False and the zone-restricted executor-reschedule ladder —
         # the very path the single-az matrix slot exists to soak — never
         # executes (verified by instrumentation in review).
+        elastic_kw = (
+            dict(
+                autoscaler_enabled=True,
+                # Low enough that autoscaler_tick ops cross it; real drains
+                # happen mid-soak and provisioned capacity recycles.
+                autoscaler_idle_ttl_s=30.0,
+                # Headroom for several bursts, low enough that a busy run
+                # exercises the cannot-fulfill cap path too.
+                autoscaler_max_cluster_size=n_nodes + 48,
+                autoscaler_zones=["zone0", "zone1", "zone2"],
+                clock=self.clock,
+            )
+            if elastic
+            else {}
+        )
         self.h = Harness(
             binpack_algo=strategy, fifo=True,
             same_az_dynamic_allocation="single-az" in strategy,
+            **elastic_kw,
         )
         self.node_seq = 0
         self.nodes: dict[str, object] = {}
@@ -74,6 +110,10 @@ class Soak:
         self.nodes[name] = node
 
     def node_names(self):
+        if self.elastic:
+            # Elastic topology is backend truth: autoscaled nodes join the
+            # candidate set, drained ones leave it.
+            return [n.name for n in self.h.backend.list_nodes()]
         return list(self.nodes)
 
     def _dispatch(self, args_list):
@@ -294,6 +334,56 @@ class Soak:
         }
         assert before == after, ("retry changed reservations", app_id)
 
+    # ------------------------------------------------------- elastic ops
+
+    def _assert_no_reserved_drained(self):
+        """THE drain-safety invariant: after any autoscaler pass, every node
+        a hard or soft reservation names must still exist."""
+        known = {n.name for n in self.h.backend.list_nodes()}
+        reserved = self.h.autoscaler.drainer.reserved_node_names()
+        missing = reserved - known
+        assert not missing, ("reserved node drained", missing, self.steps)
+
+    def op_elastic_burst(self):
+        """A gang too large for current free capacity: the failed admission
+        creates a Demand, the autoscaler provisions nodes for it, and the
+        retried driver should land on them. Each burst moves the node count
+        across the solver's padding buckets (_bucket(capacity, 8)) under
+        load — the recompile-boundary churn this soak mode exists for."""
+        self.drain()
+        execs = int(self.rng.integers(8, 17))
+        app_id = f"burst-{self.app_seq}"
+        self.app_seq += 1
+        pods = static_allocation_spark_pods(app_id, execs)
+        self.h.add_pods(pods[0])
+        self.admitted[app_id] = {
+            "driver": pods[0], "execs": pods[1:], "node": None,
+            "min": execs, "bound": {},
+        }
+        for attempt in range(3):
+            res = self.ext.predicate(
+                ExtenderArgs(pod=pods[0], node_names=self.node_names())
+            )
+            if res.ok:
+                self.admitted[app_id]["node"] = res.node_names[0]
+                self.h.backend.bind_pod(pods[0], res.node_names[0])
+                return
+            # Demand emitted for the failed fit -> provision -> retry. The
+            # retry may still fail (FIFO earlier drivers, or the cap) —
+            # the global invariants cover both outcomes.
+            self.h.autoscaler.run_once()
+            self._assert_no_reserved_drained()
+
+    def op_autoscaler_tick(self):
+        """One autoscaler control-loop pass after a clock jump: sub-TTL
+        jumps exercise idle tracking and cordons-in-progress, super-TTL
+        jumps complete drains. Reserved nodes must survive every pass."""
+        self.drain()  # topology may change: serving loop would drain too
+        ttl = self.h.autoscaler.drainer.idle_ttl_s
+        self.clock.advance(ttl * (0.6 if self.rng.random() < 0.5 else 1.1))
+        self.h.autoscaler.run_once()
+        self._assert_no_reserved_drained()
+
     # --------------------------------------------------------- invariants
 
     def check_invariants(self):
@@ -346,10 +436,15 @@ class Soak:
         ("write_fault", 4, op_write_fault),
         ("idempotent_retry", 8, op_idempotent_retry),
     )
+    ELASTIC_OPS = (
+        ("elastic_burst", 8, op_elastic_burst),
+        ("autoscaler_tick", 10, op_autoscaler_tick),
+    )
 
     def run(self, steps):
-        names = [name for name, w, _ in self.OPS for _ in range(w)]
-        fns = {name: fn for name, _, fn in self.OPS}
+        ops = self.OPS + (self.ELASTIC_OPS if self.elastic else ())
+        names = [name for name, w, _ in ops for _ in range(w)]
+        fns = {name: fn for name, _, fn in ops}
         while self.steps < steps:
             self.steps += 1
             name = names[int(self.rng.integers(0, len(names)))]

@@ -1,0 +1,333 @@
+"""Elastic autoscaler subsystem: provisioner packing math, zone affinity,
+the max-cluster-size cap, the reservation-aware two-phase drainer, runtime
+reloads of the autoscaler knobs, and the full end-to-end elastic scenario
+(demand -> provision -> place -> drain)."""
+
+from __future__ import annotations
+
+import pytest
+
+from spark_scheduler_tpu.autoscaler import (
+    PROVISIONED_BY_LABEL,
+    PROVISIONER_NAME,
+    NodeProvisioner,
+    ScaleDownDrainer,
+)
+from spark_scheduler_tpu.autoscaler.provisioner import nodes_needed
+from spark_scheduler_tpu.models.demands import (
+    PHASE_CANNOT_FULFILL,
+    PHASE_FULFILLED,
+    DemandUnit,
+)
+from spark_scheduler_tpu.models.kube import ZONE_LABEL
+from spark_scheduler_tpu.models.reservations import Reservation
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.testing.harness import (
+    INSTANCE_GROUP_LABEL,
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _res(cpu: str, mem: str, gpu: str = "0") -> Resources:
+    return Resources.from_quantities(cpu, mem, gpu, round_up=False)
+
+
+def elastic_harness(clock=None, **kw):
+    kw.setdefault("autoscaler_idle_ttl_s", 60.0)
+    kw.setdefault("autoscaler_max_cluster_size", 100)
+    return Harness(
+        autoscaler_enabled=True, clock=clock or FakeClock(), **kw
+    )
+
+
+# -- provisioner packing math ------------------------------------------------
+
+
+def test_nodes_needed_first_fit():
+    template = _res("8", "8Gi", "1")
+    # 1 driver (1cpu/1Gi) + 15 executors (1cpu/1Gi) = 16 cpu -> 2 nodes.
+    units = [
+        DemandUnit(resources=_res("1", "1Gi"), count=1),
+        DemandUnit(resources=_res("1", "1Gi"), count=15),
+    ]
+    assert nodes_needed(units, template) == 2
+    # memory-bound: 3 units of 4Gi -> 2 per node -> 2 nodes
+    assert nodes_needed([DemandUnit(_res("1", "4Gi"), 3)], template) == 2
+
+
+def test_nodes_needed_impossible_unit():
+    template = _res("8", "8Gi", "1")
+    # A 16-cpu unit can never fit an 8-cpu template node.
+    assert nodes_needed([DemandUnit(_res("16", "1Gi"), 1)], template) is None
+
+
+def test_provisioner_zone_pin_and_labels():
+    from spark_scheduler_tpu.store.backend import InMemoryBackend
+
+    backend = InMemoryBackend()
+    prov = NodeProvisioner(
+        backend, INSTANCE_GROUP_LABEL, _res("8", "8Gi", "1"),
+        zones=["za", "zb"],
+    )
+    pinned = prov.provision(3, "group-x", "zb")
+    assert all(n.zone == "zb" for n in pinned)
+    assert all(
+        n.labels[PROVISIONED_BY_LABEL] == PROVISIONER_NAME
+        and n.labels[INSTANCE_GROUP_LABEL] == "group-x"
+        for n in pinned
+    )
+    spread = prov.provision(4, "group-x", None)
+    assert {n.zone for n in spread} == {"za", "zb"}  # round-robin spread
+    assert len(backend.list_nodes()) == 7
+
+
+# -- controller phase decisions ----------------------------------------------
+
+
+def test_demand_fulfilled_honors_demand_zone():
+    """v1alpha2 zone affinity: a demand pinned to a zone gets every node
+    in that zone and the phase reports it as fulfilled_zone."""
+    h = elastic_harness(autoscaler_zones=["zone1", "zone2", "zone3"])
+    driver = static_allocation_spark_pods("app-z", 2)[0]
+    h.add_pods(driver)
+    demand = h.app.demand_manager.create_demand_for_executor(
+        driver, _res("1", "1Gi"), zone="zone2"
+    )
+    assert demand is not None
+    h.autoscaler.run_once()
+    d = h.backend.get("demands", demand.namespace, demand.name)
+    assert d.status.phase == PHASE_FULFILLED
+    assert d.status.fulfilled_zone == "zone2"
+    added = [
+        n for n in h.backend.list_nodes()
+        if n.labels.get(PROVISIONED_BY_LABEL) == PROVISIONER_NAME
+    ]
+    assert added and all(n.labels[ZONE_LABEL] == "zone2" for n in added)
+
+
+def test_cap_marks_cannot_fulfill():
+    h = elastic_harness(autoscaler_max_cluster_size=2)
+    h.add_nodes(new_node("n0"), new_node("n1"))  # already at the cap
+    pods = static_allocation_spark_pods("app-cap", 30)
+    r = h.schedule(pods[0], ["n0", "n1"])
+    assert not r.ok
+    summary = h.autoscaler.run_once()
+    assert summary["unfulfillable"] == 1 and summary["nodes_added"] == 0
+    # Phase lives on the BACKEND object (the autoscaler writes like the
+    # external one would); the owner cache only fast-forwards rv on watch.
+    d = h.backend.list("demands")[0]
+    assert d.status.phase == PHASE_CANNOT_FULFILL
+    assert h.autoscaler.metrics.counts()["demands_unfulfillable"] == 1
+
+
+def test_oldest_first_partial_fulfillment_under_cap():
+    """Two pending demands, cap headroom for one: the older fulfills, the
+    newer goes cannot-fulfill."""
+    clock = FakeClock()
+    h = elastic_harness(clock=clock, autoscaler_max_cluster_size=3)
+    h.add_nodes(new_node("n0"))
+    old_driver = static_allocation_spark_pods("app-old", 10)[0]
+    h.add_pods(old_driver)
+    assert not h.schedule(old_driver, ["n0"]).ok
+    clock.advance(5.0)
+    new_driver = static_allocation_spark_pods("app-new", 10)[0]
+    h.add_pods(new_driver)
+    assert not h.schedule(new_driver, ["n0"]).ok
+    h.autoscaler.run_once()
+    phases = {
+        d.name: d.status.phase for d in h.backend.list("demands")
+    }
+    assert phases["demand-app-old-driver"] == PHASE_FULFILLED
+    assert phases["demand-app-new-driver"] == PHASE_CANNOT_FULFILL
+
+
+# -- drainer -----------------------------------------------------------------
+
+
+def _drainer_rig(clock, ttl=60.0):
+    h = elastic_harness(clock=clock, autoscaler_idle_ttl_s=ttl)
+    prov = h.autoscaler.provisioner
+    nodes = prov.provision(2, "batch-medium-priority", None)
+    return h, nodes
+
+
+def test_drainer_two_phase_and_ttl():
+    clock = FakeClock()
+    h, nodes = _drainer_rig(clock)
+    drainer = h.autoscaler.drainer
+    assert drainer.run_once() == []  # idle clock starts now
+    clock.advance(59.0)
+    assert drainer.run_once() == []  # under TTL: nothing, not even cordon
+    assert not any(n.unschedulable for n in h.backend.list_nodes())
+    clock.advance(2.0)
+    assert drainer.run_once() == []  # phase 1: cordon only
+    assert all(n.unschedulable for n in h.backend.list_nodes())
+    assert sorted(drainer.run_once()) == sorted(n.name for n in nodes)
+    assert h.backend.list_nodes() == []
+
+
+def test_drainer_never_touches_reserved_nodes():
+    """Hard reservation on one provisioned node, soft on the other: neither
+    may be cordoned or drained, whatever the idle age."""
+    clock = FakeClock()
+    h, nodes = _drainer_rig(clock)
+    hard, soft = nodes
+    # Hard slot via the reservation cache (reservation_manager truth).
+    from spark_scheduler_tpu.models.reservations import (
+        ResourceReservation,
+        ReservationSpec,
+    )
+
+    h.app.rr_cache.create(
+        ResourceReservation(
+            name="app-hard",
+            namespace="namespace",
+            spec=ReservationSpec(
+                reservations={"driver": Reservation(hard.name, _res("1", "1Gi"))}
+            ),
+        )
+    )
+    h.app.soft_store.create_soft_reservation_if_not_exists("app-soft")
+    h.app.soft_store.add_reservation_for_pod(
+        "app-soft", "exec-1", Reservation(soft.name, _res("1", "1Gi"))
+    )
+    clock.advance(1e6)
+    for _ in range(3):
+        assert h.autoscaler.drainer.run_once() == []
+    live = {n.name: n for n in h.backend.list_nodes()}
+    assert set(live) == {hard.name, soft.name}
+    assert not any(n.unschedulable for n in live.values())
+
+
+def test_drainer_uncordons_when_node_becomes_busy():
+    clock = FakeClock()
+    h, nodes = _drainer_rig(clock)
+    drainer = h.autoscaler.drainer
+    drainer.run_once()  # idle tracking starts here
+    clock.advance(61.0)
+    drainer.run_once()  # cordons both
+    target = nodes[0].name
+    h.app.soft_store.create_soft_reservation_if_not_exists("app-race")
+    h.app.soft_store.add_reservation_for_pod(
+        "app-race", "exec-1", Reservation(target, _res("1", "1Gi"))
+    )
+    drained = drainer.run_once()  # busy one uncordoned, idle one drained
+    assert drained == [n.name for n in nodes if n.name != target]
+    survivor = h.backend.get_node(target)
+    assert survivor is not None and not survivor.unschedulable
+
+
+def test_drainer_readopts_cordoned_nodes_after_restart():
+    """A provisioned node cordoned by a PRE-RESTART drain pass (durable
+    backends persist nodes; the drainer's phase memory dies with the
+    process) must not leak forever: a fresh drainer re-adopts it and
+    removes it only after a FULL fresh TTL — never instantly."""
+    clock = FakeClock()
+    h, nodes = _drainer_rig(clock)
+    drainer = h.autoscaler.drainer
+    drainer.run_once()
+    clock.advance(61.0)
+    drainer.run_once()  # phase 1: both cordoned... then the process dies
+    assert all(n.unschedulable for n in h.backend.list_nodes())
+    fresh = ScaleDownDrainer(
+        h.backend, h.app.rr_cache, h.app.soft_store,
+        idle_ttl_s=60.0, clock=clock,
+    )
+    assert fresh.run_once() == []  # re-adopted, fresh TTL starts — no delete
+    clock.advance(59.0)
+    assert fresh.run_once() == []  # still under the fresh TTL
+    clock.advance(2.0)
+    assert fresh.run_once() == []  # TTL crossed: marked for drain
+    assert sorted(fresh.run_once()) == sorted(n.name for n in nodes)
+    assert h.backend.list_nodes() == []
+
+
+def test_drainer_ignores_static_fleet():
+    clock = FakeClock()
+    h = elastic_harness(clock=clock, autoscaler_idle_ttl_s=10.0)
+    h.add_nodes(new_node("static-0"))
+    clock.advance(1e6)
+    for _ in range(3):
+        assert h.autoscaler.drainer.run_once() == []
+    assert h.backend.get_node("static-0") is not None
+
+
+# -- runtime config reload ---------------------------------------------------
+
+
+def test_runtime_reload_of_autoscaler_knobs(tmp_path):
+    from spark_scheduler_tpu.server.runtime import RuntimeConfigManager
+
+    h = elastic_harness()
+    path = tmp_path / "runtime.yml"
+    path.write_text(
+        "autoscaler:\n  idle-ttl: 5m\n  max-cluster-size: 7\n"
+    )
+    mgr = RuntimeConfigManager(h.app, str(path))
+    assert mgr.check_now()
+    assert h.autoscaler.drainer.idle_ttl_s == 300.0
+    assert h.autoscaler.max_cluster_size == 7
+
+
+# -- end to end --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("binpack", ["tightly-pack", "single-az-tightly-pack"])
+def test_end_to_end_elastic_scenario(binpack):
+    """The acceptance scenario: a gang that cannot fit creates demands, the
+    autoscaler provisions nodes, the solver places the gang on them, idle
+    nodes later drain — and no reserved node is ever drained."""
+    clock = FakeClock()
+    h = Harness(
+        binpack_algo=binpack,
+        autoscaler_enabled=True,
+        autoscaler_idle_ttl_s=60.0,
+        autoscaler_max_cluster_size=50,
+        autoscaler_zones=["zone1", "zone2"],
+        clock=clock,
+    )
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-e2e", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    summary = h.autoscaler.run_once()
+    assert summary["fulfilled"] == 1 and summary["nodes_added"] >= 2
+    names = [n.name for n in h.backend.list_nodes()]
+    for p in pods:
+        assert h.schedule(p, names).ok, p.name
+    assert h.demands() == []  # deleted on successful schedule
+    # Reserved nodes never drain, however old.
+    clock.advance(1e5)
+    for _ in range(3):
+        h.autoscaler.run_once()
+    reserved = h.autoscaler.drainer.reserved_node_names()
+    assert reserved and reserved <= {n.name for n in h.backend.list_nodes()}
+    # Teardown -> nodes idle past TTL -> cordon, then drain.
+    for p in pods:
+        cur = h.backend.get("pods", p.namespace, p.name)
+        if cur is not None:
+            h.backend.delete_pod(cur)
+    rr = h.get_reservation("namespace", "app-e2e")
+    h.app.rr_cache.delete(rr.namespace, rr.name)
+    h.autoscaler.run_once()  # nodes observed idle: TTL clock starts
+    clock.advance(61.0)
+    h.autoscaler.run_once()  # cordon pass
+    drained = h.autoscaler.run_once()["drained"]
+    assert drained  # provisioned capacity handed back
+    assert h.backend.get_node("n0") is not None  # static fleet intact
+    counts = h.autoscaler.metrics.counts()
+    assert counts["demands_fulfilled"] == 1
+    assert counts["nodes_drained"] == len(drained)
+    assert h.autoscaler.metrics.scaleup_latency_samples()

@@ -1,0 +1,218 @@
+"""Demand phase transitions and DemandGC races, end to end through the
+in-process autoscaler: pending -> fulfilled, pending -> cannot-fulfill,
+demand deleted when its pod schedules before the autoscaler acts, and
+double-create idempotency."""
+
+from __future__ import annotations
+
+from spark_scheduler_tpu.models.demands import (
+    PHASE_CANNOT_FULFILL,
+    PHASE_EMPTY,
+    PHASE_FULFILLED,
+    PHASE_PENDING,
+    demand_name_for_pod,
+)
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _harness(**kw):
+    kw.setdefault("autoscaler_max_cluster_size", 100)
+    return Harness(autoscaler_enabled=True, clock=FakeClock(), **kw)
+
+
+def _backend_demand(h, name, namespace="namespace"):
+    return h.backend.get("demands", namespace, name)
+
+
+def test_pending_to_fulfilled():
+    h = _harness()
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-pf", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    name = demand_name_for_pod(pods[0])
+    assert _backend_demand(h, name).status.phase == PHASE_EMPTY
+    h.autoscaler.run_once()
+    d = _backend_demand(h, name)
+    # One pass both acks ("" -> pending) and fulfills; the transition time
+    # is stamped and the latency anchor is the demand's creationTimestamp.
+    assert d.status.phase == PHASE_FULFILLED
+    assert d.status.last_transition_time > 0 or d.metadata_extra
+    assert h.autoscaler.metrics.counts()["demands_fulfilled"] == 1
+
+
+def test_pending_to_cannot_fulfill_at_cap():
+    h = _harness(autoscaler_max_cluster_size=1)
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-cf", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    h.autoscaler.run_once()
+    d = _backend_demand(h, demand_name_for_pod(pods[0]))
+    assert d.status.phase == PHASE_CANNOT_FULFILL
+    assert len(h.backend.list_nodes()) == 1  # nothing provisioned
+
+
+def test_cap_limited_demand_retries_when_headroom_appears():
+    """A demand refused at the cap is NOT starved forever: once headroom
+    exists (cap raised here; drained capacity in production) the next pass
+    re-acks it pending and fulfills it."""
+    h = _harness(autoscaler_max_cluster_size=1)
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-fw", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    h.autoscaler.run_once()
+    name = demand_name_for_pod(pods[0])
+    assert _backend_demand(h, name).status.phase == PHASE_CANNOT_FULFILL
+    h.autoscaler.run_once()  # still no headroom: refusal is stable
+    assert _backend_demand(h, name).status.phase == PHASE_CANNOT_FULFILL
+    h.autoscaler.max_cluster_size = 100
+    h.autoscaler.run_once()
+    assert _backend_demand(h, name).status.phase == PHASE_FULFILLED
+    assert len(h.backend.list_nodes()) > 1
+
+
+def test_demand_deleted_when_pod_schedules_first():
+    """The DemandGC race: the pod gets capacity (another app tears down)
+    and schedules before the autoscaler ever acts on its demand. The GC
+    deletes the demand on the pod's scheduled transition, and the next
+    autoscaler pass must cope with the demand being gone."""
+    h = _harness()
+    h.add_nodes(new_node("n0"))
+    blocker = static_allocation_spark_pods("app-blocker", 6)
+    for p in blocker:
+        assert h.schedule(p, ["n0"]).ok
+    pods = static_allocation_spark_pods("app-race", 1)
+    assert not h.schedule(pods[0], ["n0"]).ok  # n0 full -> demand
+    name = demand_name_for_pod(pods[0])
+    assert _backend_demand(h, name) is not None
+    # Blocker tears down; the pod schedules WITHOUT the autoscaler.
+    for p in blocker:
+        h.backend.delete_pod(h.backend.get("pods", p.namespace, p.name))
+    rr = h.get_reservation("namespace", "app-blocker")
+    h.app.rr_cache.delete(rr.namespace, rr.name)
+    for p in pods:
+        assert h.schedule(p, ["n0"]).ok
+    assert _backend_demand(h, name) is None  # extender/GC deleted it
+    summary = h.autoscaler.run_once()  # must not provision for a ghost
+    assert summary["fulfilled"] == 0 and summary["nodes_added"] == 0
+
+
+def test_demand_gc_on_externally_bound_pod():
+    """demand_gc.go race cover: the demand's pod is bound by someone else
+    entirely (no extender success path) — the GC subscription alone must
+    delete the demand."""
+    h = _harness()
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-gc", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    name = demand_name_for_pod(pods[0])
+    assert _backend_demand(h, name) is not None
+    h.backend.bind_pod(pods[0], "n0")  # kube-scheduler binds it anyway
+    assert _backend_demand(h, name) is None
+
+
+def test_double_create_idempotency():
+    h = _harness()
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-dc", 20)
+    # Two failed attempts -> create_demand_for_application twice.
+    assert not h.schedule(pods[0], ["n0"]).ok
+    first = _backend_demand(h, demand_name_for_pod(pods[0]))
+    assert not h.schedule(pods[0], ["n0"]).ok
+    demands = h.backend.list("demands")
+    assert len(demands) == 1
+    assert demands[0].resource_version == first.resource_version
+    # And a pass fulfills ONE demand, once.
+    h.autoscaler.run_once()
+    assert h.autoscaler.metrics.counts()["demands_fulfilled"] == 1
+
+
+def test_fulfilled_phase_feeds_waste_reporter():
+    """The autoscaler's backend write is indistinguishable from the external
+    autoscaler's: the waste reporter's on-update subscription sees it."""
+    from spark_scheduler_tpu.metrics.waste import WasteReporter
+    from spark_scheduler_tpu.testing.harness import INSTANCE_GROUP_LABEL
+
+    clock = FakeClock()
+    w = WasteReporter(instance_group_label=INSTANCE_GROUP_LABEL, clock=clock)
+    h = Harness(
+        autoscaler_enabled=True, autoscaler_max_cluster_size=100,
+        clock=clock, waste=w,
+    )
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-wf", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    clock.advance(4.0)
+    h.autoscaler.run_once()
+    clock.advance(6.0)
+    names = [n.name for n in h.backend.list_nodes()]
+    assert h.schedule(pods[0], names).ok
+    snap = w.registry.snapshot()
+    from spark_scheduler_tpu.metrics.waste import SCHEDULING_WASTE
+
+    by_type = {e["tags"]["wastetype"]: e for e in snap[SCHEDULING_WASTE]}
+    assert abs(by_type["after-demand-fulfilled"]["max"] - 6.0) < 1e-6
+
+
+def test_phase_transition_stamps_time():
+    clock = FakeClock(t=100.0)
+    h = Harness(
+        autoscaler_enabled=True, autoscaler_max_cluster_size=100, clock=clock
+    )
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-ts", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    clock.advance(7.0)
+    h.autoscaler.run_once()
+    d = _backend_demand(h, demand_name_for_pod(pods[0]))
+    assert d.status.phase == PHASE_FULFILLED
+    assert d.status.last_transition_time == 107.0
+    # Latency anchored on creationTimestamp (stamped at create, t=100).
+    [latency] = h.autoscaler.metrics.scaleup_latency_samples()
+    assert abs(latency - 7.0) < 1e-6
+
+
+def test_ack_then_decision_are_separate_transitions():
+    """"" -> pending (ownership ack) and pending -> fulfilled are distinct
+    backend writes: an external dashboard watching resourceVersions sees
+    both. Intercept via a demand-update subscription."""
+    h = _harness()
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("app-pv", 20)
+    assert not h.schedule(pods[0], ["n0"]).ok
+    phases: list[str] = []
+    h.backend.subscribe(
+        "demands", on_update=lambda old, new: phases.append(new.status.phase)
+    )
+    h.autoscaler.run_once()
+    assert phases == [PHASE_PENDING, PHASE_FULFILLED]
+
+
+def test_impossible_unit_is_cannot_fulfill():
+    """A demand unit larger than an empty template node can never be
+    fulfilled by scale-up, whatever the cap."""
+    h = _harness()  # template 8 cpu
+    driver = static_allocation_spark_pods("app-imp", 1)[0]
+    h.add_pods(driver)
+    from spark_scheduler_tpu.models.resources import Resources
+
+    d = h.app.demand_manager.create_demand_for_executor(
+        driver, Resources.from_quantities("16", "1Gi", "0")
+    )
+    h.autoscaler.run_once()
+    assert _backend_demand(h, d.name).status.phase == PHASE_CANNOT_FULFILL
+    assert len(h.backend.list_nodes()) == 0
