@@ -56,6 +56,7 @@ class ElasticAutoscaler:
         max_cluster_size: int = 1000,
         poll_interval_s: float = 2.0,
         metrics: AutoscalerMetrics | None = None,
+        recorder=None,
         clock=None,
     ):
         import time as _time
@@ -66,6 +67,10 @@ class ElasticAutoscaler:
         self.max_cluster_size = max_cluster_size
         self._poll_interval_s = poll_interval_s
         self.metrics = metrics or AutoscalerMetrics()
+        # FlightRecorder: fulfilled demands annotate the denied decision
+        # that created them, closing the denial -> scale-up story on the
+        # record an operator pulls from GET /debug/decisions.
+        self._recorder = recorder
         self._clock = clock or _time.time
         # (namespace, name) -> first time this controller saw the demand;
         # fallback latency anchor when the creator didn't stamp
@@ -269,6 +274,17 @@ class ElasticAutoscaler:
             self.metrics.on_demand_fulfilled(
                 demand.spec.instance_group, max(0.0, now - anchor)
             )
+            if self._recorder is not None:
+                from spark_scheduler_tpu.models.demands import (
+                    DEMAND_NAME_PREFIX,
+                )
+
+                pod_name = demand.name
+                if pod_name.startswith(DEMAND_NAME_PREFIX):
+                    pod_name = pod_name[len(DEMAND_NAME_PREFIX):]
+                self._recorder.annotate_demand_fulfilled(
+                    demand.namespace, pod_name, max(0.0, now - anchor), now
+                )
         else:
             self.metrics.on_demand_unfulfillable(demand.spec.instance_group)
         self._first_seen.pop(key, None)

@@ -3,8 +3,8 @@
 Same shape as metrics/scheduler_metrics.py: `foundry.spark.scheduler.*`
 names so the series land next to the scheduler's own on dashboards. The
 scale-up latency histogram additionally keeps a bounded raw-sample list so
-the bench can report exact p50/p99 (the registry histogram only exposes
-p50/p95).
+the bench can report exact p50/p99 (the registry histogram's percentiles
+are reservoir-bounded approximations past its capacity).
 """
 
 from __future__ import annotations

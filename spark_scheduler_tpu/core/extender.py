@@ -114,7 +114,7 @@ class WindowTicket:
     __slots__ = (
         "args_list", "results", "roles", "timer_start", "window", "handle",
         "all_nodes", "by_name", "domains", "inflight_keys", "sync", "done",
-        "epoch",
+        "epoch", "featurize_ms", "solve_started",
     )
 
     def __init__(self, args_list):
@@ -134,6 +134,11 @@ class WindowTicket:
         # changed capacity while this window was in flight, its device
         # decisions are stale and the complete phase re-solves serially.
         self.epoch = -1
+        # Flight-recorder phase anchors: host featurize cost of the window
+        # dispatch, and the wall time the device solve started (the
+        # complete phase's fetch closes the solve interval).
+        self.featurize_ms = 0.0
+        self.solve_started = 0.0
 
 
 class SparkSchedulerExtender:
@@ -151,6 +156,7 @@ class SparkSchedulerExtender:
         metrics=None,
         events=None,
         waste=None,
+        recorder=None,
         clock=time.time,
     ):
         self._backend = backend
@@ -165,6 +171,9 @@ class SparkSchedulerExtender:
         self._metrics = metrics
         self._events = events
         self._waste = waste
+        # Scheduling flight recorder (observability/recorder.py): every
+        # decision below appends one explainable DecisionRecord.
+        self._recorder = recorder
         self._clock = clock
         self._last_request: float = 0.0
         # Apps whose gang admission is DISPATCHED but not yet applied (a
@@ -214,19 +223,29 @@ class SparkSchedulerExtender:
         try:
             self._reconcile_if_needed()
         except Exception as exc:  # failure to rebuild state is internal
-            return self._fail(args, FAILURE_INTERNAL, f"failed to reconcile: {exc}")
+            msg = f"failed to reconcile: {exc}"
+            self._record_decision(
+                pod, role, FAILURE_INTERNAL, None, args.node_names, msg
+            )
+            return self._fail(args, FAILURE_INTERNAL, msg)
         self._rrm.compact_dynamic_allocation_applications()
 
+        ctx: dict = {}
         with tracer().span(
             "select-node", role=role or "unknown", pod=f"{pod.namespace}/{pod.name}"
         ) as sp:
-            node, outcome, message = self._select_node(role, pod, args.node_names)
+            node, outcome, message = self._select_node(
+                role, pod, args.node_names, ctx=ctx
+            )
             sp.tag("outcome", outcome)
 
         if self._metrics is not None:
             self._metrics.mark_schedule_outcome(
                 pod, role, outcome, self._clock() - timer_start
             )
+        self._record_decision(
+            pod, role, outcome, node, args.node_names, message, ctx=ctx
+        )
         if node is None:
             return self._fail(args, outcome, message or outcome)
         return ExtenderFilterResult(node_names=[node], failed_nodes={}, outcome=outcome)
@@ -282,9 +301,15 @@ class SparkSchedulerExtender:
         try:
             self._reconcile_if_needed()
         except Exception as exc:
+            msg = f"failed to reconcile: {exc}"
+            for a in args_list:
+                self._record_decision(
+                    a.pod,
+                    a.pod.labels.get(SPARK_ROLE_LABEL, ""),
+                    FAILURE_INTERNAL, None, a.node_names, msg,
+                )
             t.results = [
-                self._fail(a, FAILURE_INTERNAL, f"failed to reconcile: {exc}")
-                for a in args_list
+                self._fail(a, FAILURE_INTERNAL, msg) for a in args_list
             ]
             t.done = True
             return t
@@ -368,15 +393,20 @@ class SparkSchedulerExtender:
                     self._serve_executor_window(t, run)
                     run = []
                 pod = args.pod
+                ctx: dict = {}
                 with tracer().span(
                     "select-node", role=roles[i] or "unknown",
                     pod=f"{pod.namespace}/{pod.name}",
                 ) as sp:
                     node, outcome, message = self._select_node(
-                        roles[i], pod, args.node_names
+                        roles[i], pod, args.node_names, ctx=ctx
                     )
                     sp.tag("outcome", outcome)
                 self._mark_outcome(pod, roles[i], outcome, t.timer_start)
+                self._record_decision(
+                    pod, roles[i], outcome, node, args.node_names, message,
+                    ctx=ctx,
+                )
                 if node is None:
                     results[i] = self._fail(args, outcome, message or outcome)
                 else:
@@ -400,6 +430,7 @@ class SparkSchedulerExtender:
         # Topology version BEFORE the node snapshot (capture-before-list):
         # a concurrent mutation then makes the version look stale (extra
         # walk / cache miss, safe), never fresh over an unsynced list.
+        featurize_start = self._clock()
         all_nodes, topo = self._list_nodes_versioned()
         t.all_nodes = all_nodes
         by_name = t.by_name = {n.name: n for n in all_nodes}
@@ -433,6 +464,9 @@ class SparkSchedulerExtender:
                 # Idempotent retry (resource.go:273-286).
                 node = rr.spec.reservations[DRIVER_RESERVATION].node
                 self._mark_outcome(pod, ROLE_DRIVER, SUCCESS, timer_start)
+                self._record_decision(
+                    pod, ROLE_DRIVER, SUCCESS, node, args.node_names
+                )
                 results[i] = ExtenderFilterResult(
                     node_names=[node], failed_nodes={}, outcome=SUCCESS
                 )
@@ -440,10 +474,13 @@ class SparkSchedulerExtender:
             try:
                 res = spark_resources(pod)
             except SparkPodError as exc:
+                msg = f"failed to get spark resources: {exc}"
                 self._mark_outcome(pod, ROLE_DRIVER, FAILURE_INTERNAL, timer_start)
-                results[i] = self._fail(
-                    args, FAILURE_INTERNAL, f"failed to get spark resources: {exc}"
+                self._record_decision(
+                    pod, ROLE_DRIVER, FAILURE_INTERNAL, None,
+                    args.node_names, msg,
                 )
+                results[i] = self._fail(args, FAILURE_INTERNAL, msg)
                 continue
             seen_apps.add((pod.namespace, app_id))
             window.append((i, pod, res, args))
@@ -543,6 +580,8 @@ class SparkSchedulerExtender:
                 )
             )
 
+        t.featurize_ms = (self._clock() - featurize_start) * 1e3
+        t.solve_started = self._clock()
         t.handle = self._solver.pack_window_dispatch(
             self.binpacker.name, tensors, requests
         )
@@ -562,10 +601,38 @@ class SparkSchedulerExtender:
             decisions = self._solver.pack_window_fetch(t.handle)
         finally:
             self._inflight_apps.difference_update(t.inflight_keys)
+        # Solve interval for the recorder: device dispatch -> decisions on
+        # host. On the pipelined path the blocking pull overlapped other
+        # windows' host work, so this is the wall time the WINDOW waited,
+        # not pure device time.
+        solve_ms = (self._clock() - t.solve_started) * 1e3
+        dispatch_info = t.handle.info
+        requests = t.handle.requests
         window, results, timer_start = t.window, t.results, t.timer_start
         all_nodes, by_name, domains = t.all_nodes, t.by_name, t.domains
         for k, (i, pod, res, args) in enumerate(window):
             d = decisions[k]
+            commit_start = self._clock()
+
+            def record(outcome, node, msg=""):
+                self._record_decision(
+                    pod, ROLE_DRIVER, outcome, node, args.node_names, msg,
+                    ctx={
+                        "featurize_ms": t.featurize_ms,
+                        "solve_ms": solve_ms,
+                        "commit_ms": (self._clock() - commit_start) * 1e3,
+                        # None when FIFO is off (rows then carries only
+                        # the request's own app — 0 would misread as
+                        # "first in queue").
+                        "queue_position": (
+                            len(requests[k].rows) - 1
+                            if self._config.fifo
+                            else None
+                        ),
+                        "solve_info": dispatch_info,
+                    },
+                )
+
             # Per-request trace span over the decision apply, same
             # name/tags as the solo path's — dashboards keyed on
             # select-node cover windowed serving too.
@@ -587,6 +654,7 @@ class SparkSchedulerExtender:
                         )
                     sp.tag("outcome", outcome)
                     self._mark_outcome(pod, ROLE_DRIVER, outcome, timer_start)
+                    record(outcome, None, msg)
                     results[i] = self._fail(args, outcome, msg)
                     continue
                 packing = d.packing
@@ -618,12 +686,14 @@ class SparkSchedulerExtender:
                     self._mark_outcome(
                         pod, ROLE_DRIVER, FAILURE_INTERNAL, timer_start
                     )
+                    record(FAILURE_INTERNAL, None, str(exc))
                     results[i] = self._fail(args, FAILURE_INTERNAL, str(exc))
                     continue
                 if self._events is not None:
                     self._events.emit_application_scheduled(pod, res)
                 sp.tag("outcome", SUCCESS)
                 self._mark_outcome(pod, ROLE_DRIVER, SUCCESS, timer_start)
+                record(SUCCESS, packing.driver_node)
                 results[i] = ExtenderFilterResult(
                     node_names=[packing.driver_node],
                     failed_nodes={},
@@ -656,6 +726,50 @@ class SparkSchedulerExtender:
                 pod, role, outcome, self._clock() - timer_start
             )
 
+    def _record_decision(
+        self, pod, role, outcome, node, node_names, message="", ctx=None,
+    ) -> None:
+        """Append one flight-recorder DecisionRecord. `ctx` is the per-
+        decision scratch dict the select paths fill: phase wall times
+        ("featurize_ms"/"solve_ms"/"commit_ms"), "queue_position" (earlier
+        FIFO drivers re-packed), and "solve_info" (the solver's dispatch
+        bucket + compile-cache verdict)."""
+        rec = self._recorder
+        if rec is None:
+            return
+        ctx = ctx or {}
+        # Capped at the recorder's per-record bound up front: on a
+        # 10k-node denial the reason is one identical message, and
+        # materializing the full map just for the recorder to truncate it
+        # would be an O(nodes) allocation per denial. (The wire response's
+        # full FailedNodes map is built by _fail as before.)
+        failed_nodes = (
+            rec.build_failure_map(node_names, message or outcome)
+            if node is None
+            else {}
+        )
+        rec.record(
+            namespace=pod.namespace,
+            pod_name=pod.name,
+            app_id=pod.labels.get(SPARK_APP_ID_LABEL, ""),
+            instance_group=(
+                find_instance_group(pod, self._config.instance_group_label)
+                or ""
+            ),
+            role=role or "unknown",
+            verdict=outcome,
+            node=node,
+            message=message,
+            failed_nodes=failed_nodes,
+            queue_position=ctx.get("queue_position"),
+            phases={
+                k: ctx[k]
+                for k in ("featurize_ms", "solve_ms", "commit_ms")
+                if k in ctx
+            },
+            solve=ctx.get("solve_info"),
+        )
+
     # ------------------------------------------------------------- plumbing
 
     def _fail(self, args: ExtenderArgs, outcome: str, message: str) -> ExtenderFilterResult:
@@ -682,10 +796,10 @@ class SparkSchedulerExtender:
         self._last_request = now
 
     def _select_node(
-        self, role: str, pod: Pod, node_names: list[str]
+        self, role: str, pod: Pod, node_names: list[str], ctx=None
     ) -> tuple[Optional[str], str, str]:
         if role == ROLE_DRIVER:
-            return self._select_driver_node(pod, node_names)
+            return self._select_driver_node(pod, node_names, ctx=ctx)
         if role == ROLE_EXECUTOR:
             node, outcome, msg = self._select_executor_node(pod, node_names)
             if outcome in SUCCESS_OUTCOMES:
@@ -696,8 +810,11 @@ class SparkSchedulerExtender:
     # --------------------------------------------------------------- driver
 
     def _select_driver_node(
-        self, driver: Pod, node_names: list[str]
+        self, driver: Pod, node_names: list[str], ctx=None
     ) -> tuple[Optional[str], str, str]:
+        if ctx is None:
+            ctx = {}
+        t0 = self._clock()
         app_id = driver.labels.get(SPARK_APP_ID_LABEL, "")
         rr = self._rrm.get_resource_reservation(app_id, driver.namespace)
         if rr is not None:
@@ -717,6 +834,9 @@ class SparkSchedulerExtender:
         earlier: Sequence[Pod] = ()
         if self._config.fifo:
             earlier = self._pod_lister.list_earlier_drivers(driver)
+            # None (not 0) when FIFO is off: the record must distinguish
+            # "first in queue" from "queue never consulted".
+            ctx["queue_position"] = len(earlier)
 
         if self._config.batched_admission and self._solver.can_batch(
             self.binpacker.name
@@ -736,9 +856,13 @@ class SparkSchedulerExtender:
             domain = self._solver.candidate_mask(
                 tensors, [n.name for n in available_nodes]
             )
+            s0 = self._clock()
+            ctx["featurize_ms"] = (s0 - t0) * 1e3
             packing, outcome, message = self._admit_driver_batched(
                 driver, app_resources, earlier, tensors, node_names, domain
             )
+            ctx["solve_ms"] = (self._clock() - s0) * 1e3
+            ctx["solve_info"] = self._solver.last_solve_info
             if packing is None:
                 self._demands.create_demand_for_application(driver, app_resources)
                 return None, outcome, message
@@ -746,9 +870,12 @@ class SparkSchedulerExtender:
             # Sequential fallback (batching disabled by config).
             overhead = self._overhead.get_overhead(available_nodes)
             tensors = self._solver.build_tensors(available_nodes, usage, overhead)
+            s0 = self._clock()
+            ctx["featurize_ms"] = (s0 - t0) * 1e3
             if earlier:
                 tensors, ok = self._fit_earlier_drivers(earlier, tensors, node_names)
                 if not ok:
+                    ctx["solve_ms"] = (self._clock() - s0) * 1e3
                     self._demands.create_demand_for_application(driver, app_resources)
                     return None, FAILURE_EARLIER_DRIVER, "earlier drivers do not fit to the cluster"
 
@@ -760,10 +887,13 @@ class SparkSchedulerExtender:
                 app_resources.min_executor_count,
                 node_names,
             )
+            ctx["solve_ms"] = (self._clock() - s0) * 1e3
+            ctx["solve_info"] = self._solver.last_solve_info
             if not packing.has_capacity:
                 self._demands.create_demand_for_application(driver, app_resources)
                 return None, FAILURE_FIT, "application does not fit to the cluster"
 
+        c0 = self._clock()
         if self._metrics is not None:
             self._metrics.report_packing_efficiency(self.binpacker.name, packing)
             self._metrics.report_cross_zone(
@@ -778,6 +908,7 @@ class SparkSchedulerExtender:
                 packing.executor_nodes,
             )
         except ReservationError as exc:
+            ctx["commit_ms"] = (self._clock() - c0) * 1e3
             return None, FAILURE_INTERNAL, str(exc)
         # Solo-path capacity change: stale in-flight windows must re-solve.
         self._capacity_epoch += 1
@@ -785,6 +916,7 @@ class SparkSchedulerExtender:
             # Only on fresh admission — the idempotent-retry branch above
             # must not double-emit application_scheduled (events.go:27-50).
             self._events.emit_application_scheduled(driver, app_resources)
+        ctx["commit_ms"] = (self._clock() - c0) * 1e3
         return packing.driver_node, SUCCESS, ""
 
     def _admit_driver_batched(
@@ -928,6 +1060,10 @@ class SparkSchedulerExtender:
             ) as sp:
                 sp.tag("outcome", outcome)
             self._mark_outcome(pod, ROLE_EXECUTOR, outcome, t.timer_start)
+            self._record_decision(
+                pod, ROLE_EXECUTOR, outcome, node,
+                args_list[i].node_names, message,
+            )
             if node is None:
                 results[i] = self._fail(args_list[i], outcome, message or outcome)
             else:

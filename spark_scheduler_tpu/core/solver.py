@@ -65,10 +65,11 @@ def _build_segmented_window(
     r_pad = 16
     while r_pad < int(rc.max()):
         r_pad *= 4
-    return segmented_window_from_flat(
+    win, seg_idx, row_idx = segmented_window_from_flat(
         drv_arr, exc_arr, counts, skip_arr, rc, cand_per_req, dom_per_req,
         pad_segments=s_pad, pad_rows=r_pad,
     )
+    return win, seg_idx, row_idx, s_pad, r_pad
 
 
 def _bucket(n: int, minimum: int) -> int:
@@ -84,6 +85,16 @@ def _host_view(tensors) -> ClusterTensors:
     host-side math (efficiency, masks, reconstruction) avoids pulling full
     arrays back over a tunneled device link."""
     return getattr(tensors, "host", tensors)
+
+
+def _tensors_nbytes(host) -> int:
+    """Total byte size of a host ClusterTensors — what a full device upload
+    ships (telemetry's h2d accounting)."""
+    total = 0
+    for f in dataclasses.fields(host):
+        arr = getattr(host, f.name, None)
+        total += getattr(arr, "nbytes", 0)
+    return total
 
 
 # Fields that force a full re-upload when they change (node topology /
@@ -289,6 +300,7 @@ class WindowHandle:
         "strategy", "blob", "blob_future", "requests", "flat_rows",
         "host_avail", "host_schedulable", "priors", "placements", "n",
         "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
+        "info",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
@@ -316,6 +328,9 @@ class WindowHandle:
         self.row_exec_req = None
         self.row_skippable = None
         self.seg_map = None  # pallas window path: (seg_idx, row_idx)
+        # Flight-recorder dispatch info: {"path", "nodes", "rows",
+        # "row_bucket", "emax", "compile_cache_hit"} — set at dispatch.
+        self.info = None
 
 
 class PlacementSolver:
@@ -368,6 +383,14 @@ class PlacementSolver:
         }
         # Which device path served each dispatched window (pallas | xla).
         self.window_path_counts: dict[str, int] = {}
+        # SolverTelemetry hook surface (observability/telemetry.py) — wired
+        # by build_scheduler_app; None keeps every hot-path hook a single
+        # attribute test.
+        self.telemetry = None
+        # Dispatch info of the most recent SOLO pack() ({"path", "nodes",
+        # "emax", "compile_cache_hit"}) for the flight recorder.
+        # Single-threaded by the same contract as the pipeline state.
+        self.last_solve_info: dict | None = None
 
     @property
     def uses_native_arena(self) -> bool:
@@ -473,14 +496,24 @@ class PlacementSolver:
                     )
                     stats["delta_uploads"] += 1
                     stats["delta_rows"] += k
+                    if self.telemetry is not None:
+                        self.telemetry.on_transfer(
+                            "h2d", rows.nbytes + idx.nbytes
+                        )
                 else:
                     tensors = dataclasses.replace(
                         dev["tensors"], available=jax.device_put(host.available)
                     )
                     stats["full_uploads"] += 1
+                    if self.telemetry is not None:
+                        self.telemetry.on_transfer(
+                            "h2d", host.available.nbytes
+                        )
         if tensors is None:
             tensors = jax.device_put(host)
             stats["full_uploads"] += 1
+            if self.telemetry is not None:
+                self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
         tensors.host = host
         self._dev = {"host": host, "tensors": tensors}
         return tensors
@@ -500,6 +533,8 @@ class PlacementSolver:
         host view is the durable truth once every surviving window has
         applied."""
         self._pipe = None
+        if self.telemetry is not None:
+            self.telemetry.on_pipeline_event("discard")
 
     def build_tensors_pipelined(
         self,
@@ -556,6 +591,8 @@ class PlacementSolver:
                 and delta.max() <= np.iinfo(np.int32).max
             )
             if not fits_i32 and p["unfetched"]:
+                if self.telemetry is not None:
+                    self.telemetry.on_pipeline_event("drain")
                 raise PipelineDrainRequired(
                     "availability delta exceeds int32 with a window in flight"
                 )
@@ -571,6 +608,10 @@ class PlacementSolver:
                     avail = _add_rows(avail, jnp.asarray(idx), jnp.asarray(rows))
                     stats["delta_uploads"] += 1
                     stats["delta_rows"] += k
+                    if self.telemetry is not None:
+                        self.telemetry.on_transfer(
+                            "h2d", rows.nbytes + idx.nbytes
+                        )
                 else:
                     stats["reuse_hits"] += 1
                 tensors = dataclasses.replace(p["tensors"], available=avail)
@@ -578,12 +619,16 @@ class PlacementSolver:
                 p.update(host=host, tensors=tensors, avail=avail, mirror=cur)
                 return tensors
         if p is not None and p["unfetched"]:
+            if self.telemetry is not None:
+                self.telemetry.on_pipeline_event("drain")
             raise PipelineDrainRequired(
                 "cluster topology changed with a window in flight"
             )
         tensors = jax.device_put(host)
         tensors.host = host
         stats["full_uploads"] += 1
+        if self.telemetry is not None:
+            self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
         self._pipe = {
             "host": host,
             "tensors": tensors,
@@ -762,6 +807,8 @@ class PlacementSolver:
         if domain_mask is None:
             domain_mask = np.asarray(host.valid)
         emax = _bucket(max(executor_count, 1), 8)
+        tel = self.telemetry
+        compiles_before = tel.compile_count() if tel is not None else None
         # The span covers dispatch AND the device->host transfer — the
         # transfer is where the device work is actually awaited.
         with tracer().span(
@@ -785,6 +832,19 @@ class PlacementSolver:
                     num_zones=self._num_zones_bucket(),
                 )
             )
+        self.last_solve_info = {
+            "path": "xla",
+            "nodes": n,
+            "emax": emax,
+            "compile_cache_hit": (
+                tel.compile_count() == compiles_before
+                if tel is not None
+                else None
+            ),
+        }
+        if tel is not None:
+            tel.on_pack(nodes=n, emax=emax)
+            tel.on_transfer("d2h", getattr(blob, "nbytes", 0))
         driver_idx = int(blob[0])
         has_cap = bool(blob[1])
         executor_nodes = blob[2:]
@@ -936,22 +996,29 @@ class PlacementSolver:
         self.window_path_counts[path] = (
             self.window_path_counts.get(path, 0) + 1
         )
+        tel = self.telemetry
+        compiles_before = tel.compile_count() if tel is not None else None
+        seg_bucket = 1
         with tracer().span(
             "solve-dispatch", strategy=strategy, nodes=n,
             window_requests=len(requests), window_rows=b, batched=True,
             path=path,
         ):
             if use_pallas:
-                win, seg_idx, row_idx = _build_segmented_window(
-                    requests, drv_arr, exc_arr, counts, skip_arr,
-                    cand_per_req, dom_per_req,
+                win, seg_idx, row_idx, s_pad, r_pad = (
+                    _build_segmented_window(
+                        requests, drv_arr, exc_arr, counts, skip_arr,
+                        cand_per_req, dom_per_req,
+                    )
                 )
                 seg_map = (seg_idx, row_idx)
+                row_bucket, seg_bucket = r_pad, s_pad
                 blob, avail_after = _window_blob_pallas(
                     tensors, win, fill=strategy,
                     emax=emax, num_zones=self._num_zones_bucket(),
                 )
             else:
+                row_bucket = _bucket(b, 32)
                 apps = make_app_batch(
                     drv_arr,
                     exc_arr,
@@ -961,7 +1028,7 @@ class PlacementSolver:
                     # load and FIFO depth; each distinct bucket is a fresh
                     # XLA compile, which on a remote TPU stalls live
                     # serving for seconds.
-                    pad_to=_bucket(b, 32),
+                    pad_to=row_bucket,
                     driver_cand=np.stack(cand_rows),
                     domain=np.stack(dom_rows),
                     commit=commit,
@@ -972,6 +1039,31 @@ class PlacementSolver:
                     num_zones=self._num_zones_bucket(),
                 )
 
+        info = {
+            "path": path,
+            "nodes": n,
+            "rows": b,
+            "row_bucket": row_bucket * seg_bucket,
+            "emax": emax,
+            "compile_cache_hit": (
+                tel.compile_count() == compiles_before
+                if tel is not None
+                else None
+            ),
+        }
+        # The solo batched-admission path (a single-segment pack_window)
+        # reads this right after its solve, like pack()'s callers do.
+        self.last_solve_info = info
+        if tel is not None:
+            tel.on_window_dispatch(
+                path, nodes=n, rows=b, row_bucket=row_bucket,
+                segment_bucket=seg_bucket,
+            )
+            tel.on_transfer(
+                "h2d",
+                drv_arr.nbytes + exc_arr.nbytes + counts.nbytes
+                + skip_arr.nbytes,
+            )
         priors: tuple = ()
         p = self._pipe
         pipelined = p is not None and tensors is p["tensors"]
@@ -994,6 +1086,7 @@ class PlacementSolver:
         handle.row_exec_req = exc_arr.astype(np.int64)
         handle.row_skippable = skip_arr
         handle.seg_map = seg_map  # pallas path: [S,R] blob -> flat rows
+        handle.info = info
         if pipelined:
             p["unfetched"].append(handle)
             # Start the device->host pull NOW on the fetch thread: over a
@@ -1030,7 +1123,11 @@ class PlacementSolver:
                 # fine (their blobs are independent); they just skip the
                 # mirror debit of a dead pipeline.
                 self._pipe = None
+                if self.telemetry is not None:
+                    self.telemetry.on_pipeline_event("fetch-failure")
                 raise
+        if self.telemetry is not None:
+            self.telemetry.on_transfer("d2h", getattr(blob, "nbytes", 0))
         if handle.seg_map is not None:
             # Pallas window path: the device blob is [S, R, 3+emax];
             # flatten the real rows back into flat-row order host-side.

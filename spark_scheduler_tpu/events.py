@@ -20,10 +20,12 @@ DEMAND_DELETED = "foundry.spark.scheduler.demand_deleted"
 class EventEmitter:
     def __init__(self, sink=None, instance_group_label: str = "instance-group", clock=time.time):
         if sink is None:
-            stream = sys.stderr
-
+            # Resolve sys.stderr at EMIT time, not construction: capturing
+            # the stream object here silently ignores any later stderr
+            # redirection (capsys, contextlib.redirect_stderr, a daemon
+            # re-pointing fd 2) for an emitter built before it.
             def sink(event):
-                stream.write(json.dumps(event) + "\n")
+                sys.stderr.write(json.dumps(event) + "\n")
 
         self._sink = sink
         self._label = instance_group_label

@@ -46,12 +46,16 @@ class Gauge:
 class Histogram:
     """Bounded-reservoir histogram with exact small-sample percentiles."""
 
-    __slots__ = ("_values", "_count", "_max", "_sum", "_lock", "_cap")
+    __slots__ = ("_values", "_count", "_max", "_min", "_sum", "_lock", "_cap")
 
     def __init__(self, cap: int = 1024):
         self._values: list[float] = []
         self._count = 0
-        self._max = 0.0
+        # None sentinels: min AND max are exact over ALL samples (a 0.0
+        # max initializer would fabricate a never-observed 0.0 for
+        # all-negative series).
+        self._max: float | None = None
+        self._min: float | None = None
         self._sum = 0.0
         self._lock = threading.Lock()
         self._cap = cap
@@ -60,7 +64,8 @@ class Histogram:
         with self._lock:
             self._count += 1
             self._sum += value
-            self._max = max(self._max, value)
+            self._max = value if self._max is None else max(self._max, value)
+            self._min = value if self._min is None else min(self._min, value)
             if len(self._values) < self._cap:
                 self._values.append(value)
             else:  # reservoir replacement, deterministic stride
@@ -72,10 +77,17 @@ class Histogram:
             n = len(vs)
             return {
                 "count": self._count,
-                "max": self._max,
+                "max": self._max if self._max is not None else 0.0,
+                "min": self._min if self._min is not None else 0.0,
+                # Exact running sum — exposition must emit THIS, not
+                # mean*count: the reconstruction can shrink by an ulp
+                # between scrapes and Prometheus reads any _sum decrease
+                # as a counter reset (spurious rate() spikes).
+                "sum": self._sum,
                 "mean": self._sum / self._count if self._count else 0.0,
                 "p50": vs[min(int(0.5 * n), n - 1)] if n else 0.0,
                 "p95": vs[min(int(0.95 * n), n - 1)] if n else 0.0,
+                "p99": vs[min(int(0.99 * n), n - 1)] if n else 0.0,
             }
 
 

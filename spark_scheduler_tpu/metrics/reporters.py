@@ -21,6 +21,8 @@ USAGE_CPU = "foundry.spark.scheduler.resource.usage.cpu"
 USAGE_MEMORY = "foundry.spark.scheduler.resource.usage.memory"
 USAGE_GPU = "foundry.spark.scheduler.resource.usage.nvidia.com/gpu"
 LIFECYCLE_MAX = "foundry.spark.scheduler.pod.lifecycle.max"
+LIFECYCLE_MIN = "foundry.spark.scheduler.pod.lifecycle.min"
+LIFECYCLE_P99 = "foundry.spark.scheduler.pod.lifecycle.p99"
 LIFECYCLE_P95 = "foundry.spark.scheduler.pod.lifecycle.p95"
 LIFECYCLE_P50 = "foundry.spark.scheduler.pod.lifecycle.p50"
 LIFECYCLE_COUNT = "foundry.spark.scheduler.pod.lifecycle.count"
@@ -215,7 +217,10 @@ class QueueReporter:
                 "sparkrole": role,
                 "lifecycle": lifecycle,
             }
-            for name in (LIFECYCLE_COUNT, LIFECYCLE_MAX, LIFECYCLE_P95, LIFECYCLE_P50):
+            for name in (
+                LIFECYCLE_COUNT, LIFECYCLE_MAX, LIFECYCLE_MIN,
+                LIFECYCLE_P99, LIFECYCLE_P95, LIFECYCLE_P50,
+            ):
                 self._registry.unregister(name, **tags)
         self._seen_tags = set(buckets)
         for (group, role, lifecycle), ages in buckets.items():
@@ -228,6 +233,10 @@ class QueueReporter:
             n = len(ages)
             self._registry.gauge(LIFECYCLE_COUNT, **tags).set(n)
             self._registry.gauge(LIFECYCLE_MAX, **tags).set(ages[-1])
+            self._registry.gauge(LIFECYCLE_MIN, **tags).set(ages[0])
+            self._registry.gauge(LIFECYCLE_P99, **tags).set(
+                ages[min(int(0.99 * n), n - 1)]
+            )
             self._registry.gauge(LIFECYCLE_P95, **tags).set(
                 ages[min(int(0.95 * n), n - 1)]
             )

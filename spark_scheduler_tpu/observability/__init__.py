@@ -1,0 +1,45 @@
+"""Scheduling flight recorder + solver telemetry (SURVEY.md §0 decision
+explainability; the Witchcraft-middleware observability the Go reference got
+for free, adapted to the JAX hot path).
+
+  - `recorder`: every extender decision becomes a structured
+    `DecisionRecord` (verdict, per-node failure map, FIFO queue position,
+    padding bucket, compile-cache hit/miss, featurize/solve/commit phase
+    times) in a bounded thread-safe ring, queryable at
+    GET /debug/decisions.
+  - `telemetry`: `SolverTelemetry` — the hook surface core/solver.py calls
+    to publish jit-compile counts/seconds, padding-bucket occupancy,
+    pipeline drain/discard/fetch-failure counters, and host<->device
+    transfer bytes into the tagged registry under
+    `foundry.spark.scheduler.solver.*`.
+  - `exposition`: Prometheus text rendering of a MetricRegistry snapshot,
+    giving the push-only JSON-line reporter a pull surface (GET /metrics).
+  - `state`: the point-in-time GET /debug/state snapshot (hard/soft
+    reservations, FIFO queue, unschedulable set, node fleet).
+"""
+
+from spark_scheduler_tpu.observability.recorder import (  # noqa: F401
+    DecisionRecord,
+    FlightRecorder,
+)
+from spark_scheduler_tpu.observability.telemetry import (  # noqa: F401
+    SolverTelemetry,
+    compile_stats,
+)
+from spark_scheduler_tpu.observability.exposition import (  # noqa: F401
+    prefers_prometheus,
+    render_prometheus,
+)
+from spark_scheduler_tpu.observability.state import (  # noqa: F401
+    debug_state_snapshot,
+)
+
+__all__ = [
+    "DecisionRecord",
+    "FlightRecorder",
+    "SolverTelemetry",
+    "compile_stats",
+    "prefers_prometheus",
+    "render_prometheus",
+    "debug_state_snapshot",
+]

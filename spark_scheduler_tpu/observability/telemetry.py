@@ -1,0 +1,144 @@
+"""SolverTelemetry — the solver-internals hook surface.
+
+The reference publishes every serving subsystem's internals as tagged
+metrics (metrics/metrics.go discipline); the JAX hot path has internals the
+Go original never had — XLA compiles, padding-bucket occupancy, the
+dispatch-before-fetch pipeline, host<->device transfers — and this module
+makes them first-class `foundry.spark.scheduler.solver.*` series.
+
+Compile accounting rides jax.monitoring: ONE process-wide listener counts
+`backend_compile_duration` events (each is one real XLA/Mosaic compile; the
+jitted-call fast path emits nothing, so an unchanged count across a
+dispatch IS a compile-cache hit). A listener per SolverTelemetry would leak
+— jax offers no unregister, and the test matrix builds hundreds of apps —
+so instances read the shared totals against a construction-time baseline.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from spark_scheduler_tpu.metrics.registry import MetricRegistry
+
+JIT_COMPILES = "foundry.spark.scheduler.solver.jit.compiles"
+JIT_COMPILE_SECONDS = "foundry.spark.scheduler.solver.jit.compile.seconds"
+WINDOW_DISPATCHES = "foundry.spark.scheduler.solver.window.dispatches"
+BUCKET_OCCUPANCY = "foundry.spark.scheduler.solver.bucket.occupancy"
+PIPELINE_EVENTS = "foundry.spark.scheduler.solver.pipeline.events"
+TRANSFER_BYTES = "foundry.spark.scheduler.solver.transfer.bytes"
+SOLO_PACKS = "foundry.spark.scheduler.solver.packs"
+
+# The one real-compile event (trace/lowering events also fire per compile
+# but would triple-count).
+_COMPILE_EVENT = "backend_compile"
+
+_totals = {"count": 0, "seconds": 0.0}
+_totals_lock = threading.Lock()
+_listener_state = {"installed": False}
+
+
+def _install_listener() -> None:
+    if _listener_state["installed"]:
+        return
+    with _totals_lock:
+        if _listener_state["installed"]:
+            return
+        try:
+            from jax import monitoring
+
+            def _on_duration(event: str, duration: float, **kw) -> None:
+                if _COMPILE_EVENT in event:
+                    # jax calls listeners from the compiling thread; the
+                    # GIL makes these two updates effectively atomic
+                    # enough for telemetry, but take the lock anyway —
+                    # compiles are rare and the lock is uncontended.
+                    with _totals_lock:
+                        _totals["count"] += 1
+                        _totals["seconds"] += float(duration)
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:
+            pass  # jax without monitoring: compile stats stay zero
+        _listener_state["installed"] = True
+
+
+def compile_stats() -> dict:
+    """Process-wide XLA compile totals since the listener was installed."""
+    _install_listener()
+    with _totals_lock:
+        return dict(_totals)
+
+
+class SolverTelemetry:
+    """Publishes solver internals into a tagged registry. Hook methods are
+    cheap (a counter/histogram touch) and only ever called guarded by
+    `solver.telemetry is not None`."""
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        self.registry = registry or MetricRegistry()
+        _install_listener()
+        # Baseline so this scheduler reports ITS compiles, not the whole
+        # process's history (test matrices build many apps per process).
+        self._base = compile_stats()
+
+    # -- compiles ------------------------------------------------------------
+
+    def compile_count(self) -> int:
+        return compile_stats()["count"] - self._base["count"]
+
+    def sync_compile_gauges(self) -> None:
+        cur = compile_stats()
+        self.registry.gauge(JIT_COMPILES).set(
+            cur["count"] - self._base["count"]
+        )
+        self.registry.gauge(JIT_COMPILE_SECONDS).set(
+            round(cur["seconds"] - self._base["seconds"], 6)
+        )
+
+    # -- windows / packs -----------------------------------------------------
+
+    def on_window_dispatch(
+        self,
+        path: str,
+        *,
+        nodes: int,
+        rows: int,
+        row_bucket: int,
+        segment_bucket: int = 1,
+    ) -> None:
+        """One dispatched window solve: count it per device path and record
+        how full its padding bucket was (padding is pure waste the compile
+        cache buys; occupancy says whether the bucket grid fits the
+        workload)."""
+        self.registry.counter(WINDOW_DISPATCHES, path=path).inc()
+        denom = max(1, row_bucket * segment_bucket)
+        self.registry.histogram(
+            BUCKET_OCCUPANCY,
+            nodes=str(nodes),
+            apps=str(row_bucket * segment_bucket),
+            path=path,
+        ).update(min(1.0, rows / denom))
+        self.sync_compile_gauges()
+
+    def on_pack(self, *, nodes: int, emax: int) -> None:
+        self.registry.counter(
+            SOLO_PACKS, nodes=str(nodes), emax=str(emax)
+        ).inc()
+        self.sync_compile_gauges()
+
+    # -- pipeline ------------------------------------------------------------
+
+    def on_pipeline_event(self, event: str) -> None:
+        """drain | discard | fetch-failure — the pipelined serving loop's
+        exceptional paths, countable so a drain storm is visible."""
+        self.registry.counter(PIPELINE_EVENTS, event=event).inc()
+
+    # -- transfers -----------------------------------------------------------
+
+    def on_transfer(self, direction: str, nbytes: int) -> None:
+        """Host->device ("h2d") / device->host ("d2h") bytes the serving
+        path actually ships (delta rows, full uploads, decision blobs)."""
+        if nbytes > 0:
+            self.registry.counter(TRANSFER_BYTES, direction=direction).inc(
+                int(nbytes)
+            )

@@ -51,6 +51,7 @@ class SchedulerApp:
     ingestion: object | None = None  # KubeIngestion when kube_api_url is set
     runtime_manager: object | None = None  # RuntimeConfigManager when configured
     autoscaler: object | None = None  # ElasticAutoscaler when enabled
+    recorder: object | None = None  # FlightRecorder when flight_recorder is on
     _background_started: bool = False
 
     def start_background(self) -> None:
@@ -187,6 +188,24 @@ def build_scheduler_app(
             else None
         ),
     )
+    recorder = None
+    if config.flight_recorder:
+        # Flight recorder + solver telemetry: decision explainability
+        # (GET /debug/decisions) and foundry.spark.scheduler.solver.*
+        # series. Telemetry lands in the caller's registry when metrics
+        # are wired so GET /metrics exposes it; otherwise it keeps a
+        # private registry (still drives compile hit/miss on records).
+        from spark_scheduler_tpu.observability import (
+            FlightRecorder,
+            SolverTelemetry,
+        )
+
+        recorder = FlightRecorder(
+            capacity=config.flight_recorder_capacity, clock=clock
+        )
+        solver.telemetry = SolverTelemetry(
+            metrics.registry if metrics is not None else None
+        )
     # Delta-maintained reserved-usage aggregate over the solver's node-index
     # space: the hot path reads a dense array instead of walking every
     # reservation slot per request (SURVEY.md §7 latency budget).
@@ -223,6 +242,7 @@ def build_scheduler_app(
         metrics=metrics,
         events=events,
         waste=waste,
+        recorder=recorder,
         clock=clock,
     )
     marker = UnschedulablePodMarker(
@@ -291,6 +311,7 @@ def build_scheduler_app(
             metrics=AutoscalerMetrics(
                 metrics.registry if metrics is not None else None
             ),
+            recorder=recorder,
             clock=clock,
         )
         # The demand-add wakeup waits for the Demand CRD like every other
@@ -317,6 +338,7 @@ def build_scheduler_app(
         demand_crd_watcher=demand_crd_watcher,
         ingestion=ingestion,
         autoscaler=autoscaler,
+        recorder=recorder,
     )
     if config.runtime_config_path:
         from spark_scheduler_tpu.server.runtime import RuntimeConfigManager

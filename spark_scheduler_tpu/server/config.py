@@ -122,6 +122,15 @@ class InstallConfig:
     # restarted scheduler serves its first windows without multi-second
     # compile stalls. None = per-process compiles.
     jax_compilation_cache_dir: Optional[str] = None
+    # Scheduling flight recorder (observability/): every extender decision
+    # appends an explainable DecisionRecord (verdict, per-node failure map,
+    # FIFO queue position, padding bucket, compile-cache hit, phase wall
+    # times) to a bounded ring queryable at GET /debug/decisions, and the
+    # solver publishes foundry.spark.scheduler.solver.* telemetry. On by
+    # default — bench.py's recorder-overhead section keeps the hot-path
+    # cost measured; False strips both for the control measurement.
+    flight_recorder: bool = True
+    flight_recorder_capacity: int = 2048
 
     @staticmethod
     def enable_jax_compile_cache(cache_dir: str) -> None:
@@ -242,6 +251,10 @@ class InstallConfig:
             autoscaler_zones=list(autoscaler_key("zones", [])),
             runtime_config_path=raw.get("runtime-config-path"),
             jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),
+            flight_recorder=bool(raw.get("flight-recorder", True)),
+            flight_recorder_capacity=int(
+                raw.get("flight-recorder-capacity", 2048)
+            ),
         )
 
 

@@ -11,7 +11,16 @@ Routes (all JSON):
   GET  /status/liveness       200 when the process is up
   GET  /status/readiness      200 once cluster state has been synced
                               (at least one node known to the backend)
-  GET  /metrics               metric-registry snapshot
+  GET  /metrics               metric-registry snapshot: JSON by default,
+                              Prometheus text exposition when the Accept
+                              header prefers text/plain (or
+                              ?format=prometheus) — the pull surface for
+                              scrape stacks
+  GET  /debug/decisions       flight-recorder query (?app=&verdict=&role=
+                              &limit=), gated on debug-routes
+  GET  /debug/state           point-in-time scheduler state (hard/soft
+                              reservations, FIFO queue, unschedulable set,
+                              node fleet), gated on debug-routes
   PUT  /state/nodes           upsert a k8s Node object   \  informer-watch
   PUT  /state/pods            upsert a k8s Pod object     } substitute: the
   DELETE /state/pods/{ns}/{n} remove a pod               /  state-sync API
@@ -475,7 +484,7 @@ class _JSONHandler(BaseHTTPRequestHandler):
         # count against server error budgets and invite pointless retries).
         return 400 if isinstance(exc, UnframeableBody) else 500
 
-    def _write(self, code: int, payload) -> None:
+    def _consume_body_for_response(self) -> None:
         # Keep-alive discipline: a handler that answers without reading the
         # request body (404s, gated debug routes) would leave those bytes
         # in rfile and desync the NEXT request on this persistent
@@ -494,9 +503,11 @@ class _JSONHandler(BaseHTTPRequestHandler):
                 if length:
                     self.rfile.read(length)
             self._body_consumed = True
-        body = json.dumps(payload).encode()
+
+    def _write_raw(self, code: int, body: bytes, content_type: str) -> None:
+        self._consume_body_for_response()
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         if self.close_connection:
             # Advertise the close so a pipelining client doesn't race its
@@ -504,6 +515,12 @@ class _JSONHandler(BaseHTTPRequestHandler):
             self.send_header("Connection", "close")
         self.end_headers()
         self.wfile.write(body)
+
+    def _write(self, code: int, payload) -> None:
+        self._write_raw(code, json.dumps(payload).encode(), "application/json")
+
+    def _write_text(self, code: int, text: str, content_type: str) -> None:
+        self._write_raw(code, text.encode(), content_type)
 
     def parse_request(self):
         # Request-log clock: started AFTER the request line arrived, so a
@@ -704,19 +721,90 @@ class SchedulerHTTPServer:
 
         class Handler(_JSONHandler):
             def do_GET(self):
-                if self.path == "/status/liveness":
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                path, query = parsed.path, parse_qs(parsed.query)
+                if path == "/status/liveness":
                     self._handle_liveness()
-                elif self.path == "/status/readiness":
+                elif path == "/status/readiness":
                     code = 200 if outer.ready.is_set() else 503
                     self._write(code, {"ready": outer.ready.is_set()})
-                elif self.path == "/metrics":
+                elif path == "/metrics":
+                    # Compile gauges are pull-synced: the jax.monitoring
+                    # listener feeds process totals, the scrape publishes.
+                    telemetry = getattr(outer.app.solver, "telemetry", None)
+                    if telemetry is not None:
+                        telemetry.sync_compile_gauges()
                     snap = outer.registry.snapshot() if outer.registry else {}
-                    snap["predicate_batcher"] = outer.batcher.stats()
-                    self._write(200, snap)
-                elif self.path == "/debug/traces" and outer.debug_routes:
+                    fmt = (query.get("format") or [""])[0]
+                    accept = self.headers.get("Accept", "") or ""
+                    from spark_scheduler_tpu.observability import (
+                        prefers_prometheus,
+                        render_prometheus,
+                    )
+
+                    if fmt == "prometheus" or (
+                        fmt != "json" and prefers_prometheus(accept)
+                    ):
+                        # Prometheus text exposition: the pull surface for
+                        # scrape stacks (a Prometheus scraper's Accept
+                        # header selects it by q-value preference;
+                        # `?format=` forces either way).
+
+                        batcher = {
+                            f"foundry.spark.scheduler.predicate.batcher.{k}": v
+                            for k, v in outer.batcher.stats().items()
+                            if isinstance(v, (int, float))
+                        }
+                        self._write_text(
+                            200,
+                            render_prometheus(snap, extra_gauges=batcher),
+                            "text/plain; version=0.0.4",
+                        )
+                    else:
+                        snap["predicate_batcher"] = outer.batcher.stats()
+                        self._write(200, snap)
+                elif path == "/debug/traces" and outer.debug_routes:
                     from spark_scheduler_tpu.tracing import tracer
 
                     self._write(200, {"spans": tracer().finished_spans()})
+                elif path == "/debug/decisions" and outer.debug_routes:
+                    recorder = getattr(outer.app, "recorder", None)
+                    if recorder is None:
+                        self._write(
+                            404, {"error": "flight recorder disabled"}
+                        )
+                        return
+
+                    def q(name):
+                        vals = query.get(name)
+                        return vals[0] if vals else None
+
+                    try:
+                        limit = int(q("limit") or 100)
+                    except ValueError:
+                        self._write(400, {"error": "bad limit"})
+                        return
+                    self._write(
+                        200,
+                        {
+                            "decisions": recorder.query(
+                                app=q("app"),
+                                verdict=q("verdict"),
+                                role=q("role"),
+                                namespace=q("namespace"),
+                                limit=limit,
+                            ),
+                            "recorder": recorder.stats(),
+                        },
+                    )
+                elif path == "/debug/state" and outer.debug_routes:
+                    from spark_scheduler_tpu.observability import (
+                        debug_state_snapshot,
+                    )
+
+                    self._write(200, debug_state_snapshot(outer.app))
                 else:
                     self._write(404, {"error": "not found"})
 

@@ -402,6 +402,40 @@ class Soak:
             exec_slots = [k for k in rr.spec.reservations if k != "driver"]
             assert len(exec_slots) == entry["min"], (
                 "executor slot count", app_id)
+        # 5. flight-recorder cross-check: recorded verdicts match actual
+        #    placements (every checkpoint pass, observability contract).
+        self.check_recorder()
+
+    def check_recorder(self):
+        """Recorded verdict == actual placement: the newest driver record
+        of every admitted app is a success naming the reserved node, and
+        every denied record carries its per-node failure-reason map. The
+        soak is the one place windowed, solo, retried, and faulted
+        admissions all flow through the recorder under churn."""
+        rec = self.h.app.recorder
+        if rec is None:
+            return
+        for app_id, entry in self.admitted.items():
+            if entry["node"] is None:
+                continue
+            r = rec.latest_for_app("namespace", app_id, role="driver")
+            if r is None:
+                # The ring is bounded: a very long soak can evict an early
+                # admission's record while the app stays admitted. Only a
+                # missing record with ZERO evictions is a real failure —
+                # once the ring has dropped records, absence is expected.
+                assert rec.stats()["dropped"] > 0, (
+                    "admitted app has no decision record",
+                    app_id, self.steps,
+                )
+                continue
+            assert r.verdict == "success" and r.node == entry["node"], (
+                "recorded verdict diverges from placement",
+                app_id, r.verdict, r.node, entry["node"], self.steps,
+            )
+        for d in rec.query(verdict="failure-*", limit=25):
+            assert d["node"] is None and d["failed_nodes"], (
+                "denied record lacks its failure map", d, self.steps)
 
     def check_drained_mirror(self):
         """Invariant 3: with the pipeline drained, the device-embodied

@@ -114,6 +114,34 @@ def test_usage_cache_soft_reporters():
     assert R.USAGE_MEMORY not in snap2 or not snap2[R.USAGE_MEMORY]
 
 
+def test_histogram_stats_p99_and_min():
+    """Histogram.stats() exposes min/p99 alongside p50/p95 — bench.py and
+    the autoscaler report p99, so the registry view must carry it too."""
+    from spark_scheduler_tpu.metrics.registry import Histogram
+
+    h = Histogram()
+    for v in range(1, 101):
+        h.update(float(v))
+    s = h.stats()
+    assert s["min"] == 1.0 and s["max"] == 100.0
+    assert s["p50"] == 51.0 and s["p95"] == 96.0 and s["p99"] == 100.0
+    assert s["count"] == 100
+    # min is exact over ALL samples even after reservoir replacement
+    h2 = Histogram(cap=4)
+    for v in (5.0, 9.0, 1.0, 7.0, 8.0, 6.0):
+        h2.update(v)
+    assert h2.stats()["min"] == 1.0
+    # the exact running sum rides along (Prometheus _sum must be monotone,
+    # which a mean*count reconstruction is not)
+    assert s["sum"] == sum(range(1, 101))
+    # empty histogram reports zeros, not errors
+    empty = Histogram().stats()
+    assert empty == {
+        "count": 0, "max": 0.0, "min": 0.0, "sum": 0.0, "mean": 0.0,
+        "p50": 0.0, "p95": 0.0, "p99": 0.0,
+    }
+
+
 def test_queue_reporter_lifecycles():
     clock = FakeClock(t=100.0)
     registry = MetricRegistry()
@@ -130,6 +158,18 @@ def test_queue_reporter_lifecycles():
         e for e in snap[R.LIFECYCLE_COUNT] if e["tags"]["lifecycle"] == "queued"
     ]
     assert queued and queued[0]["value"] == 1
+    # p99/min ride along with p50/p95/max; a single queued pod makes them
+    # all equal its age.
+    by_name = {
+        name: next(
+            e for e in snap[name] if e["tags"]["lifecycle"] == "queued"
+        )["value"]
+        for name in (
+            R.LIFECYCLE_P50, R.LIFECYCLE_P95, R.LIFECYCLE_P99,
+            R.LIFECYCLE_MIN, R.LIFECYCLE_MAX,
+        )
+    }
+    assert len(set(by_name.values())) == 1, by_name
     stuck = []
     rep2 = QueueReporter(
         registry, h.backend, INSTANCE_GROUP_LABEL, clock=clock,

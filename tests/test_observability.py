@@ -1,0 +1,241 @@
+"""Observability smoke (tier-1): flight recorder, /debug/decisions,
+/debug/state, Prometheus /metrics exposition, and the metric-name lint
+(every registry series carries the foundry.spark.scheduler. prefix so
+dashboards keyed on the reference's namespace see one flat family).
+"""
+
+import http.client
+import json
+
+import pytest
+
+from spark_scheduler_tpu.metrics import MetricRegistry, SchedulerMetrics
+from spark_scheduler_tpu.server.app import build_scheduler_app
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    INSTANCE_GROUP_LABEL,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+METRIC_PREFIX = "foundry.spark.scheduler."
+
+
+@pytest.fixture()
+def server():
+    backend = InMemoryBackend()
+    backend.register_crd(DEMAND_CRD)
+    for i in range(4):
+        backend.add_node(new_node(f"n{i}"))
+    registry = MetricRegistry()
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=True,
+            sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+            debug_routes=True,
+        ),
+        metrics=SchedulerMetrics(registry, INSTANCE_GROUP_LABEL),
+    )
+    srv = SchedulerHTTPServer(
+        app, registry, port=0, debug_routes=True, request_timeout_s=120.0
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(port, path, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("GET", path, headers=headers or {})
+    resp = conn.getresponse()
+    body = resp.read()
+    ctype = resp.getheader("Content-Type", "")
+    conn.close()
+    return resp.status, ctype, body
+
+
+def _post_predicate(port, pod, node_names):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request(
+        "POST",
+        "/predicates",
+        body=json.dumps(
+            {"Pod": pod_to_k8s(pod), "NodeNames": node_names}
+        ).encode(),
+    )
+    out = json.loads(conn.getresponse().read())
+    conn.close()
+    return out
+
+
+def test_debug_decisions_metrics_and_state_smoke(server):
+    """The CI smoke: admit one gang, deny one oversized app, then scrape
+    /metrics (JSON + Prometheus) and /debug/decisions and lint every
+    registry series name."""
+    port = server.port
+    names = [f"n{i}" for i in range(4)]
+    backend = server.app.backend
+
+    ok_pods = static_allocation_spark_pods("obs-app", 2)
+    backend.add_pod(ok_pods[0])
+    admitted = _post_predicate(port, ok_pods[0], names)
+    assert admitted["NodeNames"], admitted
+
+    big = static_allocation_spark_pods("obs-big", 99)[0]
+    backend.add_pod(big)
+    denied = _post_predicate(port, big, names)
+    assert not denied["NodeNames"]
+
+    # ---- /debug/decisions: the denied driver's record is explainable.
+    status, _, body = _get(
+        port, "/debug/decisions?app=obs-big&verdict=failure-*"
+    )
+    assert status == 200
+    decisions = json.loads(body)["decisions"]
+    assert len(decisions) == 1
+    rec = decisions[0]
+    assert rec["verdict"] == "failure-fit"
+    assert set(rec["failed_nodes"]) == set(names)
+    assert rec["queue_position"] is not None
+    for phase in ("featurize_ms", "solve_ms"):
+        assert rec["phases"].get(phase, -1) >= 0, rec["phases"]
+    assert rec["solve"] and rec["solve"]["path"] in ("xla", "pallas")
+    assert rec["solve"]["compile_cache_hit"] in (True, False)
+
+    # Verdict filter + app filter behave.
+    status, _, body = _get(port, "/debug/decisions?app=obs-app&role=driver")
+    assert status == 200
+    ok_recs = json.loads(body)["decisions"]
+    assert ok_recs and ok_recs[0]["verdict"] == "success"
+    assert ok_recs[0]["node"] == admitted["NodeNames"][0]
+
+    # ---- /metrics JSON: solver telemetry series exist; lint the names.
+    status, ctype, body = _get(port, "/metrics")
+    assert status == 200 and "application/json" in ctype
+    snap = json.loads(body)
+    snap.pop("predicate_batcher", None)
+    assert any(
+        name.startswith("foundry.spark.scheduler.solver.") for name in snap
+    ), sorted(snap)
+    compiles = snap.get("foundry.spark.scheduler.solver.jit.compiles")
+    assert compiles and compiles[0]["value"] >= 1
+    occupancy = snap.get("foundry.spark.scheduler.solver.bucket.occupancy")
+    assert occupancy and occupancy[0]["count"] >= 1
+    assert all(name.startswith(METRIC_PREFIX) for name in snap), [
+        n for n in snap if not n.startswith(METRIC_PREFIX)
+    ]
+
+    # ---- /metrics Prometheus text: scraped with a text Accept header.
+    status, ctype, body = _get(
+        port, "/metrics", headers={"Accept": "text/plain"}
+    )
+    assert status == 200 and ctype.startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE foundry_spark_scheduler_requests counter" in text
+    assert "foundry_spark_scheduler_solver_jit_compiles" in text
+    assert 'quantile="0.99"' in text  # histogram p99 rides exposition
+    # explicit format override wins over Accept
+    status, ctype, _ = _get(
+        port, "/metrics?format=json", headers={"Accept": "text/plain"}
+    )
+    assert status == 200 and "application/json" in ctype
+    # q-values honored: a JSON-preferring client that merely TOLERATES
+    # text keeps JSON; a real scraper's openmetrics preference gets text
+    status, ctype, _ = _get(
+        port, "/metrics",
+        headers={"Accept": "application/json, text/plain;q=0.1"},
+    )
+    assert status == 200 and "application/json" in ctype
+    status, ctype, _ = _get(
+        port, "/metrics",
+        headers={
+            "Accept": (
+                "application/openmetrics-text;version=1.0.0,"
+                "text/plain;version=0.0.4;q=0.9"
+            )
+        },
+    )
+    assert status == 200 and ctype.startswith("text/plain")
+
+    # ---- /debug/state: reservations + FIFO queue + fleet in one snapshot.
+    status, _, body = _get(port, "/debug/state")
+    assert status == 200
+    state = json.loads(body)
+    assert state["nodes"]["count"] == 4
+    rr_names = {r["name"] for r in state["hard_reservations"]}
+    assert "obs-app" in rr_names
+    queue = {q["name"] for q in state["fifo_queue"]}
+    assert big.name in queue  # denied driver still pending in FIFO order
+    assert state["demands"], state  # denial created a demand
+    assert state["flight_recorder"]["total_recorded"] >= 2
+
+
+def test_debug_routes_stay_gated_without_flag():
+    backend = InMemoryBackend()
+    backend.add_node(new_node("n0"))
+    app = build_scheduler_app(backend, InstallConfig(sync_writes=True))
+    srv = SchedulerHTTPServer(app, MetricRegistry(), port=0)
+    srv.start()
+    try:
+        for path in ("/debug/decisions", "/debug/state"):
+            status, _, _ = _get(srv.port, path)
+            assert status == 404, path
+    finally:
+        srv.stop()
+
+
+def test_recorder_off_strips_the_surface():
+    """flight_recorder: false builds no recorder and no solver telemetry —
+    the bench's control configuration."""
+    h = Harness(binpack_algo="tightly-pack", flight_recorder=False)
+    assert h.app.recorder is None
+    assert h.app.solver.telemetry is None
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("off-app", 1)
+    assert h.schedule_app(pods, ["n0"])  # scheduling unaffected
+
+
+def test_autoscaler_annotates_fulfilled_demand_on_the_denial():
+    """demand->fulfilled transitions annotate the originating decision:
+    the denied driver's record gains the scale-up latency once the
+    in-process autoscaler provisions for its demand."""
+    h = Harness(
+        binpack_algo="tightly-pack",
+        autoscaler_enabled=True,
+        autoscaler_max_cluster_size=64,
+    )
+    h.add_nodes(new_node("n0"))
+    pods = static_allocation_spark_pods("scale-app", 12)  # cannot fit 1 node
+    r = h.schedule(pods[0], ["n0"])
+    assert not r.ok
+    rec = h.app.recorder.latest_for_app("namespace", "scale-app")
+    assert rec is not None and rec.verdict == "failure-fit"
+    assert rec.demand is None
+    h.autoscaler.run_once()
+    assert rec.demand is not None and rec.demand["latency_s"] >= 0.0
+    # and the gang now fits on the provisioned nodes
+    names = [n.name for n in h.backend.list_nodes()]
+    assert h.schedule(pods[0], names).ok
+
+
+def test_recorder_ring_is_bounded():
+    from spark_scheduler_tpu.observability import FlightRecorder
+
+    rec = FlightRecorder(capacity=8)
+    for i in range(20):
+        rec.record(
+            namespace="ns", pod_name=f"p{i}", app_id=f"a{i}",
+            instance_group="ig", role="driver", verdict="success",
+            node="n0",
+        )
+    stats = rec.stats()
+    assert stats["size"] == 8 and stats["dropped"] == 12
+    newest = rec.query(limit=100)
+    assert len(newest) == 8
+    assert newest[0]["pod_name"] == "p19" and newest[-1]["pod_name"] == "p12"

@@ -74,6 +74,66 @@ class TestTracer:
         (span,) = t.finished_spans()
         assert "ValueError" in span["tags"]["error"]
 
+    def test_ring_buffer_overflow_keeps_newest(self):
+        """The finished-span ring is bounded: overflow evicts oldest-first
+        and never grows past capacity."""
+        t = Tracer(capacity=4)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        spans = t.finished_spans()
+        assert len(spans) == 4
+        assert [s["name"] for s in spans] == ["s6", "s7", "s8", "s9"]
+        # clear() empties it; the ring keeps working afterwards
+        t.clear()
+        assert t.finished_spans() == []
+        with t.span("after"):
+            pass
+        assert [s["name"] for s in t.finished_spans()] == ["after"]
+
+    def test_b3_single_header_round_trip(self):
+        """`b3: {trace}-{span}-{sampled}` extraction round-trips through
+        inject_headers and back through a downstream extraction, for both
+        the sampled and unsampled decisions."""
+        trace, upstream_span = "ab" * 16, "cd" * 8
+        t = Tracer()
+        with t.root_from_headers(
+            {"b3": f"{trace}-{upstream_span}-1"}, "srv"
+        ) as root:
+            assert root.span.trace_id == trace
+            assert root.span.parent_id == upstream_span
+            hdrs = t.inject_headers()
+            assert hdrs["X-B3-TraceId"] == trace
+            assert hdrs["X-B3-Sampled"] == "1"
+            single = (
+                f"{hdrs['X-B3-TraceId']}-{hdrs['X-B3-SpanId']}"
+                f"-{hdrs['X-B3-Sampled']}"
+            )
+        t2 = Tracer()
+        with t2.root_from_headers({"b3": single}, "downstream") as child:
+            assert child.span.trace_id == trace
+            assert child.span.parent_id == root.span.span_id
+            assert child.span.sampled
+        assert [s["name"] for s in t2.finished_spans()] == ["downstream"]
+
+        # Unsampled: the deny decision survives the round trip AND
+        # suppresses recording on both hops.
+        t3 = Tracer()
+        with t3.root_from_headers(
+            {"b3": f"{trace}-{upstream_span}-0"}, "srv"
+        ):
+            hdrs0 = t3.inject_headers()
+            assert hdrs0["X-B3-Sampled"] == "0"
+            single0 = (
+                f"{hdrs0['X-B3-TraceId']}-{hdrs0['X-B3-SpanId']}-0"
+            )
+        assert t3.finished_spans() == []
+        t4 = Tracer()
+        with t4.root_from_headers({"b3": single0}, "downstream") as child0:
+            assert child0.span.trace_id == trace
+            assert not child0.span.sampled
+        assert t4.finished_spans() == []
+
 
 class TestServingTrace:
     def test_predicate_trace_structure_and_debug_route(self):
