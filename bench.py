@@ -414,7 +414,7 @@ def bench_config6_beyond_baseline(rng):
     )
 
 
-def _serving_fixture(n_nodes=500, max_window=None):
+def _serving_fixture(n_nodes=500, max_window=None, transport="threaded"):
     _enable_compile_cache()
     from spark_scheduler_tpu.server.app import build_scheduler_app
     from spark_scheduler_tpu.server.config import InstallConfig
@@ -437,9 +437,11 @@ def _serving_fixture(n_nodes=500, max_window=None):
         ),
     )
     # Generous request budget: the first window of each row-count bucket
-    # pays an XLA compile (~tens of seconds on a remote TPU).
+    # pays an XLA compile (~tens of seconds on a remote TPU). Load shedding
+    # off: a bench must measure the backlog, not refuse it.
     server = SchedulerHTTPServer(
-        app, host="127.0.0.1", port=0, request_timeout_s=600.0
+        app, host="127.0.0.1", port=0, request_timeout_s=600.0,
+        transport=transport, shed_queue_depth=0,
     )
     server.start()
     return backend, app, server, node_names
@@ -455,17 +457,18 @@ def _post_predicate(conn, driver, node_names):
     return resp, (time.perf_counter() - t0) * 1e3
 
 
-def bench_serving_http(rng):
+def bench_serving_http(rng, transport="threaded"):
     """Wall-clock p50 of the SERVED path with a SINGLE sequential client:
     POST /predicates -> extender -> batched solver -> reservation
     write-back, over a 500-node cluster. Includes host tensor deltas,
     device dispatch, and (on tunneled TPU) the relay RPC — the end-to-end
-    number an idle kube-scheduler sees per call."""
+    number an idle kube-scheduler sees per call. Runs per transport
+    (threaded | async) so the A/B is measured on the same box."""
     import http.client
 
     from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
 
-    backend, app, server, node_names = _serving_fixture()
+    backend, app, server, node_names = _serving_fixture(transport=transport)
     conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
     latencies_ms = []
     n_requests, warmup = 40, 6
@@ -484,12 +487,14 @@ def bench_serving_http(rng):
         dev_stats = dict(app.solver.device_state_stats)
         server.stop()
     p50 = float(np.percentile(latencies_ms, 50))
+    suffix = "" if transport == "threaded" else f"_{transport}"
     _emit(
-        "serving_http_predicate_p50_ms_500_nodes",
+        f"serving_http_predicate_p50_ms_500_nodes{suffix}",
         p50,
         1,
         {
             "nodes": 500,
+            "transport": transport,
             "requests": len(latencies_ms),
             "p95_ms": round(float(np.percentile(latencies_ms, 95)), 3),
             "path": "HTTP /predicates -> batched admission -> write-back",
@@ -586,7 +591,7 @@ def _reset_cluster_state(backend, app):
         backend.delete_pod(pod)
 
 
-def bench_serving_http_concurrent(rng):
+def bench_serving_http_concurrent(rng, transport="threaded"):
     """The VERDICT r2 #1 metric: CONCURRENT clients against /predicates.
     The PredicateBatcher coalesces whatever arrives while the previous
     window solves into one pack_window device program; the pipelined
@@ -603,11 +608,11 @@ def bench_serving_http_concurrent(rng):
     resource.go:221-258 semantics)."""
     _bench_serving_concurrent(
         rng, n_nodes=500, n_clients=32, per_client=8, warmup_rounds=2,
-        repeats=3, suffix="500_nodes",
+        repeats=3, suffix="500_nodes", transport=transport,
     )
 
 
-def bench_serving_http_concurrent_10k(rng):
+def bench_serving_http_concurrent_10k(rng, transport="threaded"):
     """VERDICT r4 #1: the SERVED system at north-star scale. Every serving
     metric before r5 was captured at 500 nodes; the 10k-node 26x number was
     kernel-only. This drives 1000 driver gang admissions over HTTP against
@@ -617,11 +622,11 @@ def bench_serving_http_concurrent_10k(rng):
     _bench_serving_concurrent(
         rng, n_nodes=10_000, n_clients=100, per_client=5, warmup_rounds=1,
         repeats=2, suffix="10k_nodes", max_window=128,
-        inprocess_control=True,
+        inprocess_control=(transport == "threaded"), transport=transport,
     )
 
 
-def bench_serving_http_concurrent_64c(rng):
+def bench_serving_http_concurrent_64c(rng, transport="threaded"):
     """The windowed design's intended regime: MORE concurrency per core.
     At 64 colocated clients the mean window doubles (16 vs 7.8 at 32
     clients) and both throughput AND p50 improve — amortization beats
@@ -633,16 +638,18 @@ def bench_serving_http_concurrent_64c(rng):
     # re-packs its pending earlier drivers) overflow the cluster.
     _bench_serving_concurrent(
         rng, n_nodes=500, n_clients=64, per_client=4, warmup_rounds=1,
-        repeats=3, suffix="500_nodes_64_clients",
+        repeats=3, suffix="500_nodes_64_clients", transport=transport,
     )
 
 
 def _bench_serving_concurrent(
     rng, *, n_nodes, n_clients, per_client, warmup_rounds, repeats, suffix,
-    max_window=None, inprocess_control=False,
+    max_window=None, inprocess_control=False, transport="threaded",
 ):
+    if transport != "threaded":
+        suffix = f"{suffix}_{transport}"
     backend, app, server, node_names = _serving_fixture(
-        n_nodes, max_window=max_window
+        n_nodes, max_window=max_window, transport=transport
     )
 
     def precompile_window_buckets():
@@ -784,6 +791,7 @@ def _bench_serving_concurrent(
                 "decisions_per_s": round(window * n_windows / inproc_wall, 1),
                 "windows_of": window,
                 "windows": n_windows,
+                "transport": "none",
                 "pipelined": True,
                 "path": (
                     "predicate_window_dispatch/complete, no HTTP framing"
@@ -830,9 +838,12 @@ def _bench_serving_concurrent(
         if solve_spans
         else None
     )
-    rig_ceiling, rig_err = _rig_ceiling_or_none(n_names=n_nodes)
+    rig_ceiling, rig_err = _rig_ceiling_or_none(
+        n_names=n_nodes, transport=transport
+    )
     detail = {
         "nodes": n_nodes,
+        "transport": transport,
         "overcommitted_nodes": overcommitted,
         "concurrent_clients": n_clients,
         "requests": total,
@@ -927,7 +938,8 @@ _RIG_CEILING: dict = {}
 
 
 def _rig_ceiling_or_none(
-    n_threads: int = 16, per: int = 30, n_names: int = 500
+    n_threads: int = 16, per: int = 30, n_names: int = 500,
+    transport: str = "threaded",
 ) -> tuple:
     """(ceiling, None) or (None, error string). The rig ceiling is CONTEXT
     for a section's primary metrics, not a primary metric itself: a client-
@@ -935,28 +947,41 @@ def _rig_ceiling_or_none(
     mid-detail-build) must not discard serving results already measured.
     Callers record the error string alongside a None ceiling instead."""
     try:
-        return _http_rig_ceiling(n_threads, per, n_names), None
+        return _http_rig_ceiling(n_threads, per, n_names, transport), None
     except Exception as exc:
         return None, f"{type(exc).__name__}: {exc}"
 
 
-def _http_rig_ceiling(
-    n_threads: int = 16, per: int = 30, n_names: int = 500
-) -> float:
-    """Control measurement: the SAME client rig (colocated threads,
-    keep-alive http.client, predicate-shaped bodies carrying `n_names`
-    node names — ~10 KB at 500, ~200 KB at 10k) against a null handler
-    that only reads the body and returns a canned decision — zero
-    scheduler work. On a 1-core bench box the stdlib HTTP stack + client
-    rig alone cap the measurable request rate; serving throughput bars
-    must be read against this harness floor the same way solo p50 is read
-    against the tunnel RTT floor. Memoized per body size (one
-    measurement per bench process)."""
-    memo_key = ("req_per_s", n_threads, per, n_names)
-    if memo_key in _RIG_CEILING:
-        return _RIG_CEILING[memo_key]
-    import http.client
+class _NullRoutes:
+    """Zero-work route table for the async null-handler rig: the same
+    canned decision the threaded null handler returns."""
+
+    _RESP = None
+
+    def __init__(self):
+        from spark_scheduler_tpu.server.routing import Response
+
+        self._resp = Response(200, b'{"NodeNames": ["bench-node-00000"]}')
+
+    def handle(self, req):
+        return self._resp
+
+    def handle_nowait(self, req, respond, schedule_timeout=None):
+        respond(self._resp)
+
+
+def _null_server(transport: str):
+    """(server_handle, port, stop_fn) for a null handler on `transport` —
+    identical response bytes either way, so the ceiling A/B isolates the
+    transport stack itself."""
     import threading
+
+    if transport == "async":
+        from spark_scheduler_tpu.server.transport_async import AsyncTransport
+
+        t = AsyncTransport(_NullRoutes(), "127.0.0.1", 0, request_timeout_s=60.0)
+        t.start()
+        return t.port, t.stop
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class _Null(BaseHTTPRequestHandler):
@@ -977,7 +1002,34 @@ def _http_rig_ceiling(
 
     srv = ThreadingHTTPServer(("127.0.0.1", 0), _Null)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
-    port = srv.server_address[1]
+
+    def stop():
+        srv.shutdown()
+        srv.server_close()
+
+    return srv.server_address[1], stop
+
+
+def _http_rig_ceiling(
+    n_threads: int = 16, per: int = 30, n_names: int = 500,
+    transport: str = "threaded",
+) -> float:
+    """Control measurement: the SAME client rig (colocated threads,
+    keep-alive http.client, predicate-shaped bodies carrying `n_names`
+    node names — ~10 KB at 500, ~200 KB at 10k) against a null handler
+    that only reads the body and returns a canned decision — zero
+    scheduler work. On a 1-core bench box the HTTP stack + client rig
+    alone cap the measurable request rate; serving throughput bars must be
+    read against this harness floor the same way solo p50 is read against
+    the tunnel RTT floor. Measured PER TRANSPORT (the A/B the async
+    event loop exists for). Memoized per (body size, transport)."""
+    memo_key = ("req_per_s", n_threads, per, n_names, transport)
+    if memo_key in _RIG_CEILING:
+        return _RIG_CEILING[memo_key]
+    import http.client
+    import threading
+
+    port, stop = _null_server(transport)
     names = [f"bench-node-{i:05d}" for i in range(n_names)]
     body = json.dumps({"Pod": {"metadata": {}}, "NodeNames": names}).encode()
 
@@ -1004,15 +1056,44 @@ def _http_rig_ceiling(
     for t in ths:
         t.join()
     wall = time.perf_counter() - t0
-    srv.shutdown()
-    srv.server_close()
+    stop()
     if errors:
         raise RuntimeError(f"rig-ceiling client failed: {errors[0]!r}")
     _RIG_CEILING[memo_key] = round(n_threads * per / wall, 1)
     return _RIG_CEILING[memo_key]
 
 
-def bench_serving_http_executors(rng):
+def bench_transport_rig_ceiling(rng):
+    """The tentpole A/B headline: the null-handler rig ceiling per
+    transport, same client rig, same 500-name predicate bodies. The async
+    line's vs_baseline is (async / threaded) / 2 — >= 1 means the event
+    loop at least DOUBLED the ceiling the served path was saturating."""
+    threaded = _http_rig_ceiling(transport="threaded")
+    async_ = _http_rig_ceiling(transport="async")
+    ratio = round(async_ / threaded, 2) if threaded else None
+    for transport, value, vs in (
+        ("threaded", threaded, 1.0),
+        ("async", async_, round((ratio or 0.0) / 2.0, 2)),
+    ):
+        entry = {
+            "metric": f"http_rig_ceiling_req_per_s_{transport}",
+            "value": value,
+            "unit": "req/s",
+            "vs_baseline": vs,
+            "detail": {
+                "transport": transport,
+                "async_over_threaded": ratio,
+                "clients": 16,
+                "body": "predicate-shaped, 500 node names",
+                "path": "null handler: read body, canned decision",
+                "r05_threaded": 372.4,
+            },
+        }
+        _RESULTS.append(entry)
+        print(json.dumps(entry), flush=True)
+
+
+def bench_serving_http_executors(rng, transport="threaded"):
     """Executor binding throughput: after a driver's gang admission, every
     executor request walks the reservation ladder (already-bound / unbound /
     reschedule, resource.go:376-428) — host-side state work with no device
@@ -1030,7 +1111,7 @@ def bench_serving_http_executors(rng):
 
     from spark_scheduler_tpu.server.kube_io import pod_to_k8s
 
-    backend, app, server, node_names = _serving_fixture()
+    backend, app, server, node_names = _serving_fixture(transport=transport)
     n_apps, execs_per_app, n_workers = 8, 16, 16
     exec_pods = []
     conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=600)
@@ -1057,26 +1138,29 @@ def bench_serving_http_executors(rng):
         ]
         for i in range(n_workers)
     ]
+    inproc_bps = None
     try:
         lats, wall_s = _threaded_phase(server.port, backend, sequences)
         # In-process control: bind another fleet of executors through the
         # REAL windowed path (predicate_window_dispatch/complete on the
         # same live app + stores) with no HTTP framing. Runs before
-        # server.stop() (stop closes the solver).
+        # server.stop() (stop closes the solver). Threaded arm only — the
+        # control has no transport in it and would just repeat.
         from spark_scheduler_tpu.core.extender import ExtenderArgs
 
         ext = app.extender
         inproc_pods = []
-        for i in range(n_apps):
-            pods = static_allocation_spark_pods(f"exi-{i}", execs_per_app)
-            backend.add_pod(pods[0])
-            r = ext.predicate(
-                ExtenderArgs(pod=pods[0], node_names=list(node_names))
-            )
-            if not r.node_names:
-                raise RuntimeError(f"driver exi-{i} failed: {r.outcome}")
-            backend.bind_pod(pods[0], r.node_names[0])
-            inproc_pods.extend(pods[1:])
+        if transport == "threaded":
+            for i in range(n_apps):
+                pods = static_allocation_spark_pods(f"exi-{i}", execs_per_app)
+                backend.add_pod(pods[0])
+                r = ext.predicate(
+                    ExtenderArgs(pod=pods[0], node_names=list(node_names))
+                )
+                if not r.node_names:
+                    raise RuntimeError(f"driver exi-{i} failed: {r.outcome}")
+                backend.bind_pod(pods[0], r.node_names[0])
+                inproc_pods.extend(pods[1:])
 
         def bind_window(pods):
             for p in pods:
@@ -1094,20 +1178,23 @@ def bench_serving_http_executors(rng):
                 backend.bind_pod(p, r.node_names[0])
 
         window = n_workers
-        bind_window(inproc_pods[:window])  # warm
-        rest = inproc_pods[window:]
-        t0 = time.perf_counter()
-        for i in range(0, len(rest), window):
-            bind_window(rest[i : i + window])
-        inproc_wall = time.perf_counter() - t0
-        inproc_bps = round(len(rest) / inproc_wall, 1)
+        if transport == "threaded":
+            bind_window(inproc_pods[:window])  # warm
+            rest = inproc_pods[window:]
+            t0 = time.perf_counter()
+            for i in range(0, len(rest), window):
+                bind_window(rest[i : i + window])
+            inproc_wall = time.perf_counter() - t0
+            inproc_bps = round(len(rest) / inproc_wall, 1)
     finally:
         server.stop()
-    rig_ceiling, rig_err = _rig_ceiling_or_none()
+    rig_ceiling, rig_err = _rig_ceiling_or_none(transport=transport)
     p50 = float(np.percentile(lats, 50))
     bps = len(lats) / wall_s
+    msuffix = "" if transport == "threaded" else f"_{transport}"
     detail = {
         "nodes": 500,
+        "transport": transport,
         "executors": len(lats),
         "p95_ms": round(float(np.percentile(lats, 95)), 3),
         "bindings_per_s": round(bps, 1),
@@ -1123,11 +1210,13 @@ def bench_serving_http_executors(rng):
         "path": "concurrent executor /predicates -> reservation ladder (host-side)",
     }
     _emit(
-        "serving_http_executor_p50_ms_500_nodes",
+        f"serving_http_executor_p50_ms_500_nodes{msuffix}",
         p50,
         1,
         detail,
     )
+    if inproc_bps is None:
+        return
     # The scheduler-side capability, free of the rig floor: the same
     # windowed executor path in process.
     _record(
@@ -1136,6 +1225,7 @@ def bench_serving_http_executors(rng):
         detail={
             "windows_of": window,
             "executors": len(rest),
+            "transport": "none",
             "path": "predicate_window_dispatch/complete, no HTTP framing",
             "target": "VERDICT r4 #2: >= 500 bindings/s",
         },
@@ -1558,7 +1648,11 @@ def main() -> None:
     emit_config5 = guarded(
         "config5", bench_config5, np.random.default_rng(5), True
     )
+    # Transport A/B headline: null-handler rig ceiling per transport
+    # (pure CPU HTTP; cheap, and the async >= 2x threaded bar lives here).
+    guarded("transport_rig_ceiling", bench_transport_rig_ceiling, rng)
     guarded("serving_http", bench_serving_http, rng)
+    guarded("serving_http_async", bench_serving_http, rng, "async")
     # Flight-recorder overhead: in-process on-vs-off control pair, cheap,
     # before the long concurrent benches heat the box.
     guarded("recorder_overhead", bench_recorder_overhead, rng)
@@ -1569,13 +1663,30 @@ def main() -> None:
     # ladder numbers are the most sensitive to box heat / accumulated
     # process state, so measure them early.
     guarded("serving_http_executors", bench_serving_http_executors, rng)
+    guarded(
+        "serving_http_executors_async",
+        bench_serving_http_executors, rng, "async",
+    )
     guarded("serving_http_concurrent", bench_serving_http_concurrent, rng)
+    guarded(
+        "serving_http_concurrent_async",
+        bench_serving_http_concurrent, rng, "async",
+    )
     guarded(
         "serving_http_concurrent_64c", bench_serving_http_concurrent_64c, rng
     )
-    # North-star SCALE through the served stack (VERDICT r4 #1).
+    guarded(
+        "serving_http_concurrent_64c_async",
+        bench_serving_http_concurrent_64c, rng, "async",
+    )
+    # North-star SCALE through the served stack (VERDICT r4 #1): both
+    # transports — the async arm is the ceiling lift AT scale.
     guarded(
         "serving_http_concurrent_10k", bench_serving_http_concurrent_10k, rng
+    )
+    guarded(
+        "serving_http_concurrent_10k_async",
+        bench_serving_http_concurrent_10k, rng, "async",
     )
     if emit_config5 is not None:
         emit_config5()  # north star — the headline, measured up top

@@ -36,6 +36,15 @@ def main(argv=None) -> int:
         help="apiserver base URL for list+watch ingestion (informer slot)",
     )
     srv.add_argument(
+        "--transport",
+        choices=("threaded", "async"),
+        default=None,
+        help="serving transport: 'threaded' (stdlib thread-per-connection,"
+        " default) or 'async' (single-threaded event loop with pipelined"
+        " keep-alive framing and explicit backpressure); overrides the"
+        " install config's server.transport",
+    )
+    srv.add_argument(
         "--autoscaler",
         action="store_true",
         help="enable the in-process elastic autoscaler: consume pending "
@@ -136,6 +145,8 @@ def main(argv=None) -> int:
         config.kube_api_url = args.kube_api_url
     if args.autoscaler:
         config.autoscaler_enabled = True
+    if args.transport is not None:
+        config.server_transport = args.transport
 
     registry = MetricRegistry()
     metrics = SchedulerMetrics(registry, config.instance_group_label)

@@ -24,6 +24,7 @@ from spark_scheduler_tpu.observability.recorder import (  # noqa: F401
 )
 from spark_scheduler_tpu.observability.telemetry import (  # noqa: F401
     SolverTelemetry,
+    TransportTelemetry,
     compile_stats,
 )
 from spark_scheduler_tpu.observability.exposition import (  # noqa: F401
@@ -38,6 +39,7 @@ __all__ = [
     "DecisionRecord",
     "FlightRecorder",
     "SolverTelemetry",
+    "TransportTelemetry",
     "compile_stats",
     "prefers_prometheus",
     "render_prometheus",

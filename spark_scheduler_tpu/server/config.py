@@ -76,6 +76,27 @@ class InstallConfig:
     # Per-connection socket read timeout (extender protocol budget is 30 s,
     # examples/extender.yml:59).
     request_timeout_s: float = 30.0
+    # Serving transport: "threaded" (stdlib thread-per-connection stack —
+    # the default until the bench A/B proves the async floor on the target
+    # box) or "async" (single-threaded event loop with pipelined keep-alive
+    # framing and explicit backpressure; see server/transport_async.py).
+    # YAML: `server.transport`.
+    server_transport: str = "threaded"
+    # Largest request body either transport will buffer; bigger bodies are
+    # answered 413 with the body drained (keep-alive survives). The 10k-node
+    # predicate bodies measure ~200 KB, so 16 MiB is generous headroom.
+    # YAML: `server.max-body-bytes`.
+    max_body_bytes: int = 16 * 1024 * 1024
+    # Async-transport connection cap: connections past it are answered with
+    # a canned 503 + close instead of accumulating per-connection state
+    # (the threaded transport's analogue is its bounded listen backlog).
+    # YAML: `server.max-connections`.
+    max_connections: int = 512
+    # Predicate load shedding: when the batcher's un-claimed backlog
+    # reaches this depth, new /predicates calls get an immediate 503
+    # instead of parking until the request timeout. 0 disables.
+    # YAML: `server.shed-queue-depth`.
+    shed_queue_depth: int = 256
     # Expose /debug/* (trace dump + JAX profiler control). Off by default:
     # on the cluster-exposed port these routes are unauthenticated.
     debug_routes: bool = False
@@ -231,6 +252,25 @@ class InstallConfig:
             kube_api_qps=float(raw.get("qps", 5.0)),
             kube_api_burst=int(raw.get("burst", 10)),
             request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
+            server_transport=str(
+                server_block.get("transport", raw.get("transport", "threaded"))
+            ),
+            max_body_bytes=int(
+                server_block.get(
+                    "max-body-bytes",
+                    raw.get("max-body-bytes", 16 * 1024 * 1024),
+                )
+            ),
+            max_connections=int(
+                server_block.get(
+                    "max-connections", raw.get("max-connections", 512)
+                )
+            ),
+            shed_queue_depth=int(
+                server_block.get(
+                    "shed-queue-depth", raw.get("shed-queue-depth", 256)
+                )
+            ),
             debug_routes=bool(raw.get("debug-routes", False)),
             request_log=bool(raw.get("request-log", False)),
             predicate_max_window=int(raw.get("predicate-max-window", 32)),

@@ -27,26 +27,81 @@ Routes (all JSON):
 
 The reference learns cluster state through apiserver watch streams
 (cmd/server.go:111-147); in environments without one, the state-sync routes
-carry the same information. Threaded stdlib server: the predicate handler is
-serialized by the extender's internal ordering, matching the reference's
-single Predicate goroutine assumption (SURVEY.md §0).
+carry the same information.
+
+This module is the SERVING CORE: the PredicateBatcher (the serialization
+point for mutable scheduling state) and the server facades that wire a
+route table (server/routing.py) onto a transport. Two transports exist,
+selected by the `server.transport` install knob:
+
+  threaded (default)  server/transport_threaded.py — the stdlib
+                      thread-per-connection stack; simplest to debug, but
+                      its ceiling is the stdlib's own (round-5: the served
+                      path reached 96.6% of its null-handler rig ceiling).
+  async               server/transport_async.py — a single-threaded event
+                      loop with an incremental HTTP/1.1 parser, pipelined
+                      keep-alive framing, one-write responses, and explicit
+                      backpressure (max-connections 503, max-body-bytes
+                      413, batcher-queue-depth load shedding). Requests
+                      hand straight to the PredicateBatcher; the handler
+                      threads it replaces were pure overhead.
 """
 
 from __future__ import annotations
 
-import json
 import threading
-import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from spark_scheduler_tpu.core.extender import ExtenderArgs
-from spark_scheduler_tpu.server.conversion import convert_review
-from spark_scheduler_tpu.server.kube_io import (
-    extender_args_from_k8s,
-    filter_result_to_k8s,
-    node_from_k8s,
-    pod_from_k8s,
+# Back-compat re-exports: these lived here before the transport split
+# (kube/apiserver.py wraps its listener with _maybe_wrap_tls; tests import
+# the framing exceptions from server.http).
+from spark_scheduler_tpu.server.routing import (  # noqa: F401
+    BodyTooLarge,
+    ConversionRoutes,
+    SchedulerRoutes,
+    UnframeableBody,
+    UnsupportedTransferEncoding,
 )
+from spark_scheduler_tpu.server.transport_threaded import (  # noqa: F401
+    ThreadedTransport,
+    _maybe_wrap_tls,
+    build_server_ssl_context,
+)
+
+TRANSPORTS = ("threaded", "async")
+
+
+class _CallbackEvent:
+    """Event-shaped completion hook for `PredicateBatcher.submit_nowait`:
+    the dispatcher's `entry[1].set()` fires the registered callback exactly
+    once (set is idempotent under races between the dispatcher and
+    `stop()`), so the dispatcher code path is identical for blocking and
+    callback entries."""
+
+    __slots__ = ("_cb", "_fired", "_lock")
+
+    def __init__(self, cb):
+        self._cb = cb
+        self._fired = False
+        self._lock = threading.Lock()
+
+    def set(self) -> None:
+        with self._lock:
+            if self._fired:
+                return
+            self._fired = True
+            cb, self._cb = self._cb, None
+        try:
+            cb()
+        except Exception:
+            # A failing responder (e.g. a client that vanished) must never
+            # kill the dispatcher thread mid-window.
+            pass
+
+    def is_set(self) -> bool:
+        return self._fired
+
+    def wait(self, timeout=None) -> bool:  # Event-interface parity
+        return self._fired
 
 
 class PredicateBatcher:
@@ -62,6 +117,10 @@ class PredicateBatcher:
     solve over every queued request. The dispatcher thread is ALSO the
     serialization point for mutable scheduling state, replacing the
     per-request lock (SURVEY.md §7 "Mutable-state races")."""
+
+    # Debug log of claim decisions is HARD-BOUNDED: recording stops at this
+    # many entries (tests/test_predicate_batcher.py pins the bound).
+    CLAIM_LOG_CAP = 4096
 
     def __init__(
         self, extender, max_window: int = 32, hold_ms: float = 25.0,
@@ -96,11 +155,13 @@ class PredicateBatcher:
         self._busy_ttl_s = 2.0
         self._busy_until = 0.0
         self._cv = threading.Condition()
-        self._queue: list[list] = []  # [args, event, result, exception]
+        self._queue: list[list] = []  # [args, event, result, exception, trace]
         # Entries the dispatcher has claimed whose events may not be set
         # yet — what stop() fails when the dispatcher thread is stalled in
         # a blocking fetch against a dead tunnel (join times out but
         # in-flight HTTP handlers must not hang until request timeout).
+        # Entries are REMOVED on completion (_finish_entries), so a
+        # timed-out-then-completed request never leaves a slot behind.
         self._claimed: list[list] = []
         self._stopped = False
         # Serving stats (surfaced at GET /metrics).
@@ -109,7 +170,7 @@ class PredicateBatcher:
         self.max_window_seen = 0
         # Debug log of claim decisions:
         # (window, queue_after, pending, hold_ms). Cheap appends; recording
-        # stops at the 4096-entry bound; stats() exposes the tail for
+        # stops at the CLAIM_LOG_CAP bound; stats() exposes the tail for
         # serving-dynamics forensics.
         self.claim_log: list[tuple] = []
         # Windows dispatched while another window was still in flight (the
@@ -134,16 +195,49 @@ class PredicateBatcher:
             # Shed the abandoned request: if the dispatcher has not claimed
             # it yet, remove it so no window slot is burned solving for a
             # client that already got an error (overload would otherwise
-            # spiral: dead entries crowd out live ones).
-            with self._cv:
-                try:
-                    self._queue.remove(entry)
-                except ValueError:
-                    pass  # already claimed — the solve proceeds harmlessly
+            # spiral: dead entries crowd out live ones). If it WAS claimed,
+            # the solve proceeds harmlessly and _finish_entries clears the
+            # claimed slot at completion.
+            self.abandon(entry)
             raise TimeoutError("predicate window timed out")
         if entry[3] is not None:
             raise entry[3]
         return entry[2]
+
+    def submit_nowait(self, args, done, trace_span=None):
+        """Callback-mode submission for event-loop transports: no thread
+        parks. `done(result, exc)` is invoked exactly once — from the
+        dispatcher thread on completion, or from the stopping thread at
+        shutdown. Returns the queue entry for use with `abandon`."""
+        entry = [args, None, None, None, trace_span]
+
+        def _fire():
+            done(entry[2], entry[3])
+
+        entry[1] = _CallbackEvent(_fire)
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("scheduler is shutting down")
+            self._queue.append(entry)
+            self._cv.notify()
+        return entry
+
+    def abandon(self, entry) -> bool:
+        """Remove a not-yet-claimed entry (client timed out / went away).
+        True when removed — its event/callback will never fire. False when
+        the dispatcher already claimed it: the solve proceeds and the
+        caller's completion hook must tolerate (or dedup) the late fire."""
+        with self._cv:
+            try:
+                self._queue.remove(entry)
+                return True
+            except ValueError:
+                return False
+
+    def queue_depth(self) -> int:
+        """Current un-claimed backlog — what 503 load shedding keys on."""
+        with self._cv:
+            return len(self._queue)
 
     def stop(self) -> None:
         with self._cv:
@@ -155,7 +249,8 @@ class PredicateBatcher:
         # request timeout — covers a dispatcher STALLED in a decision pull
         # against a dead tunnel (join timed out) and one that DIED with a
         # batch's events unset. No-op on a clean exit (everything is set);
-        # a late set() by a stalled thread is harmless.
+        # a late set() by a stalled thread is harmless (set is idempotent
+        # for both entry kinds).
         err = RuntimeError("scheduler is shutting down")
         with self._cv:
             leftovers = self._claimed + self._queue
@@ -266,7 +361,7 @@ class PredicateBatcher:
                     return
                 batch = self._queue[: self._max_window]
                 del self._queue[: self._max_window]
-                if batch and len(self.claim_log) < 4096:
+                if batch and len(self.claim_log) < self.CLAIM_LOG_CAP:
                     self.claim_log.append((
                         len(batch), len(self._queue), len(pending),
                         round(hold_ms, 1),
@@ -365,6 +460,19 @@ class PredicateBatcher:
         ):
             return self._extender.predicate_window_dispatch(args_list)
 
+    def _finish_entries(self, batch) -> None:
+        """Clear completed entries out of the claimed set immediately: a
+        request that timed out client-side while its window was in flight
+        must not leave its slot in `_claimed` until the next claim's lazy
+        rebuild happens to run (on an idle server that could be never)."""
+        with self._cv:
+            claimed = self._claimed
+            for entry in batch:
+                try:
+                    claimed.remove(entry)
+                except ValueError:
+                    pass
+
     def _complete_window(self, pending) -> bool:
         """Returns False when the window failed (entries got the error) —
         the serving loop then drains the rest of the pipeline before
@@ -394,12 +502,14 @@ class PredicateBatcher:
         for entry, result in zip(batch, results):
             entry[2] = result
             entry[1].set()
+        self._finish_entries(batch)
         return True
 
     def _fail_batch(self, batch, exc) -> None:
         for entry in batch:
             entry[3] = exc
             entry[1].set()
+        self._finish_entries(batch)
 
     def stats(self) -> dict:
         return {
@@ -407,6 +517,7 @@ class PredicateBatcher:
             "requests_served": self.requests_served,
             "max_window_seen": self.max_window_seen,
             "pipelined_windows": self.pipelined_windows,
+            "queue_depth": self.queue_depth(),
             "mean_window": (
                 round(self.requests_served / self.windows_served, 2)
                 if self.windows_served
@@ -417,270 +528,56 @@ class PredicateBatcher:
         }
 
 
-class UnframeableBody(ValueError):
-    """The request body's length cannot be determined safely (client
-    framing error — mapped to a 400, and the connection is closed)."""
+def _build_transport(
+    transport: str,
+    routes,
+    host: str,
+    port: int,
+    *,
+    cert_file,
+    key_file,
+    client_ca_files,
+    request_timeout_s,
+    request_log,
+    max_body_bytes,
+    max_connections,
+    telemetry,
+    name: str,
+):
+    if transport == "async":
+        from spark_scheduler_tpu.server.transport_async import AsyncTransport
 
-
-class UnsupportedTransferEncoding(UnframeableBody):
-    """Request body uses Transfer-Encoding (no chunked decoder here)."""
-
-
-class _JSONHandler(BaseHTTPRequestHandler):
-    """Shared JSON plumbing + the routes both servers serve
-    (liveness, POST /convert)."""
-
-    # Keep-alive: without this the stdlib default (HTTP/1.0) closes the
-    # connection after EVERY response, so each request pays TCP connect +
-    # a fresh handler thread — measured ~6 ms/call on loopback, dwarfing
-    # the actual handler work. Every _write sets Content-Length, which
-    # HTTP/1.1 persistent connections require.
-    protocol_version = "HTTP/1.1"
-
-    # Per-request structured access log (the witchcraft req2log slot,
-    # middleware/route.go:28-48). Opt-in per server via config
-    # `request-log` — flipped onto the Handler subclass at construction.
-    request_log = False
-
-    def log_message(self, *args):  # stdlib's unstructured stderr lines: quiet
-        pass
-
-    def log_request(self, code="-", size="-"):
-        # Called by send_response mid-request; capture the status and defer
-        # the log line to handle_one_request so it carries the FULL
-        # duration (handler + response write).
-        self._log_status = code
-
-    def _content_length(self) -> int:
-        """Validated Content-Length. Raises UnframeableBody — after flagging
-        the connection for drain+close — on negative or non-numeric values
-        (int() would raise / read(-1) would block to EOF) and on duplicate
-        headers with differing values (RFC 7230 3.3.2: reading only the
-        first would leave the rest of the body to desync the next keep-alive
-        request — request smuggling)."""
-        raws = self.headers.get_all("Content-Length") or []
-        vals = {r.strip() for r in raws}
-        length = None
-        if len(vals) <= 1:
-            raw = next(iter(vals), None)
-            if raw is None:
-                return 0
-            # RFC 7230: 1*DIGIT only. Bare int() also accepts '1_6', '+16'
-            # and Unicode digits — forms an RFC-strict proxy in front of us
-            # would frame differently (the smuggling vector again).
-            if raw.isascii() and raw.isdigit():
-                length = int(raw)
-            else:
-                length = None
-        if length is None or length < 0:
-            self.close_connection = True
-            self._drain_on_close = True
-            raise UnframeableBody("invalid Content-Length")
-        return length
-
-    @staticmethod
-    def _error_code(exc: Exception) -> int:
-        # Client framing errors are 4xx, not server failures (a 500 would
-        # count against server error budgets and invite pointless retries).
-        return 400 if isinstance(exc, UnframeableBody) else 500
-
-    def _consume_body_for_response(self) -> None:
-        # Keep-alive discipline: a handler that answers without reading the
-        # request body (404s, gated debug routes) would leave those bytes
-        # in rfile and desync the NEXT request on this persistent
-        # connection — drain them first.
-        if not getattr(self, "_body_consumed", False):
-            if self.headers.get("Transfer-Encoding"):
-                # Unframeable (and Content-Length may lie alongside it) —
-                # don't block in read(); close after this response instead.
-                self.close_connection = True
-                self._drain_on_close = True
-            else:
-                try:
-                    length = self._content_length()
-                except UnframeableBody:
-                    length = 0  # flagged: drained + closed after response
-                if length:
-                    self.rfile.read(length)
-            self._body_consumed = True
-
-    def _write_raw(self, code: int, body: bytes, content_type: str) -> None:
-        self._consume_body_for_response()
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        if self.close_connection:
-            # Advertise the close so a pipelining client doesn't race its
-            # next request onto a socket we're about to shut.
-            self.send_header("Connection", "close")
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _write(self, code: int, payload) -> None:
-        self._write_raw(code, json.dumps(payload).encode(), "application/json")
-
-    def _write_text(self, code: int, text: str, content_type: str) -> None:
-        self._write_raw(code, text.encode(), content_type)
-
-    def parse_request(self):
-        # Request-log clock: started AFTER the request line arrived, so a
-        # keep-alive connection's idle wait for the client's next request
-        # never counts into the logged duration.
-        self._req_start = time.monotonic()
-        return super().parse_request()
-
-    def handle_one_request(self):
-        self._body_consumed = False  # per-request, before any handler runs
-        self._drain_on_close = False
-        self._log_status = None
-        self._req_start = None
-        super().handle_one_request()
-        start = self._req_start
-        if self.request_log and self._log_status is not None and start is not None:
-            from spark_scheduler_tpu.tracing import svc1log
-
-            headers = getattr(self, "headers", None)
-            try:
-                status = int(self._log_status)
-            except (TypeError, ValueError):  # send_error's "-" placeholder
-                status = 0
-            svc1log().request(
-                getattr(self, "command", "-") or "-",
-                getattr(self, "path", "-") or "-",
-                status,
-                int((time.monotonic() - start) * 1e6),
-                protocol=self.protocol_version,
-                trace_id=(
-                    headers.get("X-B3-TraceId") or headers.get("x-b3-traceid")
-                )
-                if headers
-                else None,
-            )
-        # An unframeable body (Transfer-Encoding, garbage Content-Length)
-        # was answered without being read; close the connection so the
-        # unread bytes can never desync a subsequent request on the
-        # persistent socket.
-        if self._drain_on_close:
-            self.close_connection = True
-            # Drain the unread body so close() sends FIN, not RST (unread
-            # receive data at close resets the connection on Linux and can
-            # destroy the in-flight response). The body usually rode in
-            # with the headers and sits read-ahead in rfile's user-space
-            # buffer — invisible to connection.recv — so consume that
-            # first, non-blocking.
-            try:
-                self.connection.setblocking(False)
-                while self.rfile.read1(65536):
-                    pass
-            except (OSError, ValueError):
-                pass
-            # Then a short timed kernel drain for bytes still in flight,
-            # bounded in bytes and wall time so a client streaming forever
-            # can't pin the handler thread.
-            try:
-                self.connection.settimeout(0.05)
-                budget = 1 << 18
-                deadline = time.monotonic() + 1.0
-                while budget > 0 and time.monotonic() < deadline:
-                    got = self.connection.recv(65536)
-                    if not got:
-                        break
-                    budget -= len(got)
-            except OSError:
-                pass
-
-    def _body(self):
-        if self.headers.get("Transfer-Encoding"):
-            # No chunked decoder here — without this, a chunked POST would
-            # parse as an empty body and be answered with a confidently
-            # wrong success. Callers turn this into an error response;
-            # the connection closes after it (advertised by _write).
-            self.close_connection = True
-            self._drain_on_close = True
-            self._body_consumed = True
-            raise UnsupportedTransferEncoding(
-                "Transfer-Encoding not supported; send Content-Length"
-            )
-        try:
-            length = self._content_length()
-        except UnframeableBody:
-            self._body_consumed = True  # never read; drained at close
-            raise
-        self._body_consumed = True
-        return json.loads(self.rfile.read(length) or b"{}")
-
-    def _handle_liveness(self) -> None:
-        self._write(200, {"status": "up"})
-
-    def _handle_convert(self) -> None:
-        try:
-            review = self._body()
-        except Exception as exc:
-            self._write(400, {"error": str(exc)})
-            return
-        self._write(200, convert_review(review))
-
-
-class _Server(ThreadingHTTPServer):
-    # Default listen backlog (5) resets connections under a concurrent
-    # client burst — exactly the load the predicate batcher exists for.
-    request_queue_size = 128
-
-
-def _run_threaded(server: ThreadingHTTPServer, name: str) -> threading.Thread:
-    thread = threading.Thread(target=server.serve_forever, daemon=True, name=name)
-    thread.start()
-    return thread
-
-
-def _maybe_wrap_tls(
-    server: ThreadingHTTPServer,
-    cert_file: str | None,
-    key_file: str | None,
-    client_ca_files=None,
-    handshake_timeout_s: float = 30.0,
-) -> bool:
-    """Serve HTTPS when a cert/key pair is configured — the witchcraft
-    server slot (reference config server.cert-file/key-file/client-ca-files,
-    examples/extender.yml:75-80). `client_ca_files` (str or list) requires
-    client certificates signed by ANY of the given CAs (mTLS). Returns True
-    if TLS was enabled.
-
-    The TLS handshake runs PER CONNECTION in the worker thread (via a
-    finish_request override), never in the accept loop: a client that
-    stalls mid-handshake ties up one bounded-timeout worker, not the whole
-    server."""
-    if not cert_file:
-        return False
-    import ssl
-
-    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-    ctx.load_cert_chain(cert_file, key_file or cert_file)
-    if isinstance(client_ca_files, str):
-        client_ca_files = [client_ca_files]
-    for ca in client_ca_files or []:
-        ctx.load_verify_locations(ca)
-    if client_ca_files:
-        ctx.verify_mode = ssl.CERT_REQUIRED
-
-    orig_finish_request = server.finish_request
-
-    def finish_request(request, client_address):
-        # ThreadingMixIn calls finish_request from the per-connection worker
-        # thread; the handshake happens here under a timeout.
-        try:
-            request.settimeout(handshake_timeout_s)
-            tls_request = ctx.wrap_socket(request, server_side=True)
-        except (OSError, ssl.SSLError):
-            try:
-                request.close()
-            except OSError:
-                pass
-            return
-        orig_finish_request(tls_request, client_address)
-
-    server.finish_request = finish_request
-    return True
+        return AsyncTransport(
+            routes,
+            host,
+            port,
+            cert_file=cert_file,
+            key_file=key_file,
+            client_ca_files=client_ca_files,
+            request_timeout_s=request_timeout_s,
+            request_log=request_log,
+            max_body_bytes=max_body_bytes,
+            max_connections=max_connections,
+            telemetry=telemetry,
+            name=name,
+        )
+    if transport != "threaded":
+        raise ValueError(
+            f"unknown server transport {transport!r}; expected one of {TRANSPORTS}"
+        )
+    return ThreadedTransport(
+        routes,
+        host,
+        port,
+        cert_file=cert_file,
+        key_file=key_file,
+        client_ca_files=client_ca_files,
+        request_timeout_s=request_timeout_s,
+        request_log=request_log,
+        max_body_bytes=max_body_bytes,
+        telemetry=telemetry,
+        name=name,
+    )
 
 
 class SchedulerHTTPServer:
@@ -696,10 +593,17 @@ class SchedulerHTTPServer:
         request_timeout_s: float = 30.0,
         debug_routes: bool = False,
         request_log: bool = False,
+        transport: str | None = None,
+        max_body_bytes: int | None = None,
+        max_connections: int | None = None,
+        shed_queue_depth: int | None = None,
     ):
+        from spark_scheduler_tpu.observability import TransportTelemetry
+
         self.app = app
         self.registry = registry
-        self._request_timeout_s = request_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self._request_timeout_s = request_timeout_s  # legacy alias
         self.request_log = request_log
         # /debug/* (trace dump, JAX profiler control) is an explicit opt-in:
         # on the cluster-exposed extender port it would let any peer start
@@ -707,254 +611,79 @@ class SchedulerHTTPServer:
         self.debug_routes = debug_routes
         self.ready = threading.Event()
         self._shutdown = threading.Event()
+        cfg = getattr(app, "config", None)
+        # Transport + backpressure knobs resolve explicit args first, then
+        # the install config, then defaults — so embedded uses (tests,
+        # bench) can A/B without a config object.
+        self.transport_name = transport or getattr(
+            cfg, "server_transport", "threaded"
+        )
+        self.max_body_bytes = (
+            max_body_bytes
+            if max_body_bytes is not None
+            else getattr(cfg, "max_body_bytes", 16 * 1024 * 1024)
+        )
+        self.max_connections = (
+            max_connections
+            if max_connections is not None
+            else getattr(cfg, "max_connections", 512)
+        )
+        self.shed_queue_depth = (
+            shed_queue_depth
+            if shed_queue_depth is not None
+            else getattr(cfg, "shed_queue_depth", 256)
+        )
         # Concurrent predicates coalesce into windowed batch solves; the
         # batcher's dispatcher thread is the serialization point for mutable
         # scheduling state (SURVEY.md §7 "Mutable-state races").
-        cfg = getattr(app, "config", None)
         self.batcher = PredicateBatcher(
             app.extender,
             max_window=getattr(cfg, "predicate_max_window", 32),
             hold_ms=getattr(cfg, "predicate_hold_ms", 25.0),
             registry=registry,
         )
-        outer = self
-
-        class Handler(_JSONHandler):
-            def do_GET(self):
-                from urllib.parse import parse_qs, urlparse
-
-                parsed = urlparse(self.path)
-                path, query = parsed.path, parse_qs(parsed.query)
-                if path == "/status/liveness":
-                    self._handle_liveness()
-                elif path == "/status/readiness":
-                    code = 200 if outer.ready.is_set() else 503
-                    self._write(code, {"ready": outer.ready.is_set()})
-                elif path == "/metrics":
-                    # Compile gauges are pull-synced: the jax.monitoring
-                    # listener feeds process totals, the scrape publishes.
-                    telemetry = getattr(outer.app.solver, "telemetry", None)
-                    if telemetry is not None:
-                        telemetry.sync_compile_gauges()
-                    snap = outer.registry.snapshot() if outer.registry else {}
-                    fmt = (query.get("format") or [""])[0]
-                    accept = self.headers.get("Accept", "") or ""
-                    from spark_scheduler_tpu.observability import (
-                        prefers_prometheus,
-                        render_prometheus,
-                    )
-
-                    if fmt == "prometheus" or (
-                        fmt != "json" and prefers_prometheus(accept)
-                    ):
-                        # Prometheus text exposition: the pull surface for
-                        # scrape stacks (a Prometheus scraper's Accept
-                        # header selects it by q-value preference;
-                        # `?format=` forces either way).
-
-                        batcher = {
-                            f"foundry.spark.scheduler.predicate.batcher.{k}": v
-                            for k, v in outer.batcher.stats().items()
-                            if isinstance(v, (int, float))
-                        }
-                        self._write_text(
-                            200,
-                            render_prometheus(snap, extra_gauges=batcher),
-                            "text/plain; version=0.0.4",
-                        )
-                    else:
-                        snap["predicate_batcher"] = outer.batcher.stats()
-                        self._write(200, snap)
-                elif path == "/debug/traces" and outer.debug_routes:
-                    from spark_scheduler_tpu.tracing import tracer
-
-                    self._write(200, {"spans": tracer().finished_spans()})
-                elif path == "/debug/decisions" and outer.debug_routes:
-                    recorder = getattr(outer.app, "recorder", None)
-                    if recorder is None:
-                        self._write(
-                            404, {"error": "flight recorder disabled"}
-                        )
-                        return
-
-                    def q(name):
-                        vals = query.get(name)
-                        return vals[0] if vals else None
-
-                    try:
-                        limit = int(q("limit") or 100)
-                    except ValueError:
-                        self._write(400, {"error": "bad limit"})
-                        return
-                    self._write(
-                        200,
-                        {
-                            "decisions": recorder.query(
-                                app=q("app"),
-                                verdict=q("verdict"),
-                                role=q("role"),
-                                namespace=q("namespace"),
-                                limit=limit,
-                            ),
-                            "recorder": recorder.stats(),
-                        },
-                    )
-                elif path == "/debug/state" and outer.debug_routes:
-                    from spark_scheduler_tpu.observability import (
-                        debug_state_snapshot,
-                    )
-
-                    self._write(200, debug_state_snapshot(outer.app))
-                else:
-                    self._write(404, {"error": "not found"})
-
-            def do_POST(self):
-                if self.path == "/predicates":
-                    from spark_scheduler_tpu.tracing import (
-                        pod_safe_params,
-                        svc1log,
-                        tracer,
-                    )
-
-                    try:
-                        pod, node_names = extender_args_from_k8s(self._body())
-                    except Exception as exc:
-                        self._write(self._error_code(exc), {"Error": str(exc)})
-                        return
-                    # Root span continues the caller's b3 trace context
-                    # (the witchcraft tracing middleware slot).
-                    with tracer().root_from_headers(
-                        self.headers, "predicate", pod=f"{pod.namespace}/{pod.name}"
-                    ) as root:
-                        try:
-                            result = outer.batcher.submit(
-                                ExtenderArgs(pod=pod, node_names=node_names),
-                                timeout=outer._request_timeout_s,
-                            )
-                        except Exception as exc:
-                            # Internal errors ride the protocol's Error
-                            # channel (ExtenderFilterResult.Error) so
-                            # kube-scheduler gets a well-formed response
-                            # instead of a dropped connection.
-                            root.tag("outcome", "failure-internal")
-                            svc1log().error(
-                                "predicate failed",
-                                error=repr(exc),
-                                **pod_safe_params(pod),
-                            )
-                            self._write(
-                                200,
-                                {"NodeNames": [], "FailedNodes": {}, "Error": str(exc)},
-                            )
-                            return
-                        root.tag("outcome", result.outcome)
-                        svc1log().info(
-                            "predicate",
-                            outcome=result.outcome,
-                            nodes=list(result.node_names),
-                            **pod_safe_params(pod),
-                        )
-                    self._write(200, filter_result_to_k8s(result))
-                elif self.path == "/convert":
-                    self._handle_convert()
-                elif self.path == "/debug/profile/start" and outer.debug_routes:
-                    from spark_scheduler_tpu.tracing import start_jax_profile
-
-                    try:
-                        body = self._body()
-                    except UnframeableBody as exc:
-                        # The body (with its would-be "dir") was never
-                        # read — reject rather than silently profiling
-                        # into the default dir.
-                        self._write(400, {"error": str(exc)})
-                        return
-                    except Exception:
-                        body = {}  # empty/garbage body: defaults are fine
-                    if not isinstance(body, dict):
-                        body = {}
-                    log_dir = body.get("dir") or "/tmp/spark-scheduler-jax-trace"
-                    try:
-                        started = start_jax_profile(log_dir)
-                    except Exception as exc:  # unwritable dir etc.
-                        self._write(500, {"profiling": False, "error": str(exc)})
-                        return
-                    self._write(
-                        200 if started else 409,
-                        {"profiling": started, "dir": log_dir},
-                    )
-                elif self.path == "/debug/profile/stop" and outer.debug_routes:
-                    from spark_scheduler_tpu.tracing import stop_jax_profile
-
-                    try:
-                        out_dir = stop_jax_profile()
-                    except Exception as exc:
-                        self._write(500, {"profiling": False, "error": str(exc)})
-                        return
-                    self._write(
-                        200 if out_dir else 409,
-                        {"profiling": False, "dir": out_dir},
-                    )
-                else:
-                    self._write(404, {"error": "not found"})
-
-            def do_PUT(self):
-                try:
-                    if self.path == "/state/nodes":
-                        node = node_from_k8s(self._body())
-                        existing = outer.app.backend.get_node(node.name)
-                        if existing is None:
-                            outer.app.backend.add_node(node)
-                        else:
-                            outer.app.backend.update("nodes", node)
-                        outer.ready.set()  # first synced node => ready
-                        self._write(200, {"applied": node.name})
-                    elif self.path == "/state/pods":
-                        pod = pod_from_k8s(self._body())
-                        if outer.app.backend.get("pods", pod.namespace, pod.name) is None:
-                            outer.app.backend.add_pod(pod)
-                        else:
-                            outer.app.backend.update_pod(pod)
-                        self._write(200, {"applied": pod.name})
-                    else:
-                        self._write(404, {"error": "not found"})
-                except Exception as exc:
-                    self._write(self._error_code(exc), {"error": str(exc)})
-
-            def do_DELETE(self):
-                try:
-                    parts = self.path.strip("/").split("/")
-                    if len(parts) == 4 and parts[:2] == ["state", "pods"]:
-                        ns, name = parts[2], parts[3]
-                        pod = outer.app.backend.get("pods", ns, name)
-                        if pod is None:
-                            self._write(404, {"error": "pod not found"})
-                        else:
-                            outer.app.backend.delete_pod(pod)
-                            self._write(200, {"deleted": name})
-                    else:
-                        self._write(404, {"error": "not found"})
-                except Exception as exc:  # e.g. concurrent-delete race
-                    self._write(500, {"error": str(exc)})
-
-        # Socket read timeout per connection: a stalled client cannot pin a
-        # handler thread forever (the extender protocol budget is 30 s,
-        # examples/extender.yml:59).
-        Handler.timeout = request_timeout_s
-        Handler.request_log = request_log
-        self._server = _Server((host, port), Handler)
-        self.tls = _maybe_wrap_tls(
-            self._server, cert_file, key_file, client_ca_files,
-            handshake_timeout_s=request_timeout_s,
+        self.telemetry = TransportTelemetry(self.transport_name)
+        self.routes = SchedulerRoutes(self)
+        self._transport = _build_transport(
+            self.transport_name,
+            self.routes,
+            host,
+            port,
+            cert_file=cert_file,
+            key_file=key_file,
+            client_ca_files=client_ca_files,
+            request_timeout_s=request_timeout_s,
+            request_log=request_log,
+            max_body_bytes=self.max_body_bytes,
+            max_connections=self.max_connections,
+            telemetry=self.telemetry,
+            name=f"scheduler-http-{self.transport_name}",
         )
-        self._thread: threading.Thread | None = None
+        self.tls = self._transport.tls
+
+    # Hooks the route table calls back into -------------------------------
+
+    def transport_stats(self) -> dict:
+        return self.telemetry.stats()
+
+    def on_queue_shed(self) -> None:
+        self.telemetry.on_queue_shed()
+
+    # ----------------------------------------------------------- lifecycle
 
     @property
     def port(self) -> int:
-        return self._server.server_address[1]
+        return self._transport.port
+
+    def set_request_log(self, enabled: bool) -> None:
+        """Toggle the per-request access log on the running transport (the
+        runtime-config reload slot; also what the tests flip)."""
+        self.request_log = enabled
+        self._transport.set_request_log(enabled)
 
     def start(self) -> None:
         self.app.start_background()
-        self._thread = _run_threaded(self._server, "scheduler-http")
+        self._transport.start()
         # Ready only once cluster state exists; pre-seeded backends (tests,
         # embedded use) are ready at once, otherwise the first successful
         # PUT /state/nodes — or watch-ingestion cache sync
@@ -980,20 +709,16 @@ class SchedulerHTTPServer:
     def stop(self) -> None:
         self._shutdown.set()
         self.ready.clear()
+        # Batcher first: pending entries fail fast (and their event-loop
+        # callbacks flush) while the transport is still able to write the
+        # error responses.
         self.batcher.stop()
-        # shutdown() blocks on serve_forever()'s exit handshake — only call
-        # it if serving actually started (Ctrl-C can land before start()
-        # finished, e.g. during the pre-start cache-sync wait).
-        if self._thread is not None:
-            self._server.shutdown()
-            self._thread.join(timeout=5)
-        self._server.server_close()
+        self._transport.stop()
         self.app.stop()
 
     def join(self) -> None:
         """Block until the serving thread exits (after start())."""
-        if self._thread is not None:
-            self._thread.join()
+        self._transport.join()
 
     def serve_forever(self) -> None:
         self.start()
@@ -1017,45 +742,35 @@ class ConversionWebhookServer:
         client_ca_files=None,
         request_timeout_s: float = 30.0,
         request_log: bool = False,
+        max_body_bytes: int = 16 * 1024 * 1024,
     ):
-        class Handler(_JSONHandler):
-            def do_GET(self):
-                if self.path == "/status/liveness":
-                    self._handle_liveness()
-                else:
-                    self._write(404, {"error": "not found"})
-
-            def do_POST(self):
-                if self.path == "/convert":
-                    self._handle_convert()
-                else:
-                    self._write(404, {"error": "not found"})
-
-        Handler.timeout = request_timeout_s
-        Handler.request_log = request_log
-        self._server = _Server((host, port), Handler)
-        self.tls = _maybe_wrap_tls(
-            self._server, cert_file, key_file, client_ca_files,
-            handshake_timeout_s=request_timeout_s,
+        self._transport = ThreadedTransport(
+            ConversionRoutes(),
+            host,
+            port,
+            cert_file=cert_file,
+            key_file=key_file,
+            client_ca_files=client_ca_files,
+            request_timeout_s=request_timeout_s,
+            request_log=request_log,
+            max_body_bytes=max_body_bytes,
+            name="conversion-http",
         )
-        self._thread: threading.Thread | None = None
+        self.tls = self._transport.tls
 
     @property
     def port(self) -> int:
-        return self._server.server_address[1]
+        return self._transport.port
 
     def start(self) -> None:
-        self._thread = _run_threaded(self._server, "conversion-http")
+        self._transport.start()
 
     def stop(self) -> None:
-        if self._thread is not None:
-            self._server.shutdown()
-            self._thread.join(timeout=5)
-        self._server.server_close()
+        self._transport.stop()
 
     def serve_forever(self) -> None:
         self.start()
         try:
-            self._thread.join()
+            self._transport.join()
         except KeyboardInterrupt:
             self.stop()

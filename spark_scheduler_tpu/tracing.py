@@ -203,6 +203,24 @@ class Tracer:
             "X-B3-Sampled": "1" if cur.sampled else "0",
         }
 
+    # -- detached spans ------------------------------------------------------
+
+    def begin_detached(self, span: Span) -> None:
+        """Start a span WITHOUT pushing it on this thread's stack. The
+        event-loop serving transport cannot hold a request's root span
+        open on its (shared, interleaved) loop thread the way a dedicated
+        handler thread can — detached spans are timed by hand and land in
+        the finished ring via finish_detached."""
+        span.start = self._clock()
+
+    def finish_detached(self, span: Span) -> None:
+        span.end = self._clock()
+        if span.sampled:
+            with self._lock:
+                self._finished.append(span)
+                if self._log_stream is not None:
+                    self._log_stream.write(json.dumps(span.to_dict()) + "\n")
+
     # -- inspection ----------------------------------------------------------
 
     def finished_spans(self) -> list[dict]:

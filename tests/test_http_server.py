@@ -78,8 +78,11 @@ def _request(port, method, path, payload=None):
         return err.code, json.loads(err.read())
 
 
-@pytest.fixture()
-def server():
+@pytest.fixture(params=["threaded", "async"])
+def server(request):
+    # The full scenario matrix runs against BOTH transports: the stdlib
+    # thread-per-connection stack and the async event loop must be
+    # byte-compatible on every route and framing edge.
     backend = InMemoryBackend()
     backend.register_crd(DEMAND_CRD)
     registry = MetricRegistry()
@@ -93,7 +96,9 @@ def server():
         ),
         metrics=SchedulerMetrics(registry, INSTANCE_GROUP_LABEL),
     )
-    srv = SchedulerHTTPServer(app, registry, port=0)  # ephemeral port
+    srv = SchedulerHTTPServer(
+        app, registry, port=0, transport=request.param
+    )  # ephemeral port
     srv.start()
     yield srv
     srv.stop()
@@ -282,9 +287,8 @@ def test_request_log_emits_structured_lines(server):
     stream = io.StringIO()
     old_logger = svc1log()
     set_svc1log(Svc1Logger(stream=stream))
-    # Flip the flag on the running server's handler class.
-    handler_cls = server._server.RequestHandlerClass
-    handler_cls.request_log = True
+    # Flip the flag on the RUNNING server (works on either transport).
+    server.set_request_log(True)
     try:
         req = urllib.request.Request(
             f"http://127.0.0.1:{server.port}/status/liveness",
@@ -294,7 +298,7 @@ def test_request_log_emits_structured_lines(server):
             assert resp.status == 200
         _request(server.port, "GET", "/nope")
     finally:
-        handler_cls.request_log = False
+        server.set_request_log(False)
         set_svc1log(old_logger)
     lines = [
         json.loads(l)

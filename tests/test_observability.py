@@ -120,6 +120,7 @@ def test_debug_decisions_metrics_and_state_smoke(server):
     assert status == 200 and "application/json" in ctype
     snap = json.loads(body)
     snap.pop("predicate_batcher", None)
+    snap.pop("server_transport", None)  # stats surface, not a registry series
     assert any(
         name.startswith("foundry.spark.scheduler.solver.") for name in snap
     ), sorted(snap)

@@ -458,7 +458,11 @@ def test_batcher_completes_solo_ticket_before_next_window():
     assert solo_done < win_disp, events
 
 
-def test_http_mixed_driver_executor_workload():
+import pytest
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_http_mixed_driver_executor_workload(transport):
     """Drivers and executors of MANY apps interleave through the HTTP
     batcher: each app's executors go in right after its driver binds, while
     OTHER apps' driver windows are still in flight — mixed batches hit the
@@ -475,7 +479,8 @@ def test_http_mixed_driver_executor_workload():
 
     h, node_names = _mk_harness(n_nodes=24)
     server = SchedulerHTTPServer(
-        h.app, host="127.0.0.1", port=0, request_timeout_s=120.0
+        h.app, host="127.0.0.1", port=0, request_timeout_s=120.0,
+        transport=transport,
     )
     server.start()
     n_apps, execs_per_app = 6, 3
@@ -528,7 +533,8 @@ def test_http_mixed_driver_executor_workload():
         server.stop()
 
 
-def test_http_pipelined_soak_consistent_reservations():
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_http_pipelined_soak_consistent_reservations(transport):
     """Concurrent clients through the REAL HTTP server: every request lands
     and the final reservation state is consistent (each app exactly one
     reservation, executor slots on real nodes, no node over capacity)."""
@@ -540,7 +546,8 @@ def test_http_pipelined_soak_consistent_reservations():
 
     h, node_names = _mk_harness(n_nodes=40)
     server = SchedulerHTTPServer(
-        h.app, host="127.0.0.1", port=0, request_timeout_s=120.0
+        h.app, host="127.0.0.1", port=0, request_timeout_s=120.0,
+        transport=transport,
     )
     server.start()
     n_clients, rounds = 8, 5

@@ -45,19 +45,23 @@ def _client_ctx(cert: str) -> ssl.SSLContext:
     return ctx
 
 
-def _tls_server(tls_material, **kw):
+def _tls_server(tls_material, transport="threaded", **kw):
     cert, key = tls_material
     backend = InMemoryBackend()
     backend.add_node(new_node("n0"))
     app = build_scheduler_app(backend, InstallConfig(sync_writes=True))
     return SchedulerHTTPServer(
-        app, host="127.0.0.1", port=0, cert_file=cert, key_file=key, **kw
+        app, host="127.0.0.1", port=0, cert_file=cert, key_file=key,
+        transport=transport, **kw
     )
 
 
-def test_https_serving(tls_material):
+# Both transports must serve the same TLS surface: per-connection
+# handshakes on the threaded stack, loop-level SSL on the async one.
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_https_serving(tls_material, transport):
     cert, _ = tls_material
-    server = _tls_server(tls_material)
+    server = _tls_server(tls_material, transport)
     server.start()
     try:
         assert server.tls
@@ -71,8 +75,9 @@ def test_https_serving(tls_material):
         server.stop()
 
 
-def test_plaintext_client_rejected_on_tls_server(tls_material):
-    server = _tls_server(tls_material)
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_plaintext_client_rejected_on_tls_server(tls_material, transport):
+    server = _tls_server(tls_material, transport)
     server.start()
     try:
         conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
@@ -108,14 +113,17 @@ def test_conversion_webhook_https(tls_material):
         server.stop()
 
 
-def test_request_timeout_closes_stalled_connection(tls_material):
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_request_timeout_closes_stalled_connection(tls_material, transport):
     """A client that connects and never sends a request cannot pin a
-    handler thread past the configured timeout."""
+    handler thread (threaded) or per-connection loop state (async) past
+    the configured timeout."""
     backend = InMemoryBackend()
     backend.add_node(new_node("n0"))
     app = build_scheduler_app(backend, InstallConfig(sync_writes=True))
     server = SchedulerHTTPServer(
-        app, host="127.0.0.1", port=0, request_timeout_s=0.5
+        app, host="127.0.0.1", port=0, request_timeout_s=0.5,
+        transport=transport,
     )
     server.start()
     try:
@@ -137,6 +145,10 @@ def test_config_parses_server_block():
                 "cert-file": "/c.crt",
                 "key-file": "/c.key",
                 "client-ca-files": ["/ca.crt"],
+                "transport": "async",
+                "max-body-bytes": 1048576,
+                "max-connections": 64,
+                "shed-queue-depth": 32,
             },
             "request-timeout": "10s",
         }
@@ -146,3 +158,14 @@ def test_config_parses_server_block():
     assert cfg.key_file == "/c.key"
     assert cfg.client_ca_files == ["/ca.crt"]
     assert cfg.request_timeout_s == 10.0
+    assert cfg.server_transport == "async"
+    assert cfg.max_body_bytes == 1048576
+    assert cfg.max_connections == 64
+    assert cfg.shed_queue_depth == 32
+    # Defaults: threaded transport, backpressure knobs at their documented
+    # values.
+    dflt = InstallConfig.from_dict({})
+    assert dflt.server_transport == "threaded"
+    assert dflt.max_body_bytes == 16 * 1024 * 1024
+    assert dflt.max_connections == 512
+    assert dflt.shed_queue_depth == 256

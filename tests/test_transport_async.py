@@ -1,0 +1,270 @@
+"""Async event-loop transport: backpressure and framing edges the
+threaded stack never had to express — max-connections 503 load shedding,
+max-body-bytes 413 with keep-alive surviving, pipelined request framing
+with in-order responses, batcher-queue-depth shedding, and the
+foundry.spark.scheduler.server.* telemetry surface.
+
+The load-shed smoke is the tier-1 guard for the ceiling-lift PR: saturate
+past max-connections on CPU, assert the excess got clean 503s and ZERO
+sockets hang.
+"""
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from spark_scheduler_tpu.metrics import MetricRegistry, SchedulerMetrics
+from spark_scheduler_tpu.server.app import build_scheduler_app
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+from spark_scheduler_tpu.store.backend import InMemoryBackend
+from spark_scheduler_tpu.testing.harness import new_node
+
+
+def _make_server(transport="async", **kw):
+    backend = InMemoryBackend()
+    backend.add_node(new_node("n0"))
+    registry = MetricRegistry()
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(sync_writes=True),
+        metrics=SchedulerMetrics(registry, "instance-group"),
+    )
+    srv = SchedulerHTTPServer(
+        app, registry, port=0, transport=transport, **kw
+    )
+    srv.start()
+    return srv
+
+
+def _read_response(sock, timeout=5.0):
+    """Read exactly ONE response (headers + Content-Length body) so
+    keep-alive reuse never races a partial read."""
+    sock.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            return buf
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1].strip())
+    while len(rest) < length:
+        chunk = sock.recv(4096)
+        if not chunk:
+            break
+        rest += chunk
+    return head + b"\r\n\r\n" + rest
+
+
+def _read_all(sock, timeout=5.0):
+    sock.settimeout(timeout)
+    buf, closed = b"", False
+    try:
+        while True:
+            chunk = sock.recv(4096)
+            if not chunk:
+                closed = True
+                break
+            buf += chunk
+    except socket.timeout:
+        pass
+    return buf, closed
+
+
+def test_load_shed_past_max_connections_no_hung_sockets():
+    """Saturate past max-connections: the excess connections get a canned
+    503 + close (never a hang, never a silent drop), the admitted ones
+    still serve, and the server stays healthy afterwards."""
+    cap = 4
+    srv = _make_server(max_connections=cap, request_timeout_s=5.0)
+    try:
+        port = srv.port
+        admitted = [
+            socket.create_connection(("127.0.0.1", port)) for _ in range(cap)
+        ]
+        # Nudge the loop so all opens are registered before the excess.
+        for s in admitted:
+            s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+            assert b"200" in _read_response(s)
+        shed_results = []
+        for _ in range(8):
+            s = socket.create_connection(("127.0.0.1", port))
+            buf, closed = _read_all(s)
+            shed_results.append((buf, closed))
+            s.close()
+        for buf, closed in shed_results:
+            assert buf.startswith(b"HTTP/1.1 503"), buf[:80]
+            assert b"connection limit reached" in buf
+            assert closed, "shed socket was left hanging"
+        # Admitted connections still work (keep-alive survived the storm).
+        for s in admitted:
+            s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+            assert b"200" in _read_response(s)
+            s.close()
+        # Slots freed: a fresh connection is admitted again.
+        s = socket.create_connection(("127.0.0.1", port))
+        s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert b"200" in _read_response(s)
+        s.close()
+        stats = srv.telemetry.stats()
+        assert stats["connection_sheds"] >= 8
+    finally:
+        srv.stop()
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_oversized_body_413_and_keepalive_survives(transport):
+    """A body past max-body-bytes is answered 413 with the body DRAINED:
+    the same connection must serve the next request (no desync, no
+    close) on both transports."""
+    srv = _make_server(transport=transport, max_body_bytes=1024)
+    try:
+        big = b"x" * 4096
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(
+            b"POST /predicates HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(big)).encode() + b"\r\n\r\n" + big
+        )
+        resp = _read_response(s)
+        assert resp.startswith(b"HTTP/1.1 413"), resp[:120]
+        assert b"Connection: close" not in resp
+        # Keep-alive survived: next request on the SAME socket frames
+        # cleanly (the oversized body was drained, not left in the stream).
+        s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+        follow = _read_response(s)
+        assert follow.startswith(b"HTTP/1.1 200"), follow[:120]
+        s.close()
+        assert srv.telemetry.stats()["body_rejections"] == 1
+    finally:
+        srv.stop()
+
+
+def test_pipelined_requests_answered_in_order():
+    """Three pipelined requests in ONE write: three responses come back in
+    request order on the persistent connection."""
+    srv = _make_server()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(
+            b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n"
+            b"GET /nope HTTP/1.1\r\nHost: x\r\n\r\n"
+            b"GET /status/readiness HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n"
+        )
+        buf, closed = _read_all(s)
+        s.close()
+        import re
+
+        # Bodies are framed by Content-Length (no trailing CRLF), so a
+        # body can butt directly against the next status line — match the
+        # status lines positionally instead of splitting on CRLF.
+        statuses = re.findall(rb"HTTP/1\.1 (\d{3})", buf)
+        # liveness 200, unknown 404, readiness 200 (node pre-seeded) —
+        # strictly in request order.
+        assert statuses == [b"200", b"404", b"200"], (statuses, buf[:400])
+        assert closed  # the final Connection: close honored
+    finally:
+        srv.stop()
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+def test_queue_depth_load_shedding_503(transport, monkeypatch):
+    """When the batcher backlog crosses shed-queue-depth, /predicates gets
+    an immediate 503 instead of parking until the request timeout."""
+    srv = _make_server(transport=transport, shed_queue_depth=1)
+    try:
+        monkeypatch.setattr(srv.batcher, "queue_depth", lambda: 99)
+        body = json.dumps({"Pod": {"metadata": {}}, "NodeNames": ["n0"]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/predicates",
+            data=body,
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            urllib.request.urlopen(req)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            payload = json.loads(err.read())
+            assert payload["error"] == "scheduler overloaded"
+        assert srv.telemetry.stats()["queue_sheds"] >= 1
+    finally:
+        srv.stop()
+
+
+def test_transport_metrics_surface():
+    """GET /metrics exposes the transport's series: the JSON snapshot
+    carries server_transport, the Prometheus exposition the
+    foundry.spark.scheduler.server.* gauges."""
+    srv = _make_server()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics"
+        ) as resp:
+            snap = json.loads(resp.read())
+        st = snap["server_transport"]
+        assert st["transport"] == "async"
+        assert st["requests_total"] >= 1
+        assert st["open_connections"] >= 1
+        assert "keepalive_reuse_ratio" in st
+        assert "parse_mean_ms" in st and "write_mean_ms" in st
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics?format=prometheus"
+        )
+        with urllib.request.urlopen(req) as resp:
+            text = resp.read().decode()
+        assert "foundry_spark_scheduler_server_requests_total" in text.replace(
+            ".", "_"
+        ) or "foundry.spark.scheduler.server.requests_total" in text
+    finally:
+        srv.stop()
+
+
+def test_keepalive_reuse_ratio_counts_reused_requests():
+    srv = _make_server()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        for _ in range(4):
+            s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+            assert b"200" in _read_response(s)
+        s.close()
+        stats = srv.telemetry.stats()
+        assert stats["requests_total"] >= 4
+        assert stats["keepalive_requests"] >= 3
+        assert stats["keepalive_reuse_ratio"] > 0.5
+    finally:
+        srv.stop()
+
+
+def test_malformed_request_line_rejected_in_order():
+    """A garbage request line gets a 400 + close — and when it arrives
+    pipelined behind a valid request, the valid response still flushes
+    FIRST (the reject rides the slot queue, never out of band)."""
+    srv = _make_server()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(
+            b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n"
+            b"TOTAL GARBAGE\r\n\r\n"
+        )
+        buf, closed = _read_all(s)
+        s.close()
+        import re
+
+        statuses = re.findall(rb"HTTP/1\.1 (\d{3})", buf)
+        assert statuses == [b"200", b"400"], (statuses, buf[:300])
+        assert closed
+        # The server is healthy for the next connection.
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+        assert b"200" in _read_response(s)
+        s.close()
+    finally:
+        srv.stop()
