@@ -199,10 +199,40 @@ def _windowed_chain(cluster, batches, fill, emax, num_zones, *, force_xla=False)
     """Queue-mode solves route through fifo_pack_auto: the Pallas VMEM-
     resident kernel on TPU (ops/pallas_fifo.py), the XLA scan elsewhere —
     the routing the public queue-admission API applies. (The serving path's
-    segmented windows re-sort per segment and always use the XLA scan.)"""
+    segmented windows re-sort per segment and always use the XLA scan.)
+
+    The force_xla arm threads the availability through the DONATED carry
+    entry (ops/batched.batched_fifo_pack_carry): available_after reuses
+    the carry buffer in place — the same double-buffer discipline the
+    pipelined serving engine runs — instead of a copy-on-write [N, 3]
+    clone per window."""
     import jax
+    import jax.numpy as jnp
 
     from spark_scheduler_tpu.ops.pallas_fifo import fifo_pack_auto
+
+    if force_xla:
+        from spark_scheduler_tpu.models.cluster import cluster_statics
+        from spark_scheduler_tpu.ops.batched import batched_fifo_pack_carry
+
+        statics = cluster_statics(cluster)
+
+        def chain(k):
+            # Fresh device copy per chain: each window DONATES the carry,
+            # so the caller-owned starting availability must not be
+            # consumed across chain() invocations.
+            avail = jnp.array(cluster.available, copy=True)
+            admitted = []
+            for i in range(k):
+                out = batched_fifo_pack_carry(
+                    avail, statics, batches[i % len(batches)],
+                    fill=fill, emax=emax, num_zones=num_zones,
+                )
+                avail = out.available_after
+                admitted.append(out.admitted)
+            return np.asarray(jax.numpy.concatenate(admitted))
+
+        return chain
 
     def chain(k):
         c = cluster
@@ -457,6 +487,54 @@ def _post_predicate(conn, driver, node_names):
     return resp, (time.perf_counter() - t0) * 1e3
 
 
+_RTT_FLOOR: dict = {}
+
+
+def _device_rtt_floor_ms() -> float:
+    """One minimal device round trip (dispatch + pull a scalar), p50 of 7.
+    Over this environment's tunneled TPU this alone exceeds the 50 ms
+    latency target — EVERY serving section reports it so per-request
+    latencies read against the transport floor, not against zero.
+    Memoized per process (the floor is a property of the link)."""
+    if "ms" in _RTT_FLOOR:
+        return _RTT_FLOOR["ms"]
+    import jax
+    import jax.numpy as jnp
+
+    samples = []
+    x = jax.device_put(jnp.zeros(1, jnp.int32))
+    for _ in range(7):
+        t0 = time.perf_counter()
+        np.asarray(x + 1)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    _RTT_FLOOR["ms"] = round(float(np.percentile(samples, 50)), 2)
+    return _RTT_FLOOR["ms"]
+
+
+def _recorder_phase_stats(app) -> dict:
+    """Per-phase device/host timings of the decisions a serving section
+    actually served, pulled from the flight recorder's ring: p50 of
+    featurize (host tensor build), solve (device dispatch->decisions), and
+    commit (reservation write-back). Every serving section reports these
+    so a latency number decomposes without a profiler run."""
+    recorder = getattr(app, "recorder", None)
+    if recorder is None:
+        return {}
+    out = {}
+    records = recorder.query(limit=recorder.capacity)
+    for phase in ("featurize_ms", "solve_ms", "commit_ms"):
+        vals = [
+            r["phases"][phase]
+            for r in records
+            if r.get("phases", {}).get(phase) is not None
+        ]
+        if vals:
+            out[f"{phase[:-3]}_p50_ms"] = round(
+                float(np.percentile(vals, 50)), 3
+            )
+    return out
+
+
 def bench_serving_http(rng, transport="threaded"):
     """Wall-clock p50 of the SERVED path with a SINGLE sequential client:
     POST /predicates -> extender -> batched solver -> reservation
@@ -485,6 +563,7 @@ def bench_serving_http(rng, transport="threaded"):
     finally:
         conn.close()
         dev_stats = dict(app.solver.device_state_stats)
+        phase_stats = _recorder_phase_stats(app)
         server.stop()
     p50 = float(np.percentile(latencies_ms, 50))
     suffix = "" if transport == "threaded" else f"_{transport}"
@@ -503,6 +582,8 @@ def bench_serving_http(rng, transport="threaded"):
             # the decision pull (VERDICT r2 #3).
             "device_round_trips_per_request": 1,
             "device_state": dev_stats,
+            "device_rtt_floor_ms": _device_rtt_floor_ms(),
+            "device_phases": phase_stats,
             "r02_ms": 119.68,
         },
     )
@@ -800,6 +881,7 @@ def _bench_serving_concurrent(
     finally:
         stats = server.batcher.stats()
         dev_stats = dict(app.solver.device_state_stats)
+        phase_stats = _recorder_phase_stats(app)
         server.stop()  # quiesce before the invariant walk below
     # System-level invariant at this scale: no node over-committed by the
     # reservations the run left behind (reservations + overhead <=
@@ -818,20 +900,10 @@ def _bench_serving_concurrent(
     wall_s = sum(repeat_walls)
     p50 = float(np.percentile(lats, 50))
 
-    # Transport floor evidence: one minimal device round trip (dispatch +
-    # pull a scalar). Over this environment's tunneled TPU this alone
-    # exceeds the 50 ms latency target — per-request latency is
-    # transport-bound; THROUGHPUT is what windowing buys.
-    import jax
-    import jax.numpy as jnp
-
-    floor_samples = []
-    x = jax.device_put(jnp.zeros(1, jnp.int32))
-    for _ in range(7):
-        t0 = time.perf_counter()
-        np.asarray(x + 1)
-        floor_samples.append((time.perf_counter() - t0) * 1e3)
-    rtt_floor_ms = round(float(np.percentile(floor_samples, 50)), 2)
+    # Transport floor evidence: one minimal device round trip — per-request
+    # latency is transport-bound over a tunneled TPU; THROUGHPUT is what
+    # windowing buys (shared helper so every serving section reports it).
+    rtt_floor_ms = _device_rtt_floor_ms()
 
     solve_p50_ms = (
         round(float(np.percentile([s["duration_ms"] for s in solve_spans], 50)), 3)
@@ -863,6 +935,7 @@ def _bench_serving_concurrent(
         # segmented Pallas path serves /predicates on TPU).
         "window_path_counts": dict(app.solver.window_path_counts),
         "device_rtt_floor_ms": rtt_floor_ms,
+        "device_phases": phase_stats,
         # Same rig, null handler, SAME body size (10k-node requests carry
         # ~200 KB of node names): what the 1-core HTTP harness itself can
         # carry — decisions/s saturating this floor is a rig limit, not a
@@ -1187,6 +1260,7 @@ def bench_serving_http_executors(rng, transport="threaded"):
             inproc_wall = time.perf_counter() - t0
             inproc_bps = round(len(rest) / inproc_wall, 1)
     finally:
+        phase_stats = _recorder_phase_stats(app)
         server.stop()
     rig_ceiling, rig_err = _rig_ceiling_or_none(transport=transport)
     p50 = float(np.percentile(lats, 50))
@@ -1198,6 +1272,8 @@ def bench_serving_http_executors(rng, transport="threaded"):
         "executors": len(lats),
         "p95_ms": round(float(np.percentile(lats, 95)), 3),
         "bindings_per_s": round(bps, 1),
+        "device_rtt_floor_ms": _device_rtt_floor_ms(),
+        "device_phases": phase_stats,
         # Same rig, null handler: the 1-core HTTP harness floor the HTTP
         # number saturates (bindings_per_s / ceiling = scheduler share).
         "http_rig_ceiling_req_per_s": rig_ceiling,
@@ -1285,6 +1361,52 @@ def bench_serving_inprocess(rng):
         ),
         flush=True,
     )
+
+
+def bench_multi_device_serving(rng):
+    """The multi-device window-solve engine at north-star scale: in-process
+    pipelined serving windows over a 10,240-node cluster in 8 instance
+    groups, one arm per device-pool size (1 = the single-device serving
+    path, the engine disabled). Runs as a subprocess
+    (hack/multidevice_bench.py) because the arms need an 8-device virtual
+    CPU mesh forced before jax initializes — the bench process's backend
+    is already bound. One JSON line per device count; the pooled arms'
+    vs_baseline is (speedup over the single-device path) / 1.5 — >= 1
+    means the engine cleared the 1.5x bar."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "hack",
+        "multidevice_bench.py",
+    )
+    out = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=2400,
+    )
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"multi-device bench failed rc={out.returncode}: "
+            f"{out.stderr[-800:]}"
+        )
+    for line in lines:
+        arm = json.loads(line)
+        devices = arm["devices"]
+        speedup = arm.get("speedup_vs_single_device") or 0.0
+        vs = 1.0 if devices == 1 else round(speedup / 1.5, 2)
+        entry = {
+            "metric": (
+                f"multi_device_serving_decisions_per_s_10k_nodes_{devices}dev"
+            ),
+            "value": arm["decisions_per_s"],
+            "unit": "decisions/s",
+            "vs_baseline": vs,
+            "detail": arm,
+        }
+        _RESULTS.append(entry)
+        print(json.dumps(entry), flush=True)
 
 
 def bench_recorder_overhead(rng):
@@ -1659,6 +1781,10 @@ def main() -> None:
     # In-process (subprocess, local cpu backend): runs alone, before the
     # concurrent benches, so nothing contends with it or them.
     guarded("serving_inprocess", bench_serving_inprocess, rng)
+    # Multi-device window-solve engine (subprocess, 8-device virtual CPU
+    # mesh): decisions/s at pool sizes 1/2/4/8 on the 10k-node x 8-group
+    # topology; the pooled arms' bar is 1.5x the single-device path.
+    guarded("multi_device_serving", bench_multi_device_serving, rng)
     # Executor bench BEFORE the long concurrent bench: the host-only
     # ladder numbers are the most sensitive to box heat / accumulated
     # process state, so measure them early.

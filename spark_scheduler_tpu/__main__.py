@@ -45,6 +45,25 @@ def main(argv=None) -> int:
         " install config's server.transport",
     )
     srv.add_argument(
+        "--device-pool",
+        type=int,
+        default=None,
+        help="multi-device window-solve engine: keep a resident cluster "
+        "replica on N accelerator devices and round-robin concurrent "
+        "window solves across them (disjoint instance-group windows "
+        "solve in parallel); overrides the install config's "
+        "solver.device-pool",
+    )
+    srv.add_argument(
+        "--mesh",
+        default=None,
+        metavar="GROUPSxSHARDS",
+        help="full mesh form of --device-pool, e.g. '4x2' = 4 pool slots "
+        "of 2 node-sharding devices each (solver.mesh {groups, "
+        "node-shards}); node-shards > 1 runs each window as a GSPMD "
+        "node-axis-sharded solve on the slot's sub-mesh",
+    )
+    srv.add_argument(
         "--autoscaler",
         action="store_true",
         help="enable the in-process elastic autoscaler: consume pending "
@@ -147,6 +166,25 @@ def main(argv=None) -> int:
         config.autoscaler_enabled = True
     if args.transport is not None:
         config.server_transport = args.transport
+    if args.device_pool is not None:
+        # The flag overrides the WHOLE engine config: a configured
+        # solver.mesh would otherwise win inside the solver and make
+        # `--device-pool 1` (disable the engine) a no-op. An explicit
+        # --mesh below still takes precedence over --device-pool.
+        config.solver_device_pool = args.device_pool
+        config.solver_mesh_groups = None
+        config.solver_mesh_node_shards = None
+    if args.mesh is not None:
+        try:
+            groups, shards = (int(x) for x in args.mesh.lower().split("x"))
+        except ValueError:
+            print(
+                f"--mesh expects GROUPSxSHARDS (e.g. 4x2), got {args.mesh!r}",
+                file=sys.stderr,
+            )
+            return 2
+        config.solver_mesh_groups = groups
+        config.solver_mesh_node_shards = shards
 
     registry = MetricRegistry()
     metrics = SchedulerMetrics(registry, config.instance_group_label)

@@ -630,6 +630,14 @@ class SparkSchedulerExtender:
                             else None
                         ),
                         "solve_info": dispatch_info,
+                        # Multi-device engine: the pool slot whose
+                        # partition solved THIS request (None on the
+                        # single-device path).
+                        "device_id": (
+                            t.handle.request_device[k]
+                            if t.handle.request_device is not None
+                            else None
+                        ),
                     },
                 )
 
@@ -748,6 +756,7 @@ class SparkSchedulerExtender:
             if node is None
             else {}
         )
+        solve_info = ctx.get("solve_info")
         rec.record(
             namespace=pod.namespace,
             pod_name=pod.name,
@@ -767,7 +776,13 @@ class SparkSchedulerExtender:
                 for k in ("featurize_ms", "solve_ms", "commit_ms")
                 if k in ctx
             },
-            solve=ctx.get("solve_info"),
+            solve=solve_info,
+            device_id=ctx.get("device_id"),
+            state_upload=(
+                solve_info.get("state_upload")
+                if isinstance(solve_info, dict)
+                else None
+            ),
         )
 
     # ------------------------------------------------------------- plumbing

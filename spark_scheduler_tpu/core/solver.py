@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time as _time
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -28,6 +29,8 @@ from spark_scheduler_tpu.models.cluster import (
     ClusterTensors,
     NodeRegistry,
     build_cluster_tensors,
+    cluster_from_statics,
+    cluster_statics,
 )
 from spark_scheduler_tpu.models.kube import Node
 from spark_scheduler_tpu.models.resources import INT32_INF, NUM_DIMS, Resources
@@ -236,6 +239,275 @@ def _window_blob_pallas(cluster, win, *, fill, emax, num_zones):
     return blob, base_after
 
 
+def _window_blob_split(avail, statics, apps, *, fill, emax, num_zones):
+    """`_window_blob` with the availability split from the static cluster
+    fields (models.cluster.cluster_statics): the multi-device engine keeps
+    only the STATIC fields resident per pool slot and threads the base
+    availability as its own argument, so the donated variant can consume
+    the carry in place without deleting the resident replica."""
+    out = batched_fifo_pack(
+        cluster_from_statics(avail, statics), apps,
+        fill=fill, emax=emax, num_zones=num_zones,
+    )
+    blob = jnp.concatenate(
+        [
+            out.driver_node[:, None],
+            out.admitted[:, None].astype(jnp.int32),
+            out.packed[:, None].astype(jnp.int32),
+            out.executor_nodes,
+        ],
+        axis=1,
+    )
+    return blob, out.available_after
+
+
+_window_blob_statics = jax.jit(
+    _window_blob_split, static_argnames=("fill", "emax", "num_zones")
+)
+# Double-buffered committed base: the carry is DONATED, so available_after
+# reuses the input buffer in place instead of copy-on-write. The input base
+# is DEAD after the call — the pipeline threads available_after forward and
+# nothing else may read the consumed buffer (tests pin the deletion).
+_window_blob_donated = jax.jit(
+    _window_blob_split,
+    static_argnames=("fill", "emax", "num_zones"),
+    donate_argnums=(0,),
+)
+
+
+@jax.jit
+def _take_rows(arr, idx):
+    """Row gather for partitioned window solves: the sub-cluster's CURRENT
+    availability pulled out of the threaded device base (runs on the base's
+    device; the small [n_g, 3] result then moves to the partition's slot)."""
+    return arr[idx]
+
+
+@_partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows_exact_donated(base, idx, rows):
+    """Scatter a partition's committed sub-base back into the (DONATED)
+    global base. `idx` is the partition's EXACT domain index list — no
+    padding, no duplicates — so .set is deterministic and in-place."""
+    return base.at[idx].set(rows)
+
+
+@_partial(jax.jit, donate_argnums=(0,))
+def _add_rows_donated(avail, idx, delta_rows):
+    """`_add_rows` with the pipelined base DONATED: external availability
+    deltas update the committed base in place. The input buffer is dead
+    after the call; only the returned array may be threaded forward."""
+    return avail.at[idx].add(delta_rows)
+
+
+_solve_pool: "_DaemonFetchPool | None" = None
+_solve_pool_lock = threading.Lock()
+
+
+def _shared_solve_pool() -> "_DaemonFetchPool":
+    """Process-wide worker pool for the multi-device engine's window solves.
+
+    On backends whose dispatch is effectively synchronous (jax CPU runs the
+    program inside the jit call), concurrent per-slot solves need their own
+    host threads; on async backends the worker just owns the block+fetch.
+    Shared and daemon for the same reasons as the fetch pool (see
+    _DaemonFetchPool): workers run stateless jit applies and device_get
+    calls, and per-solver pools would leak threads across rebuilt apps."""
+    global _solve_pool
+    with _solve_pool_lock:
+        if _solve_pool is None:
+            _solve_pool = _DaemonFetchPool(workers=8, name="window-solve")
+        return _solve_pool
+
+
+class _PoolSlot:
+    """One slot of the window-solve device pool: a plain device, or a
+    single-axis ("nodes",) sub-mesh sharding the node axis (the GSPMD
+    serving mode). Keeps the slot's resident STATIC replica (and gathered
+    sub-replicas per partition domain), upload stats, and in-flight count."""
+
+    __slots__ = (
+        "placement", "label", "is_mesh", "statics", "statics_epoch",
+        "sub_statics", "uploads", "last_full_upload", "inflight",
+    )
+
+    def __init__(self, placement):
+        self.placement = placement
+        self.is_mesh = hasattr(placement, "devices")  # jax.sharding.Mesh
+        if self.is_mesh:
+            devs = list(placement.devices.flat)
+            self.label = (
+                f"{devs[0].platform}:{devs[0].id}-{devs[-1].id}"
+            )
+        else:
+            self.label = f"{placement.platform}:{placement.id}"
+        self.statics = None  # resident static-field tuple (full cluster)
+        self.statics_epoch = -1
+        # idx_key -> (epoch, statics tuple, idx device array) for gathered
+        # partition sub-clusters.
+        self.sub_statics: dict = {}
+        # Per-slot replica decisions: "full" (statics uploaded) vs "reuse"
+        # (resident copy served). Availability DELTAS are pipeline-level
+        # (one thread for the whole pool), counted in device_state_stats.
+        self.uploads = {"full": 0, "reuse": 0}
+        self.last_full_upload = 0.0
+        self.inflight = 0
+
+    def _put(self, arr):
+        if self.is_mesh:
+            from spark_scheduler_tpu.parallel.solve import node_sharding
+
+            a = jnp.asarray(arr)
+            return jax.device_put(
+                a, node_sharding(self.placement, a.ndim)
+            )
+        return jax.device_put(arr, self.placement)
+
+    def place_avail(self, avail):
+        """Move the threaded base (or a gathered sub-base) onto this slot.
+        A same-device put is a no-op view, so the single-slot pool costs
+        nothing extra."""
+        return self._put(avail)
+
+    def place_apps(self, apps):
+        """Mesh slots shard the app batch's node-axis masks with the
+        cluster; plain devices let the jit follow its committed inputs."""
+        if not self.is_mesh:
+            return apps
+        from spark_scheduler_tpu.parallel.solve import shard_apps
+
+        return shard_apps(apps, self.placement)
+
+    def resident_statics(self, host, epoch, clock, telemetry):
+        """The slot's resident full-cluster static replica, re-uploaded
+        only when the statics epoch moved (topology/attribute change)."""
+        if self.statics is None or self.statics_epoch != epoch:
+            self.statics = tuple(self._put(f) for f in cluster_statics(host))
+            self.statics_epoch = epoch
+            self.uploads["full"] += 1
+            self.last_full_upload = clock()
+            if telemetry is not None:
+                nbytes = sum(getattr(f, "nbytes", 0) for f in cluster_statics(host))
+                telemetry.on_device_upload(self.label, "full", nbytes)
+        else:
+            self.uploads["reuse"] += 1
+            if telemetry is not None:
+                telemetry.on_device_upload(self.label, "reuse", 0)
+        return self.statics
+
+    def sub_replica(self, host, idx_key, idx, epoch, clock, telemetry):
+        """Gathered static sub-cluster for a partition domain, cached per
+        (domain, statics epoch). `idx` is the host-side numpy index list."""
+        cached = self.sub_statics.get(idx_key)
+        if cached is not None and cached[0] == epoch:
+            self.uploads["reuse"] += 1
+            if telemetry is not None:
+                telemetry.on_device_upload(self.label, "reuse", 0)
+            return cached[1]
+        statics = tuple(
+            self._put(np.asarray(f)[idx]) for f in cluster_statics(host)
+        )
+        if len(self.sub_statics) >= 64:
+            self.sub_statics.clear()
+        self.sub_statics[idx_key] = (epoch, statics)
+        self.uploads["full"] += 1
+        self.last_full_upload = clock()
+        if telemetry is not None:
+            nbytes = sum(getattr(f, "nbytes", 0) for f in statics)
+            telemetry.on_device_upload(self.label, "full", nbytes)
+        return statics
+
+    def release(self):
+        """Drop every resident device buffer (close()/discard_pipeline():
+        repeated server rebuilds in one process must not accumulate dead
+        replicas on the devices). In-flight accounting resets too: a
+        release accompanies dropping the pipeline, and a discarded
+        window's parts are never fetched — without the reset the
+        DEVICE_INFLIGHT gauge would report phantom solves forever."""
+        self.statics = None
+        self.statics_epoch = -1
+        self.sub_statics.clear()
+        self.inflight = 0
+
+
+class _DevicePool:
+    """Round-robin slot allocator for the multi-device window-solve engine."""
+
+    def __init__(self, slots):
+        self.slots = [_PoolSlot(s) for s in slots]
+        self._next = 0
+
+    def next_slot(self) -> _PoolSlot:
+        slot = self.slots[self._next]
+        self._next = (self._next + 1) % len(self.slots)
+        return slot
+
+    def release(self):
+        for s in self.slots:
+            s.release()
+
+    def stats(self) -> dict:
+        return {
+            s.label: {**s.uploads, "inflight": s.inflight}
+            for s in self.slots
+        }
+
+
+class _PendingBase:
+    """A pooled window's committed-base combine, deferred until the next
+    pipelined build resolves it ON THE BUILD THREAD. Running the combine
+    lazily (instead of as a worker task) means combines can never park
+    pool workers waiting on other pool tasks — the classic bounded-pool
+    deadlock — and the scatter work is tiny next to the solves it waits
+    on. Duck-typed to Future.result() for _resolve_base."""
+
+    __slots__ = ("_fn", "_done", "_val", "_exc")
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._done = False
+        self._val = None
+        self._exc = None
+
+    def result(self):
+        if not self._done:
+            try:
+                self._val = self._fn()
+            except BaseException as exc:  # surfaced by _resolve_base
+                self._exc = exc
+            self._done = True
+            self._fn = None
+        if self._exc is not None:
+            raise self._exc
+        return self._val
+
+
+class _WindowPart:
+    """One partition of a pooled window: its request slice, the worker
+    future resolving to the fetched blob + timings, the EARLY future
+    carrying just the committed sub-base (set the moment the solve
+    finishes, BEFORE the blob d2h — the next window's base combine must
+    not wait out a decision-blob transfer), and the global-node index map
+    when the partition solved a gathered sub-cluster."""
+
+    __slots__ = (
+        "future", "after_future", "req_ids", "requests", "row_drv",
+        "row_exc", "row_skip", "idx", "slot", "rows",
+    )
+
+    def __init__(self, *, future, after_future, req_ids, requests, row_drv,
+                 row_exc, row_skip, idx, slot, rows):
+        self.future = future
+        self.after_future = after_future
+        self.req_ids = req_ids  # original positions in the window
+        self.requests = requests
+        self.row_drv = row_drv  # int64 [b_g, 3]
+        self.row_exc = row_exc
+        self.row_skip = row_skip
+        self.idx = idx  # np int32 global node indices, None = full cluster
+        self.slot = slot
+        self.rows = rows
+
+
 @_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
 def _pack_blob(cluster, dreq, ereq, count, dmask, dom, *, fill, emax, num_zones):
     """Single-app pack with the Packing flattened to one int32 [2+Emax]
@@ -300,12 +572,18 @@ class WindowHandle:
         "strategy", "blob", "blob_future", "requests", "flat_rows",
         "host_avail", "host_schedulable", "priors", "placements", "n",
         "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
-        "info",
+        "info", "parts", "request_device",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
                  host_schedulable, priors, n):
         self.strategy = strategy
+        # Multi-device engine: list[_WindowPart] when the window was served
+        # by the device pool (possibly partitioned); None on the classic
+        # single-device path. request_device[i] names the slot that solved
+        # request i (flight-recorder attribution).
+        self.parts = None
+        self.request_device = None
         # Device blob, not yet transferred: flat [B, 3+emax] int32 on the
         # XLA path; [S, R, 3+emax] on the Pallas window path (seg_map set
         # — pack_window_fetch flattens the real rows after the pull).
@@ -332,6 +610,21 @@ class WindowHandle:
         # "row_bucket", "emax", "compile_cache_hit"} — set at dispatch.
         self.info = None
 
+    def fetch_ready(self) -> bool:
+        """True when every decision pull this window started eagerly has
+        landed — completing it costs no blocking wait. False when no eager
+        pull exists (the caller decides whether to block)."""
+        if self.parts is not None:
+            return all(p.future.done() for p in self.parts)
+        return self.blob_future is not None and self.blob_future.done()
+
+    def has_eager_fetch(self) -> bool:
+        """Whether a decision pull is in flight on a side thread (the
+        serving loop sleeps on it instead of blocking in result())."""
+        if self.parts is not None:
+            return True
+        return self.blob_future is not None
+
 
 class PlacementSolver:
     def __init__(
@@ -339,8 +632,35 @@ class PlacementSolver:
         driver_label_priority: tuple[str, list[str]] | None = None,
         executor_label_priority: tuple[str, list[str]] | None = None,
         use_native: bool = True,
+        device_pool: int = 1,
+        mesh: tuple[int, int] | None = None,
     ):
         self.registry = NodeRegistry()
+        # Multi-device window-solve engine (`solver.device-pool` /
+        # `solver.mesh` install keys): `mesh=(groups, node_shards)` builds
+        # `groups` pool slots of `node_shards` devices each (node_shards>1
+        # = the GSPMD sharded serving mode); `device_pool=P` is shorthand
+        # for mesh (P, 1). Default (pool 1, no mesh) keeps the classic
+        # single-device serving path byte-for-byte.
+        self._pool: _DevicePool | None = None
+        pool_spec = mesh if mesh is not None else (device_pool, 1)
+        if pool_spec and (pool_spec[0] > 1 or pool_spec[1] > 1):
+            from spark_scheduler_tpu.parallel.mesh import make_pool_slots
+
+            slots = make_pool_slots(pool_spec[0], pool_spec[1])
+            if len(slots) > 1 or pool_spec[1] > 1:
+                self._pool = _DevicePool(slots)
+        # Statics epoch: bumped on every full host upload (topology or
+        # attribute change); pool replicas re-upload when their epoch lags.
+        self._static_epoch = 0
+        # How the LAST pipelined/cached build reached the device
+        # ("full" | "delta" | "reuse") — flight-recorder state_upload.
+        self.last_state_upload: str | None = None
+        # In-flight worker/fetch futures, cancelled (if unstarted) on
+        # close() so repeated server restarts drain the shared pools'
+        # queues instead of leaking device buffers through parked closures.
+        self._inflight_futures: set = set()
+        self._clock = _time.time
         self._driver_label_priority = driver_label_priority
         self._executor_label_priority = executor_label_priority
         # Native C++ arena (native/runtime.cpp): per-node state is upserted
@@ -395,6 +715,17 @@ class PlacementSolver:
     @property
     def uses_native_arena(self) -> bool:
         return self._arena is not None
+
+    @property
+    def pool_size(self) -> int:
+        """Slot count of the multi-device window-solve engine (1 = the
+        classic single-device serving path)."""
+        return len(self._pool.slots) if self._pool is not None else 1
+
+    def device_pool_stats(self) -> dict:
+        """Per-slot resident-state stats ({label: {full, reuse,
+        inflight}}) — surfaced by bench.py's multi-device section."""
+        return self._pool.stats() if self._pool is not None else {}
 
 
     def build_tensors(
@@ -480,6 +811,7 @@ class PlacementSolver:
                 if k == 0:
                     tensors = dev["tensors"]
                     stats["reuse_hits"] += 1
+                    self.last_state_upload = "reuse"
                 elif k <= max(32, host.available.shape[0] // 8):
                     # Bucket the row count so the scatter program compiles
                     # once per bucket; padding repeats dirty rows (set with
@@ -496,6 +828,7 @@ class PlacementSolver:
                     )
                     stats["delta_uploads"] += 1
                     stats["delta_rows"] += k
+                    self.last_state_upload = "delta"
                     if self.telemetry is not None:
                         self.telemetry.on_transfer(
                             "h2d", rows.nbytes + idx.nbytes
@@ -505,6 +838,7 @@ class PlacementSolver:
                         dev["tensors"], available=jax.device_put(host.available)
                     )
                     stats["full_uploads"] += 1
+                    self.last_state_upload = "full"
                     if self.telemetry is not None:
                         self.telemetry.on_transfer(
                             "h2d", host.available.nbytes
@@ -512,6 +846,7 @@ class PlacementSolver:
         if tensors is None:
             tensors = jax.device_put(host)
             stats["full_uploads"] += 1
+            self.last_state_upload = "full"
             if self.telemetry is not None:
                 self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
         tensors.host = host
@@ -520,19 +855,39 @@ class PlacementSolver:
 
     def close(self) -> None:
         """Stop accepting new pipelined fetch submits (they would enqueue a
-        Future whose result nobody will pull). The fetch pool itself is
-        process-shared (_shared_fetch_pool) and stays up for other
-        solvers; its workers are daemon threads, so a transfer stuck on a
-        dead tunnel can never block interpreter exit."""
+        Future whose result nobody will pull), CANCEL any queued-but-unrun
+        fetch/solve work this solver still has in the shared pools, and
+        release every device-resident buffer (pipeline state, cached
+        tensors, pool replicas). The pools themselves are process-shared
+        (_shared_fetch_pool / _shared_solve_pool) and stay up for other
+        solvers — their workers are a bounded set of daemon threads — but
+        without the cancel+release, repeated server restarts in one
+        process leak device buffers through parked closures."""
         self._closed = True
+        for fut in list(self._inflight_futures):
+            fut.cancel()  # no-op if already running; queued work is dropped
+        self._inflight_futures.clear()
+        self._pipe = None
+        self._dev = None
+        self._release_pool()
+
+    def _release_pool(self) -> None:
+        if self._pool is None:
+            return
+        self._pool.release()
+        if self.telemetry is not None:
+            for s in self._pool.slots:
+                self.telemetry.on_device_inflight(s.label, 0)
 
     def discard_pipeline(self) -> None:
         """Drop the pipelined device state: the next build_tensors_pipelined
         does a full upload from the host view. Used when in-flight window
         decisions are being discarded (capacity changed under them) — the
         host view is the durable truth once every surviving window has
-        applied."""
+        applied. Pool replicas are released with it (the next build bumps
+        the statics epoch, so every slot re-uploads on its next turn)."""
         self._pipe = None
+        self._release_pool()
         if self.telemetry is not None:
             self.telemetry.on_pipeline_event("discard")
 
@@ -568,6 +923,8 @@ class PlacementSolver:
         )
         stats = self.device_state_stats
         p = self._pipe
+        if p is not None and not self._resolve_base(p):
+            p = None  # pooled combine failed: pipeline dead, full re-upload
         if (
             p is not None
             and p["host"].available.shape == host.available.shape
@@ -600,20 +957,27 @@ class PlacementSolver:
                 if k:
                     # Pad with a repeated index but ZERO delta rows: .add
                     # is cumulative, so padding must contribute nothing.
+                    # The base is DONATED into the add — committed-base
+                    # updates are in place, and the consumed buffer (the
+                    # previous build's availability) is dead by contract.
                     kb = _bucket(k, 16)
                     idx = np.full(kb, dirty[0], dtype=np.int32)
                     idx[:k] = dirty
                     rows = np.zeros((kb, host.available.shape[1]), np.int32)
                     rows[:k] = delta[dirty]
-                    avail = _add_rows(avail, jnp.asarray(idx), jnp.asarray(rows))
+                    avail = _add_rows_donated(
+                        avail, jnp.asarray(idx), jnp.asarray(rows)
+                    )
                     stats["delta_uploads"] += 1
                     stats["delta_rows"] += k
+                    self.last_state_upload = "delta"
                     if self.telemetry is not None:
                         self.telemetry.on_transfer(
                             "h2d", rows.nbytes + idx.nbytes
                         )
                 else:
                     stats["reuse_hits"] += 1
+                    self.last_state_upload = "reuse"
                 tensors = dataclasses.replace(p["tensors"], available=avail)
                 tensors.host = host
                 p.update(host=host, tensors=tensors, avail=avail, mirror=cur)
@@ -627,6 +991,10 @@ class PlacementSolver:
         tensors = jax.device_put(host)
         tensors.host = host
         stats["full_uploads"] += 1
+        self.last_state_upload = "full"
+        # Statics may have changed with this full upload: pool replicas
+        # re-upload on their next turn.
+        self._static_epoch += 1
         if self.telemetry is not None:
             self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
         self._pipe = {
@@ -637,6 +1005,24 @@ class PlacementSolver:
             "unfetched": [],
         }
         return tensors
+
+    def _resolve_base(self, p) -> bool:
+        """Resolve a pooled window's pending committed-base combine (the
+        scatter of every partition's sub-base back into the global base).
+        False when the combine failed — the pipeline is dead exactly like
+        a failed decision fetch: drop it, count it, rebuild from host
+        truth (in-flight handles still fetch fine on their own futures)."""
+        avail = p.get("avail")
+        if not hasattr(avail, "result"):
+            return True
+        try:
+            p["avail"] = avail.result()
+            return True
+        except BaseException:
+            self._pipe = None
+            if self.telemetry is not None:
+                self.telemetry.on_pipeline_event("fetch-failure")
+            return False
 
     def _label_rank(self, node: Node, prio) -> int:
         if prio is None:
@@ -945,22 +1331,38 @@ class PlacementSolver:
         dom_rows: list[np.ndarray] = []
         cand_per_req: list[np.ndarray] = []
         dom_per_req: list[np.ndarray] = []
+        # Affinity-domain identity per request, for the multi-device
+        # engine's partition plan: requests sharing a domain_node_names
+        # tuple share ONE mask build and one partition key; None marks a
+        # request whose domain cannot key a partition (precomputed mask
+        # override, or the all-valid default that overlaps everything).
+        dom_memo: dict[tuple, np.ndarray] = {}
+        dom_keys: list[tuple | None] = []
+        req_row_ranges: list[tuple[int, int]] = []
         for req in requests:
             cand = self.candidate_mask(tensors, req.driver_candidate_names)
+            key: tuple | None = None
             if req.domain_mask is not None:
                 dom = np.asarray(req.domain_mask) & valid_np
             elif req.domain_node_names is not None:
-                dom = self.candidate_mask(tensors, req.domain_node_names) & valid_np
+                key = tuple(req.domain_node_names)
+                dom = dom_memo.get(key)
+                if dom is None:
+                    dom = self.candidate_mask(tensors, req.domain_node_names) & valid_np
+                    dom_memo[key] = dom
             else:
                 dom = valid_np
+            dom_keys.append(key)
             cand_per_req.append(cand)
             dom_per_req.append(dom)
+            lo = len(flat_rows)
             for j, row in enumerate(req.rows):
                 flat_rows.append(row)
                 commit.append(j == len(req.rows) - 1)
                 reset.append(j == 0)
                 cand_rows.append(cand)
                 dom_rows.append(dom)
+            req_row_ranges.append((lo, len(flat_rows)))
 
         b = len(flat_rows)
         # FIFO windows repeat the SAME row objects across requests (request
@@ -980,6 +1382,19 @@ class PlacementSolver:
         counts = np.asarray([r[2] for r in flat_rows], np.int32)
         skip_arr = np.asarray([bool(r[3]) for r in flat_rows])
         emax = _bucket(max(int(counts.max()), 1), 8)
+        p = self._pipe
+        pipelined = p is not None and tensors is p["tensors"]
+        if self._pool is not None and pipelined:
+            # Multi-device engine: round-robin the window (partitioned by
+            # disjoint affinity domains when possible) across the pool.
+            return self._dispatch_pooled(
+                strategy, tensors, requests,
+                host=host,
+                drv_arr=drv_arr, exc_arr=exc_arr, counts=counts,
+                skip_arr=skip_arr, emax=emax,
+                cand_per_req=cand_per_req, dom_per_req=dom_per_req,
+                dom_keys=dom_keys, req_row_ranges=req_row_ranges,
+            )
         from spark_scheduler_tpu.tracing import tracer
 
         # Route the segmented window to the Pallas path when the backend
@@ -1034,10 +1449,21 @@ class PlacementSolver:
                     commit=commit,
                     reset=reset,
                 )
-                blob, avail_after = _window_blob(
-                    tensors, apps, fill=strategy, emax=emax,
-                    num_zones=self._num_zones_bucket(),
-                )
+                if pipelined:
+                    # Double-buffered committed base: the pipeline owns the
+                    # availability buffer exclusively (nothing reads it
+                    # after this dispatch), so DONATE it — available_after
+                    # updates it in place instead of copy-on-write.
+                    blob, avail_after = _window_blob_donated(
+                        tensors.available, cluster_statics(tensors), apps,
+                        fill=strategy, emax=emax,
+                        num_zones=self._num_zones_bucket(),
+                    )
+                else:
+                    blob, avail_after = _window_blob(
+                        tensors, apps, fill=strategy, emax=emax,
+                        num_zones=self._num_zones_bucket(),
+                    )
 
         info = {
             "path": path,
@@ -1045,6 +1471,7 @@ class PlacementSolver:
             "rows": b,
             "row_bucket": row_bucket * seg_bucket,
             "emax": emax,
+            "state_upload": self.last_state_upload if pipelined else None,
             "compile_cache_hit": (
                 tel.compile_count() == compiles_before
                 if tel is not None
@@ -1065,8 +1492,6 @@ class PlacementSolver:
                 + skip_arr.nbytes,
             )
         priors: tuple = ()
-        p = self._pipe
-        pipelined = p is not None and tensors is p["tensors"]
         if pipelined:
             priors = tuple(p["unfetched"])
             p["avail"] = avail_after  # the next pipelined build extends this
@@ -1095,6 +1520,263 @@ class PlacementSolver:
             handle.blob_future = _shared_fetch_pool().submit(
                 jax.device_get, blob
             )
+            self._track(handle.blob_future)
+        return handle
+
+    def _track(self, fut) -> None:
+        """Register an in-flight pool future for cancel-on-close()."""
+        self._inflight_futures.add(fut)
+        fut.add_done_callback(self._inflight_futures.discard)
+
+    def _dispatch_pooled(
+        self, strategy, tensors, requests, *, host, drv_arr, exc_arr,
+        counts, skip_arr, emax, cand_per_req, dom_per_req, dom_keys,
+        req_row_ranges,
+    ) -> "WindowHandle":
+        """Multi-device window dispatch (the engine behind `solver.mesh` /
+        `solver.device-pool`).
+
+        The window is split into PARTITIONS of requests whose affinity
+        domains are provably pairwise-disjoint (instance groups in
+        practice: failover.go:276-313 groups nodes by the instance-group
+        label, and every request's node selector pins it to one group).
+        Requests inside a partition interact only through availability
+        rows of their own domain, and zone ranks / priority orders /
+        packing efficiencies all derive from domain-masked aggregates
+        (ops/sorting.py, ops/efficiency.py), so partitions COMMUTE:
+        solving them concurrently — each over a GATHERED sub-cluster of
+        just its domain's rows, on its own pool slot — produces decisions
+        byte-identical to the serialized window (pinned by
+        tests/test_window_serving.py). Windows that do not partition
+        (shared or unkeyed domains) run whole on the next slot, which
+        still overlaps their d2h decision pull with the next window's
+        h2d upload on another device.
+
+        The committed base stays a single logical thread: each
+        partition's `available_after` rows scatter back into the
+        (donated) global base, and the next pipelined build resolves that
+        combine before applying external deltas."""
+        from spark_scheduler_tpu.tracing import tracer
+
+        p = self._pipe
+        n = tensors.available.shape[0]
+        pool = self._pool
+        tel = self.telemetry
+        compiles_before = tel.compile_count() if tel is not None else None
+        num_zones = self._num_zones_bucket()
+        solve_pool = _shared_solve_pool()
+        now = self._clock()
+
+        # ---- partition plan: ≥2 distinct domain keys, all keyed, masks
+        # pairwise disjoint and non-empty. Plain-device slots only — a
+        # sharded (mesh) slot solves the whole window over the node axis.
+        plan = None
+        if (
+            len(pool.slots) > 1
+            and not any(s.is_mesh for s in pool.slots)
+            and all(k is not None for k in dom_keys)
+        ):
+            groups: dict[tuple, list[int]] = {}
+            for r, key in enumerate(dom_keys):
+                groups.setdefault(key, []).append(r)
+            if len(groups) > 1:
+                masks = [dom_per_req[ids[0]] for ids in groups.values()]
+                overlap = np.zeros(n, np.int32)
+                for m in masks:
+                    overlap += m
+                if int(overlap.max()) <= 1 and all(m.any() for m in masks):
+                    plan = list(groups.items())
+
+        base = p["avail"]
+        base_device = next(iter(base.devices()))
+        request_device: list = [None] * len(requests)
+        parts: list[_WindowPart] = []
+
+        def submit_part(slot, req_ids, idx_key, idx):
+            row_sel = np.concatenate(
+                [np.arange(*req_row_ranges[r]) for r in req_ids]
+            )
+            drv_g, exc_g = drv_arr[row_sel], exc_arr[row_sel]
+            cnt_g, skip_g = counts[row_sel], skip_arr[row_sel]
+            commit_g: list[bool] = []
+            reset_g: list[bool] = []
+            cand_g: list[np.ndarray] = []
+            dom_g: list[np.ndarray] = []
+            for r in req_ids:
+                lo, hi = req_row_ranges[r]
+                span = hi - lo
+                commit_g += [False] * (span - 1) + [True]
+                reset_g += [True] + [False] * (span - 1)
+                c, d = cand_per_req[r], dom_per_req[r]
+                if idx is not None:
+                    c, d = c[idx], d[idx]
+                cand_g += [c] * span
+                dom_g += [d] * span
+            b_g = len(row_sel)
+            apps = make_app_batch(
+                drv_g, exc_g, cnt_g, skippable=skip_g,
+                pad_to=_bucket(b_g, 8),
+                driver_cand=np.stack(cand_g), domain=np.stack(dom_g),
+                commit=commit_g, reset=reset_g,
+            )
+            epoch = self._static_epoch
+            if idx is None:
+                statics = slot.resident_statics(host, epoch, self._clock, tel)
+                sub_avail = slot.place_avail(base)
+            else:
+                statics = slot.sub_replica(
+                    host, idx_key, idx, epoch, self._clock, tel
+                )
+                sub_avail = slot.place_avail(_take_rows(base, jnp.asarray(idx)))
+            apps = slot.place_apps(apps)
+            # Donate the sub-base on plain devices: a gathered copy (or a
+            # base the combine will replace) that nothing else reads.
+            fn = _window_blob_statics if slot.is_mesh else _window_blob_donated
+            slot.inflight += 1
+            if tel is not None:
+                tel.on_device_inflight(slot.label, slot.inflight)
+                if slot.last_full_upload:
+                    tel.on_device_age(
+                        slot.label, max(0.0, now - slot.last_full_upload)
+                    )
+
+            from concurrent.futures import Future as _Future
+
+            # The committed sub-base publishes on its OWN future the
+            # moment the solve lands — the next window's base combine
+            # must never wait out this part's decision-blob d2h (that
+            # transfer overlaps the next window's work, exactly like the
+            # single-device eager fetch).
+            after_fut: _Future = _Future()
+
+            def run():
+                t0 = self._clock()
+                try:
+                    blob, after = fn(
+                        sub_avail, statics, apps,
+                        fill=strategy, emax=emax, num_zones=num_zones,
+                    )
+                    after = jax.block_until_ready(after)
+                except BaseException as exc:
+                    after_fut.set_exception(exc)
+                    raise
+                after_fut.set_result(after)
+                t1 = self._clock()
+                blob_np = np.asarray(jax.device_get(blob))
+                t2 = self._clock()
+                return {
+                    "blob": blob_np,
+                    "solve_ms": (t1 - t0) * 1e3,
+                    "fetch_ms": (t2 - t1) * 1e3,
+                }
+
+            fut = solve_pool.submit(run)
+            self._track(fut)
+
+            def _propagate_cancel(f, af=after_fut):
+                # A close()-cancelled part never runs; the base future
+                # must fail too, not hang a later resolve.
+                if f.cancelled() and not af.done():
+                    af.cancel()
+
+            fut.add_done_callback(_propagate_cancel)
+            for r in req_ids:
+                request_device[r] = slot.label
+            return _WindowPart(
+                future=fut, after_future=after_fut, req_ids=list(req_ids),
+                requests=[requests[r] for r in req_ids],
+                row_drv=drv_g.astype(np.int64),
+                row_exc=exc_g.astype(np.int64),
+                row_skip=skip_g, idx=idx, slot=slot, rows=b_g,
+            )
+
+        with tracer().span(
+            "solve-dispatch", strategy=strategy, nodes=n,
+            window_requests=len(requests), window_rows=len(drv_arr),
+            batched=True, path="pool",
+            partitions=len(plan) if plan else 1,
+        ):
+            if plan is None:
+                parts.append(
+                    submit_part(
+                        pool.next_slot(), list(range(len(requests))),
+                        None, None,
+                    )
+                )
+                head = parts[0]
+                p["avail"] = _PendingBase(
+                    lambda: head.after_future.result()
+                )
+            else:
+                for key, req_ids in plan:
+                    idx = np.flatnonzero(
+                        dom_per_req[req_ids[0]]
+                    ).astype(np.int32)
+                    parts.append(
+                        submit_part(pool.next_slot(), req_ids, key, idx)
+                    )
+
+                def combine(parts=parts, base=base):
+                    # Scatter every partition's committed sub-base back
+                    # into the global base (disjoint rows; the base is
+                    # DONATED through the chain — in-place double-buffer).
+                    # Waits only on the solves (after_future), never on
+                    # the decision-blob transfers.
+                    out = base
+                    for part in parts:
+                        rows = jax.device_put(
+                            part.after_future.result(), base_device
+                        )
+                        out = _scatter_rows_exact_donated(
+                            out, jnp.asarray(part.idx), rows
+                        )
+                    return out
+
+                p["avail"] = _PendingBase(combine)
+
+        self.window_path_counts["pool"] = (
+            self.window_path_counts.get("pool", 0) + 1
+        )
+        b = len(drv_arr)
+        info = {
+            "path": "pool",
+            "nodes": n,
+            "rows": b,
+            "row_bucket": _bucket(b, 8),
+            "emax": emax,
+            "partitions": len(parts),
+            "devices": sorted({pt.slot.label for pt in parts}),
+            "state_upload": self.last_state_upload,
+            "compile_cache_hit": (
+                tel.compile_count() == compiles_before
+                if tel is not None
+                else None
+            ),
+        }
+        self.last_solve_info = info
+        if tel is not None:
+            tel.on_window_dispatch(
+                "pool", nodes=n, rows=b, row_bucket=_bucket(b, 8),
+            )
+            tel.on_transfer(
+                "h2d",
+                drv_arr.nbytes + exc_arr.nbytes + counts.nbytes
+                + skip_arr.nbytes,
+            )
+        handle = WindowHandle(
+            strategy=strategy,
+            blob=None,
+            requests=tuple(requests),
+            flat_rows=[],
+            host_avail=np.array(np.asarray(host.available), dtype=np.int64),
+            host_schedulable=np.asarray(host.schedulable),
+            priors=tuple(p["unfetched"]),
+            n=n,
+        )
+        handle.parts = parts
+        handle.request_device = request_device
+        handle.info = info
+        p["unfetched"].append(handle)
         return handle
 
     def pack_window_fetch(self, handle: "WindowHandle") -> list[WindowDecision]:
@@ -1102,6 +1784,8 @@ class PlacementSolver:
         per-request outcomes (the second half of pack_window)."""
         if not handle.requests:
             return []
+        if handle.parts is not None:
+            return self._fetch_pooled(handle)
         from spark_scheduler_tpu.tracing import tracer
 
         requests, n = handle.requests, handle.n
@@ -1137,23 +1821,124 @@ class PlacementSolver:
         packed = blob[:, 2].astype(bool)
         execs = blob[:, 3:]
 
-        # Host-side reconstruction for per-request packing efficiency: the
-        # availability each admitted request's final pack saw = the
-        # host view at dispatch, minus the committed placements of windows
-        # that were still in flight then (the device had them threaded),
-        # minus committed placements of earlier segments, minus in-segment
-        # admitted hypothetical placements. Vectorized over each segment's
-        # rows (a FIFO window carries O(requests x pending) hypothetical
-        # rows — per-row Python was the serving loop's hot spot).
-        drv64 = handle.row_driver_req
-        exc64 = handle.row_exec_req
-        skip = handle.row_skippable
-        decisions: list[WindowDecision] = []
         base = handle.host_avail.copy()
         for prior in handle.priors:
             if prior.placements is not None:
                 base -= prior.placements
         placements = np.zeros_like(base)
+        decisions = self._reconstruct_requests(
+            requests, drivers, admitted, packed, execs,
+            handle.row_driver_req, handle.row_exec_req,
+            handle.row_skippable, base, placements,
+            handle.host_schedulable,
+        )
+        handle.placements = placements
+        # Pipeline accounting: the device base now permanently embodies this
+        # window's committed gangs; debit them from the mirror so the next
+        # build's host-vs-mirror delta ships only EXTERNAL changes. When the
+        # host later fails to create one of these reservations, its usage
+        # never reaches the host view and the next delta restores the gang's
+        # capacity on device automatically (self-correcting drift).
+        p = self._pipe
+        if p is not None and handle in p["unfetched"]:
+            p["unfetched"].remove(handle)
+            p["mirror"] -= placements
+        return decisions
+
+    def _fetch_pooled(self, handle: "WindowHandle") -> list[WindowDecision]:
+        """Fetch + reconstruct a pooled (possibly partitioned) window.
+
+        Partitions reconstruct against the SHARED global base in part
+        order — their committed rows are pairwise disjoint, so any order
+        yields the serialized window's exact base — and the decisions
+        reassemble into original request order."""
+        from spark_scheduler_tpu.tracing import tracer
+
+        requests, n = handle.requests, handle.n
+        tel = self.telemetry
+        results: list = [None] * len(requests)
+        base = handle.host_avail.copy()
+        for prior in handle.priors:
+            if prior.placements is not None:
+                base -= prior.placements
+        placements = np.zeros_like(base)
+        with tracer().span(
+            "solve", strategy=handle.strategy, nodes=n,
+            window_requests=len(requests), batched=True,
+            path="pool", partitions=len(handle.parts),
+        ):
+            for part_i, part in enumerate(handle.parts):
+                try:
+                    out = part.future.result()
+                except Exception:
+                    # Same contract as a single-device fetch failure: the
+                    # device base embodies unknowable placements, so the
+                    # whole pipeline drops and the next build re-uploads
+                    # host truth (the dead combine is skipped by
+                    # _resolve_base the same way). Only the parts not yet
+                    # processed release their in-flight slots here —
+                    # earlier parts already did.
+                    self._pipe = None
+                    for pt in handle.parts[part_i:]:
+                        pt.slot.inflight = max(0, pt.slot.inflight - 1)
+                        if tel is not None:
+                            tel.on_device_inflight(
+                                pt.slot.label, pt.slot.inflight
+                            )
+                    if tel is not None:
+                        tel.on_pipeline_event("fetch-failure")
+                    raise
+                blob = out["blob"]
+                part.slot.inflight = max(0, part.slot.inflight - 1)
+                if tel is not None:
+                    tel.on_transfer("d2h", blob.nbytes)
+                    tel.on_device_window(
+                        part.slot.label, out["solve_ms"], out["fetch_ms"],
+                        inflight=part.slot.inflight,
+                    )
+                drivers = blob[:, 0].astype(np.int64)
+                admitted = blob[:, 1].astype(bool)
+                packed = blob[:, 2].astype(bool)
+                execs = blob[:, 3:].astype(np.int64)
+                if part.idx is not None:
+                    # Sub-cluster solve: map local node indices back to
+                    # the global index space (-1 stays -1).
+                    gmap = part.idx.astype(np.int64)
+                    drivers = np.where(
+                        drivers >= 0, gmap[np.clip(drivers, 0, None)], -1
+                    )
+                    execs = np.where(
+                        execs >= 0, gmap[np.clip(execs, 0, None)], -1
+                    )
+                decisions = self._reconstruct_requests(
+                    part.requests, drivers, admitted, packed, execs,
+                    part.row_drv, part.row_exc, part.row_skip,
+                    base, placements, handle.host_schedulable,
+                )
+                for rid, d in zip(part.req_ids, decisions):
+                    results[rid] = d
+        handle.placements = placements
+        p = self._pipe
+        if p is not None and handle in p["unfetched"]:
+            p["unfetched"].remove(handle)
+            p["mirror"] -= placements
+        return results
+
+    def _reconstruct_requests(
+        self, requests, drivers, admitted, packed, execs,
+        drv64, exc64, skip, base, placements, host_schedulable,
+    ) -> list[WindowDecision]:
+        """Host-side reconstruction for per-request packing efficiency: the
+        availability each admitted request's final pack saw = the
+        host view at dispatch, minus the committed placements of windows
+        that were still in flight then (the device had them threaded),
+        minus committed placements of earlier segments, minus in-segment
+        admitted hypothetical placements. Vectorized over each segment's
+        rows (a FIFO window carries O(requests x pending) hypothetical
+        rows — per-row Python was the serving loop's hot spot). Mutates
+        `base` and `placements` in place (the pooled fetch threads ONE
+        base through every partition)."""
+        decisions: list[WindowDecision] = []
         row = 0
         for r, req in enumerate(requests):
             nrows = len(req.rows)
@@ -1182,7 +1967,7 @@ class PlacementSolver:
                         ri, _si = np.nonzero(esel)
                         np.subtract.at(seg_avail, e[esel], exc64[hyp][ri])
                 eff = avg_packing_efficiency_np(
-                    handle.host_schedulable,
+                    host_schedulable,
                     seg_avail,
                     int(drivers[real]),
                     execs[real],
@@ -1221,17 +2006,6 @@ class PlacementSolver:
                     earlier_blocked=earlier_blocked,
                 )
             )
-        handle.placements = placements
-        # Pipeline accounting: the device base now permanently embodies this
-        # window's committed gangs; debit them from the mirror so the next
-        # build's host-vs-mirror delta ships only EXTERNAL changes. When the
-        # host later fails to create one of these reservations, its usage
-        # never reaches the host view and the next delta restores the gang's
-        # capacity on device automatically (self-correcting drift).
-        p = self._pipe
-        if p is not None and handle in p["unfetched"]:
-            p["unfetched"].remove(handle)
-            p["mirror"] -= placements
         return decisions
 
     def subtract_usage(self, tensors, usage: dict[str, Resources]):

@@ -84,6 +84,32 @@ _jtu.register_pytree_node(
 )
 
 
+def cluster_statics(cluster: ClusterTensors) -> tuple:
+    """Every ClusterTensors field EXCEPT `available`, as a flat tuple.
+
+    The serving engine splits the cluster at this seam: the static fields
+    are uploaded once per device and stay resident, while the availability
+    rides its own (donatable) argument — donating a whole ClusterTensors
+    would delete the resident replica's static buffers with it. Order
+    matches the constructor after `available` (cluster_from_statics)."""
+    return (
+        cluster.schedulable,
+        cluster.zone_id,
+        cluster.name_rank,
+        cluster.label_rank_driver,
+        cluster.label_rank_executor,
+        cluster.unschedulable,
+        cluster.ready,
+        cluster.valid,
+    )
+
+
+def cluster_from_statics(available, statics: tuple) -> ClusterTensors:
+    """Rebuild a ClusterTensors from an availability tensor + the static
+    tuple `cluster_statics` produced (works on traced values inside jit)."""
+    return ClusterTensors(available, *statics)
+
+
 class NodeRegistry:
     """Host-side interning of node names and zone labels to stable indices.
 

@@ -64,6 +64,14 @@ class DecisionRecord:
     # Solver dispatch info: {"path", "nodes", "rows", "row_bucket", "emax",
     # "compile_cache_hit"} when a device solve served the decision.
     solve: Optional[dict[str, Any]] = None
+    # Which pool slot solved this decision's window (partition), e.g.
+    # "cpu:1" — None outside the multi-device engine. Lets /debug/decisions
+    # attribute a latency outlier to one device.
+    device_id: Optional[str] = None
+    # How the solve's cluster state reached the device: "full" re-upload,
+    # "delta" row scatter, or "reuse" of the resident replica — a "full"
+    # on a latency outlier marks a cold device replica.
+    state_upload: Optional[str] = None
     # Set by the autoscaler when the demand this denial created is
     # fulfilled: {"fulfilled_at", "latency_s"}.
     demand: Optional[dict[str, float]] = None
@@ -107,6 +115,8 @@ class FlightRecorder:
         queue_position: Optional[int] = None,
         phases: Optional[dict[str, float]] = None,
         solve: Optional[dict] = None,
+        device_id: Optional[str] = None,
+        state_upload: Optional[str] = None,
     ) -> DecisionRecord:
         if (
             failed_nodes
@@ -140,6 +150,8 @@ class FlightRecorder:
             queue_position=queue_position,
             phases=phases or {},
             solve=solve,
+            device_id=device_id,
+            state_upload=state_upload,
         )
         with self._lock:
             self._ring.append(rec)

@@ -27,6 +27,15 @@ BUCKET_OCCUPANCY = "foundry.spark.scheduler.solver.bucket.occupancy"
 PIPELINE_EVENTS = "foundry.spark.scheduler.solver.pipeline.events"
 TRANSFER_BYTES = "foundry.spark.scheduler.solver.transfer.bytes"
 SOLO_PACKS = "foundry.spark.scheduler.solver.packs"
+# Multi-device window-solve engine (core/solver.py _DevicePool): per-slot
+# series tagged device=<label>.
+DEVICE_UPLOADS = "foundry.spark.scheduler.solver.device.uploads"
+DEVICE_INFLIGHT = "foundry.spark.scheduler.solver.device.inflight"
+DEVICE_SOLVE_MS = "foundry.spark.scheduler.solver.device.solve.ms"
+DEVICE_FETCH_MS = "foundry.spark.scheduler.solver.device.fetch.ms"
+DEVICE_RESIDENT_AGE = (
+    "foundry.spark.scheduler.solver.device.resident.age.seconds"
+)
 
 # The one real-compile event (trace/lowering events also fire per compile
 # but would triple-count).
@@ -125,6 +134,41 @@ class SolverTelemetry:
             SOLO_PACKS, nodes=str(nodes), emax=str(emax)
         ).inc()
         self.sync_compile_gauges()
+
+    # -- device pool ---------------------------------------------------------
+
+    def on_device_upload(self, device: str, kind: str, nbytes: int = 0) -> None:
+        """One resident-replica decision on a pool slot: kind is
+        "full" (statics re-uploaded) or "reuse" (resident copy served)."""
+        self.registry.counter(DEVICE_UPLOADS, device=device, kind=kind).inc()
+        if nbytes > 0:
+            self.on_transfer("h2d", nbytes)
+
+    def on_device_inflight(self, device: str, inflight: int) -> None:
+        """Dispatched-but-unfetched window solves currently on the slot."""
+        self.registry.gauge(DEVICE_INFLIGHT, device=device).set(inflight)
+
+    def on_device_age(self, device: str, age_s: float) -> None:
+        """Seconds since the slot's resident state was last fully uploaded
+        — a cold replica explains a latency outlier on that device."""
+        self.registry.gauge(DEVICE_RESIDENT_AGE, device=device).set(
+            round(age_s, 3)
+        )
+
+    def on_device_window(
+        self, device: str, solve_ms: float, fetch_ms: float,
+        inflight: int | None = None,
+    ) -> None:
+        """Per-slot phase wall times of one window (or window partition):
+        device solve vs decision-blob fetch."""
+        self.registry.histogram(DEVICE_SOLVE_MS, device=device).update(
+            solve_ms
+        )
+        self.registry.histogram(DEVICE_FETCH_MS, device=device).update(
+            fetch_ms
+        )
+        if inflight is not None:
+            self.on_device_inflight(device, inflight)
 
     # -- pipeline ------------------------------------------------------------
 

@@ -359,6 +359,42 @@ def batched_fifo_pack(
     )
 
 
+@partial(
+    jax.jit,
+    static_argnames=("fill", "emax", "num_zones", "unroll"),
+    donate_argnums=(0,),
+)
+def batched_fifo_pack_carry(
+    available,
+    statics: tuple,
+    apps: AppBatch,
+    *,
+    fill: str = "tightly-pack",
+    emax: int,
+    num_zones: int,
+    unroll: int = 2,
+) -> BatchedPacking:
+    """`batched_fifo_pack` with the base-capacity carry split out and
+    DONATED: `available` is consumed and `available_after` reuses its
+    buffer in place instead of copy-on-write, so a caller threading the
+    committed base across back-to-back windows (the pipelined serving
+    engine, the bench's window chains) never pays an [N, 3] copy per
+    window. `statics` is `models.cluster.cluster_statics(cluster)` — the
+    resident, never-donated fields. The input availability is DEAD after
+    the call (jax marks it deleted); callers must thread
+    `available_after` forward, never the input."""
+    from spark_scheduler_tpu.models.cluster import cluster_from_statics
+
+    return batched_fifo_pack(
+        cluster_from_statics(available, statics),
+        apps,
+        fill=fill,
+        emax=emax,
+        num_zones=num_zones,
+        unroll=unroll,
+    )
+
+
 def make_app_batch(
     driver_reqs,  # [B,3] array-like
     exec_reqs,  # [B,3] array-like

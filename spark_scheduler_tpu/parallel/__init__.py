@@ -9,16 +9,21 @@ are declared with `jax.sharding.NamedSharding` and XLA inserts the
 psum/all-gather/all-to-all it needs (scaling-book recipe).
 """
 
-from spark_scheduler_tpu.parallel.mesh import make_solver_mesh
+from spark_scheduler_tpu.parallel.mesh import make_pool_slots, make_solver_mesh
 from spark_scheduler_tpu.parallel.solve import (
     grouped_fifo_pack,
     grouped_fifo_pack_auto,
+    node_sharding,
+    shard_apps,
     sharded_fifo_pack,
     stack_groups,
 )
 
 __all__ = [
+    "make_pool_slots",
     "make_solver_mesh",
+    "node_sharding",
+    "shard_apps",
     "sharded_fifo_pack",
     "grouped_fifo_pack",
     "grouped_fifo_pack_auto",

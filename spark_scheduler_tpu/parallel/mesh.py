@@ -47,3 +47,34 @@ def make_solver_mesh(
         )
     arr = np.asarray(devices).reshape(n_groups, n_nodes_shards)
     return Mesh(arr, ("groups", "nodes"))
+
+
+def make_pool_slots(pool: int, node_shards: int = 1, devices=None) -> list:
+    """Placements for the serving window-solve engine (core/solver.py):
+    `pool` SLOTS, each either a plain device (node_shards == 1) or a
+    single-axis ("nodes",) sub-mesh of `node_shards` devices. Slot k gets
+    devices [k*S, (k+1)*S) of the flat device list — the same row-major
+    layout make_solver_mesh uses, so a {groups, node_shards} install config
+    describes both APIs identically.
+
+    More slots than the backend has devices CLAMP to what exists (slot
+    count is a throughput knob, not a correctness contract — a laptop run
+    of an 8-pool config must serve, just without the parallelism)."""
+    devices = list(devices if devices is not None else jax.devices())
+    node_shards = max(1, node_shards)
+    pool = max(1, pool)
+    usable = len(devices) // node_shards
+    if usable < 1:
+        raise ValueError(
+            f"mesh node-shards {node_shards} exceeds the {len(devices)} "
+            "available devices"
+        )
+    pool = min(pool, usable)
+    slots = []
+    for k in range(pool):
+        row = devices[k * node_shards : (k + 1) * node_shards]
+        if node_shards == 1:
+            slots.append(row[0])
+        else:
+            slots.append(Mesh(np.asarray(row), ("nodes",)))
+    return slots

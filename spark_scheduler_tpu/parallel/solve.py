@@ -29,13 +29,22 @@ from spark_scheduler_tpu.models.cluster import ClusterTensors
 from spark_scheduler_tpu.ops.batched import AppBatch, BatchedPacking, batched_fifo_pack
 
 
+def node_sharding(mesh: Mesh, ndim: int, leading=()) -> NamedSharding:
+    """THE sharding of a node-axis array on a ("nodes",) mesh: axis 0
+    (after any `leading` axes) over "nodes", the rest replicated. The one
+    definition both the one-shot sharded_fifo_pack placement and the
+    serving engine's per-slot replica placement (core/solver.py
+    _PoolSlot) build on — edit here, both follow."""
+    spec = P(*leading, "nodes", *([None] * (ndim - 1 - len(leading))))
+    return NamedSharding(mesh, spec)
+
+
 def _shard_cluster(cluster: ClusterTensors, mesh: Mesh, leading=()) -> ClusterTensors:
     """Place cluster tensors with the node axis sharded over "nodes"."""
 
     def put(x):
         x = jnp.asarray(x)
-        spec = P(*leading, "nodes", *([None] * (x.ndim - 1 - len(leading))))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return jax.device_put(x, node_sharding(mesh, x.ndim, leading))
 
     return jax.tree_util.tree_map(put, cluster)
 
@@ -67,6 +76,17 @@ def _shard_apps(apps: AppBatch, mesh: Mesh, leading=()) -> AppBatch:
         commit=put(apps.commit),
         reset=put(apps.reset),
     )
+
+
+# Public surface for the serving window-solve engine (core/solver.py):
+# `node_sharding` places a mesh slot's resident replica fields and
+# `shard_apps` its window app batches with the SAME shardings the one-shot
+# sharded_fifo_pack picks; the engine then runs its own blob-packing jit
+# over them (computation follows input shardings — GSPMD).
+def shard_apps(apps: AppBatch, mesh: Mesh) -> AppBatch:
+    """App batch replicated over "nodes" except the per-app [B, N] masks,
+    which shard their node axis with the cluster."""
+    return _shard_apps(apps, mesh)
 
 
 def sharded_fifo_pack(

@@ -176,6 +176,14 @@ def build_scheduler_app(
         demand_crd_watcher.on_ready(
             lambda: backend.subscribe("demands", on_update=_on_demand_update)
         )
+    # Multi-device window-solve engine: `solver.mesh {groups, node-shards}`
+    # wins over the `solver.device-pool` shorthand when both are set.
+    mesh = None
+    if config.solver_mesh_groups or config.solver_mesh_node_shards:
+        mesh = (
+            config.solver_mesh_groups or 1,
+            config.solver_mesh_node_shards or 1,
+        )
     solver = PlacementSolver(
         driver_label_priority=(
             config.driver_prioritized_node_label.as_tuple()
@@ -187,6 +195,8 @@ def build_scheduler_app(
             if config.executor_prioritized_node_label
             else None
         ),
+        device_pool=config.solver_device_pool,
+        mesh=mesh,
     )
     recorder = None
     if config.flight_recorder:

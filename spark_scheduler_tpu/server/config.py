@@ -143,6 +143,19 @@ class InstallConfig:
     # restarted scheduler serves its first windows without multi-second
     # compile stalls. None = per-process compiles.
     jax_compilation_cache_dir: Optional[str] = None
+    # Multi-device window-solve engine (core/solver.py): `solver.device-pool`
+    # keeps a resident cluster replica on N devices and round-robins
+    # concurrent window solves (disjoint-domain windows partition across the
+    # pool — instance groups solve in parallel); `solver.mesh` is the full
+    # {groups, node-shards} form, where node-shards > 1 additionally shards
+    # each slot's node axis over a GSPMD sub-mesh (when a single window's
+    # 10k-node solve is the bottleneck and the interconnect is fast — see
+    # README "Multi-device serving" for when sharded vs pooled wins).
+    # device-pool N is shorthand for mesh {groups: N, node-shards: 1}.
+    # 1 / unset = the classic single-device serving path.
+    solver_device_pool: int = 1
+    solver_mesh_groups: Optional[int] = None
+    solver_mesh_node_shards: Optional[int] = None
     # Scheduling flight recorder (observability/): every extender decision
     # appends an explainable DecisionRecord (verdict, per-node failure map,
     # FIFO queue position, padding bucket, compile-cache hit, phase wall
@@ -214,12 +227,18 @@ class InstallConfig:
         server_block = raw.get("server") or {}
         ca_files = server_block.get("client-ca-files") or []
         autoscaler_block = raw.get("autoscaler") or {}
+        solver_block = raw.get("solver") or {}
+        mesh_block = solver_block.get("mesh") or {}
+
+        def block_key(block, key, default):
+            # Present-but-null keys (`device-pool:` with no value) must
+            # read as the default, not None — same YAML idiom the
+            # autoscaler block defends against.
+            v = block.get(key)
+            return default if v is None else v
 
         def autoscaler_key(key, default):
-            # Present-but-null keys (`zones:` with no value — a common
-            # YAML idiom) must read as the default, not None.
-            v = autoscaler_block.get(key)
-            return default if v is None else v
+            return block_key(autoscaler_block, key, default)
         return cls(
             fifo=bool(raw.get("fifo", False)),
             fifo_config=fifo_cfg,
@@ -289,6 +308,18 @@ class InstallConfig:
             autoscaler_node_memory=str(autoscaler_key("node-memory", "8Gi")),
             autoscaler_node_gpu=str(autoscaler_key("node-gpu", "1")),
             autoscaler_zones=list(autoscaler_key("zones", [])),
+            solver_device_pool=int(block_key(solver_block, "device-pool", 1)),
+            solver_mesh_groups=(
+                int(v)
+                if (v := block_key(mesh_block, "groups", None)) is not None
+                else None
+            ),
+            solver_mesh_node_shards=(
+                int(v)
+                if (v := block_key(mesh_block, "node-shards", None))
+                is not None
+                else None
+            ),
             runtime_config_path=raw.get("runtime-config-path"),
             jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),
             flight_recorder=bool(raw.get("flight-recorder", True)),

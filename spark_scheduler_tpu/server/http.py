@@ -298,11 +298,23 @@ class PredicateBatcher:
 
         def head_ready() -> bool:
             t = pending[0][0]
-            return (
-                t.handle is not None
-                and t.handle.blob_future is not None
-                and t.handle.blob_future.done()
-            )
+            if t.handle is None:
+                return False
+            # WindowHandle.fetch_ready covers both the single-device eager
+            # pull and the multi-device engine's per-partition futures;
+            # fall back to the bare blob_future for handle stubs (tests).
+            ready = getattr(t.handle, "fetch_ready", None)
+            if ready is not None:
+                return ready()
+            fut = getattr(t.handle, "blob_future", None)
+            return fut is not None and fut.done()
+
+        def eager_futures(handle) -> list:
+            parts = getattr(handle, "parts", None)
+            if parts:
+                return [p.future for p in parts]
+            fut = getattr(handle, "blob_future", None)
+            return [fut] if fut is not None else []
 
         while True:
             with self._cv:
@@ -403,10 +415,10 @@ class PredicateBatcher:
                     if pending:
                         self.pipelined_windows += 1
                     pending.append((new_ticket, batch))
-                    # Wake the loop the moment this window's decision pull
-                    # lands, so its complete never waits on a cv timeout.
-                    fut = new_ticket.handle.blob_future
-                    if fut is not None:
+                    # Wake the loop the moment this window's decision pulls
+                    # land (every partition's, on the multi-device engine),
+                    # so its complete never waits on a cv timeout.
+                    for fut in eager_futures(new_ticket.handle):
                         fut.add_done_callback(lambda _f: self._notify())
             # Heads whose pull already landed complete at zero cost, and
             # the depth bound backpressures (blocking complete) when the
@@ -417,7 +429,7 @@ class PredicateBatcher:
                 complete_head()
             if not batch and pending and not self._queue:
                 head = pending[0][0]
-                if head.handle is None or head.handle.blob_future is None:
+                if head.handle is None or not eager_futures(head.handle):
                     # No in-flight pull to overlap with (no eager fetch was
                     # started): complete now, blocking fetch and all.
                     complete_head()
@@ -641,6 +653,9 @@ class SchedulerHTTPServer:
             max_window=getattr(cfg, "predicate_max_window", 32),
             hold_ms=getattr(cfg, "predicate_hold_ms", 25.0),
             registry=registry,
+            # With a device pool, keep at least pool-size windows in
+            # flight so every slot can hold work.
+            pipeline_depth=max(3, getattr(app.solver, "pool_size", 1)),
         )
         self.telemetry = TransportTelemetry(self.transport_name)
         self.routes = SchedulerRoutes(self)

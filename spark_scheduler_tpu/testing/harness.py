@@ -86,8 +86,14 @@ def _spark_pods(
     return pods
 
 
-def static_allocation_spark_pods(app_id: str, num_executors: int) -> list[Pod]:
-    """Driver + executors, 1 CPU / 1 GiB each (extender_test_utils.go:261-277)."""
+def static_allocation_spark_pods(
+    app_id: str,
+    num_executors: int,
+    instance_group: str = DEFAULT_INSTANCE_GROUP,
+) -> list[Pod]:
+    """Driver + executors, 1 CPU / 1 GiB each (extender_test_utils.go:261-277).
+    `instance_group` pins the pods' node selector to that group's nodes —
+    the multi-group topology the multi-device serving tests drive."""
     return _spark_pods(
         app_id,
         num_executors,
@@ -98,6 +104,7 @@ def static_allocation_spark_pods(app_id: str, num_executors: int) -> list[Pod]:
             EXECUTOR_MEMORY: "1Gi",
             EXECUTOR_COUNT: str(num_executors),
         },
+        instance_group=instance_group,
     )
 
 

@@ -673,6 +673,196 @@ def test_fetch_pool_is_shared_across_solvers():
     assert len(fetch_threads) <= 4, [t.name for t in fetch_threads]
 
 
+# ------------------------------------------------- multi-device engine
+
+
+def _multi_group_harness(n_groups=4, nodes_per_group=4, **kw):
+    h = Harness(binpack_algo="tightly-pack", fifo=True, **kw)
+    for g in range(n_groups):
+        h.add_nodes(
+            *[
+                new_node(
+                    f"g{g}-n{i}",
+                    zone=f"zone{i % 2}",
+                    instance_group=f"group-{g}",
+                )
+                for i in range(nodes_per_group)
+            ]
+        )
+    return h
+
+
+def _group_window_requests(rng, n_groups, nodes_per_group, n_requests):
+    """Random WindowRequests pinned to per-group domains, with FIFO-style
+    hypothetical prefix rows, in one interleaved arrival order."""
+    from spark_scheduler_tpu.core.solver import WindowRequest
+    from spark_scheduler_tpu.models.resources import Resources
+
+    reqs = []
+    for k in range(n_requests):
+        g = int(rng.integers(0, n_groups))
+        names = [f"g{g}-n{i}" for i in range(nodes_per_group)]
+        rows = []
+        for _ in range(int(rng.integers(0, 3))):  # hypothetical prefix
+            rows.append(
+                (
+                    Resources.from_quantities("1", "1Gi"),
+                    Resources.from_quantities("1", "1Gi"),
+                    int(rng.integers(1, 4)),
+                    bool(rng.random() < 0.5),
+                )
+            )
+        rows.append(
+            (
+                Resources.from_quantities("1", "1Gi"),
+                Resources.from_quantities("1", "1Gi"),
+                int(rng.integers(1, 4)),
+                False,
+            )
+        )
+        reqs.append(
+            WindowRequest(
+                rows=rows,
+                driver_candidate_names=list(names),
+                domain_node_names=list(names),
+            )
+        )
+    return reqs
+
+
+@pytest.mark.parametrize(
+    "engine_kw",
+    [
+        {"solver_device_pool": 4},  # pooled: partitioned across devices
+        {"solver_mesh_groups": 1, "solver_mesh_node_shards": 4},  # sharded
+    ],
+    ids=["device-pool", "sharded-mesh"],
+)
+def test_multi_device_window_decisions_byte_identical(engine_kw):
+    """THE engine equivalence pin: the same window stream solved through
+    the device pool (disjoint-domain partitions solving concurrently on
+    the 8-device virtual mesh) and through the GSPMD sharded mode produces
+    WindowDecisions BYTE-IDENTICAL to the single-device serving path —
+    every node name, admitted/blocked bit, and efficiency float. Two
+    overlapped windows exercise the threaded committed base + priors."""
+    decisions_by_mode = []
+    for kw in ({}, engine_kw):
+        h = _multi_group_harness(**kw)
+        solver = h.app.solver
+        nodes = h.backend.list_nodes()
+        rng = np.random.default_rng(7)
+        w1 = _group_window_requests(rng, 4, 4, 10)
+        w2 = _group_window_requests(rng, 4, 4, 10)
+        t1 = solver.build_tensors_pipelined(nodes, {}, {})
+        h1 = solver.pack_window_dispatch("tightly-pack", t1, w1)
+        # Overlap: dispatch w2 before fetching w1 (the pipelined loop).
+        t2 = solver.build_tensors_pipelined(nodes, {}, {})
+        h2 = solver.pack_window_dispatch("tightly-pack", t2, w2)
+        d1 = solver.pack_window_fetch(h1)
+        d2 = solver.pack_window_fetch(h2)
+        decisions_by_mode.append(d1 + d2)
+    single, multi = decisions_by_mode
+    assert single == multi  # NamedTuple equality: every field, bit for bit
+
+
+def test_pooled_serving_through_extender_matches_single_device():
+    """End-to-end over the extender: a mixed multi-group driver window via
+    predicate_batch lands identical outcomes, nodes, and reservation state
+    with and without the device pool (windows partition by instance
+    group), and pool-mode records attribute each decision to a slot."""
+    streams = []
+    for kw in ({}, {"solver_device_pool": 4}):
+        h = _multi_group_harness(**kw)
+        args = []
+        for g in range(4):
+            for a in range(2):
+                pod = static_allocation_spark_pods(
+                    f"mdx-{g}-{a}", 2, instance_group=f"group-{g}"
+                )[0]
+                h.add_pods(pod)
+                args.append(
+                    ExtenderArgs(
+                        pod=pod,
+                        node_names=[f"g{g}-n{i}" for i in range(4)],
+                    )
+                )
+        results = h.extender.predicate_batch(args)
+        rrs = {
+            rr.name: {k: v.node for k, v in rr.spec.reservations.items()}
+            for rr in h.backend.list("resourcereservations")
+        }
+        streams.append(
+            ([(r.outcome, tuple(r.node_names)) for r in results], rrs)
+        )
+        if kw:
+            assert h.app.solver.window_path_counts.get("pool", 0) >= 1
+            info = h.app.solver.last_solve_info
+            assert info["path"] == "pool" and info["partitions"] == 4
+            rec = h.app.recorder.query(role="driver", limit=1)[0]
+            assert rec["device_id"] and rec["device_id"].startswith("cpu:")
+            assert rec["state_upload"] in ("full", "delta", "reuse")
+    assert streams[0] == streams[1]
+
+
+def test_pool_falls_back_whole_window_on_overlapping_domains():
+    """Requests whose domains overlap (shared nodes) must NOT partition:
+    the window solves whole on one slot and decisions still match the
+    single-device path."""
+    streams = []
+    for kw in ({}, {"solver_device_pool": 2}):
+        h = Harness(binpack_algo="tightly-pack", fifo=True, **kw)
+        h.add_nodes(*[new_node(f"n{i}") for i in range(8)])
+        names = [f"n{i}" for i in range(8)]
+        args = []
+        for a in range(4):
+            pod = static_allocation_spark_pods(f"ovl-{a}", 2)[0]
+            h.add_pods(pod)
+            args.append(ExtenderArgs(pod=pod, node_names=list(names)))
+        results = h.extender.predicate_batch(args)
+        streams.append([(r.outcome, tuple(r.node_names)) for r in results])
+        if kw:
+            assert h.app.solver.last_solve_info["partitions"] == 1
+    assert streams[0] == streams[1]
+
+
+def test_donated_carry_not_reused_after_commit():
+    """Buffer donation pin: the pipelined committed base is DONATED into
+    the window solve — available_after updates it in place — so the
+    consumed carry must be marked deleted and any reuse must raise instead
+    of silently reading freed memory. The pipeline itself keeps working
+    (it threads available_after forward, never the dead input)."""
+    from spark_scheduler_tpu.core.solver import WindowRequest
+    from spark_scheduler_tpu.models.resources import Resources
+
+    h = Harness(binpack_algo="tightly-pack", fifo=False)
+    h.add_nodes(*[new_node(f"n{i}") for i in range(4)])
+    solver = h.app.solver
+    nodes = h.backend.list_nodes()
+    req = WindowRequest(
+        rows=[
+            (
+                Resources.from_quantities("1", "1Gi"),
+                Resources.from_quantities("1", "1Gi"),
+                2,
+                False,
+            )
+        ],
+        driver_candidate_names=[f"n{i}" for i in range(4)],
+    )
+    t1 = solver.build_tensors_pipelined(nodes, {}, {})
+    carry = t1.available
+    handle = solver.pack_window_dispatch("tightly-pack", t1, [req])
+    assert carry.is_deleted(), "committed-base carry was copied, not donated"
+    with pytest.raises(Exception):
+        np.asarray(carry)  # reuse of the donated carry must fail loudly
+    decisions = solver.pack_window_fetch(handle)
+    assert decisions[0].admitted
+    # The pipeline threads the in-place-updated base forward unharmed.
+    t2 = solver.build_tensors_pipelined(nodes, {}, {})
+    h2 = solver.pack_window_dispatch("tightly-pack", t2, [req])
+    assert solver.pack_window_fetch(h2)[0].admitted
+
+
 def test_solver_close_fails_fast_on_pipelined_dispatch():
     """After close(), a pipelined dispatch must raise instead of enqueuing
     a Future nobody serves (ThreadPoolExecutor-after-shutdown semantics);
