@@ -522,7 +522,15 @@ def _recorder_phase_stats(app) -> dict:
         return {}
     out = {}
     records = recorder.query(limit=recorder.capacity)
-    for phase in ("featurize_ms", "solve_ms", "commit_ms"):
+    for phase in (
+        "featurize_ms",
+        "featurize_snapshot_ms",
+        "featurize_tensors_ms",
+        "featurize_domains_ms",
+        "featurize_fifo_ms",
+        "solve_ms",
+        "commit_ms",
+    ):
         vals = [
             r["phases"][phase]
             for r in records
@@ -1320,6 +1328,169 @@ def bench_serving_http_executors(rng, transport="threaded"):
     )
 
 
+def bench_host_featurize(rng):
+    """The feature store's O(changed) claim, MEASURED: per-window host
+    featurize (feature snapshot + host tensor build) at 1k/10k/100k nodes,
+    three arms per size —
+
+      cold    a node event forced the O(nodes) roster re-walk;
+      steady  50 incremental reservation events land between windows but
+              no node churn (the serving steady state): the snapshot
+              serves the resident roster and re-copies only the dirty
+              usage aggregate;
+      legacy  the pre-feature-store per-window rebuild (full list_nodes +
+              fresh {name: node} dict + per-node overhead dict copies +
+              usage array walk + tensor build), run against the same live
+              components.
+
+    Host-only (build_tensors builds numpy tensors; no device dispatch) —
+    this is exactly the host layer the pipelined serving loop pays per
+    window. Bar (ISSUE 5): steady-state p50 at 10k nodes >= 5x faster
+    than the legacy rebuild."""
+    from spark_scheduler_tpu.models.kube import Container, Pod
+    from spark_scheduler_tpu.models.resources import Resources
+    from spark_scheduler_tpu.models.reservations import (
+        new_resource_reservation,
+    )
+    from spark_scheduler_tpu.server.app import build_scheduler_app
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.store.backend import InMemoryBackend
+    from spark_scheduler_tpu.testing.harness import (
+        INSTANCE_GROUP_LABEL,
+        new_node,
+        static_allocation_spark_pods,
+    )
+
+    for n_nodes in (1_000, 10_000, 100_000):
+        backend = InMemoryBackend()
+        names = []
+        for i in range(n_nodes):
+            node = new_node(f"hf-n{i}", zone=f"zone{i % 4}")
+            backend.add_node(node)
+            names.append(node.name)
+        # Populate the overhead aggregate (unreserved pods bound to nodes):
+        # the legacy arm's per-node dict copies must have entries to copy.
+        for i in range(0, n_nodes, 20):
+            backend.add_pod(
+                Pod(
+                    name=f"hf-ov-{i}",
+                    namespace="kube-system",
+                    node_name=names[i],
+                    scheduler_name="default-scheduler",
+                    phase="Running",
+                    containers=[
+                        Container(
+                            requests=Resources.from_quantities("100m", "64Mi")
+                        )
+                    ],
+                )
+            )
+        app = build_scheduler_app(
+            backend,
+            InstallConfig(
+                sync_writes=True, instance_group_label=INSTANCE_GROUP_LABEL
+            ),
+        )
+        solver, store = app.solver, app.extender.features
+        rrm = app.reservation_manager
+        oc = app.overhead_computer
+
+        def featurize():
+            snap = store.snapshot()
+            return solver.build_tensors(
+                snap.nodes, snap.usage, snap.overhead,
+                full_node_list=True, topo_version=snap.nodes_version,
+            )
+
+        def legacy_featurize():
+            # The old per-window rebuild, faithfully: full list + dict +
+            # per-node overhead copies + usage array + tensor build.
+            topo = backend.nodes_version
+            all_nodes = backend.list_nodes()
+            _by_name = {n.name: n for n in all_nodes}
+            usage = rrm.reserved_usage()
+            overhead = {
+                name: res.copy()
+                for name, res in oc.get_overhead(all_nodes).items()
+            }
+            return solver.build_tensors(
+                all_nodes, usage, overhead,
+                full_node_list=True, topo_version=topo,
+            )
+
+        def one_reservation_event(j):
+            # One incremental commit between windows: a small gang's
+            # reservation lands (usage-tracker scatter, O(slots)).
+            driver = static_allocation_spark_pods(f"hf-app-{n_nodes}-{j}", 2)[0]
+            rr = new_resource_reservation(
+                names[j % n_nodes],
+                [names[(j + 1) % n_nodes], names[(j + 2) % n_nodes]],
+                driver,
+                Resources.from_quantities("1", "1Gi"),
+                Resources.from_quantities("1", "1Gi"),
+            )
+            app.rr_cache.create(rr)
+
+        reps = 20 if n_nodes <= 10_000 else 8
+        featurize()  # warm: arena sync + registry interning + first copies
+
+        steady_ms = []
+        for j in range(reps + 50):
+            one_reservation_event(j)
+            t0 = time.perf_counter()
+            featurize()
+            dt = (time.perf_counter() - t0) * 1e3
+            if j >= 50:  # the ISSUE's 50 incremental events are warm-up
+                steady_ms.append(dt)
+
+        cold_ms = []
+        for j in range(min(reps, 8)):
+            node = backend.get_node(names[j])
+            backend.update("nodes", node)  # node event: roster goes dirty
+            t0 = time.perf_counter()
+            featurize()
+            cold_ms.append((time.perf_counter() - t0) * 1e3)
+
+        legacy_ms = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            legacy_featurize()
+            legacy_ms.append((time.perf_counter() - t0) * 1e3)
+
+        steady = float(np.percentile(steady_ms, 50))
+        cold = float(np.percentile(cold_ms, 50))
+        legacy = float(np.percentile(legacy_ms, 50))
+        speedup = legacy / steady if steady > 0 else float("inf")
+        label = f"{n_nodes // 1000}k"
+        entry = {
+            "metric": f"host_featurize_steady_p50_ms_{label}_nodes",
+            "value": round(steady, 4),
+            "unit": "ms",
+            # At 10k nodes (the bar's scale): speedup/5 — >= 1.0 clears
+            # the "steady-state featurize >= 5x over the per-window
+            # rebuild" acceptance bar. Other sizes report the raw speedup.
+            "vs_baseline": round(
+                speedup / 5.0 if n_nodes == 10_000 else speedup, 2
+            ),
+            "detail": {
+                "nodes": n_nodes,
+                "steady_p50_ms": round(steady, 4),
+                "cold_p50_ms": round(cold, 4),
+                "legacy_rebuild_p50_ms": round(legacy, 4),
+                "speedup_vs_legacy_rebuild": round(speedup, 2),
+                "events_between_windows": 1,
+                "store": store.stats(),
+                "path": (
+                    "feature snapshot + host tensor build, no device "
+                    "dispatch"
+                ),
+            },
+        }
+        _RESULTS.append(entry)
+        print(json.dumps(entry), flush=True)
+        app.stop()
+
+
 def bench_serving_inprocess(rng):
     """VERDICT r4 #7: the 'locally-attached accelerator pays the few-ms
     solve' claim as a measured number instead of prose. Runs the serving
@@ -1758,6 +1929,10 @@ def main() -> None:
     guarded("config3", bench_config3, rng)
     guarded("config4", bench_config4, rng)
     guarded("config6", bench_config6_beyond_baseline, rng)
+    # Host featurize (feature store O(changed) evidence): host-only, so it
+    # runs with the cheap kernel configs before the serving benches heat
+    # the box.
+    guarded("host_featurize", bench_host_featurize, rng)
     # North-star MEASUREMENT here — after the small kernel configs (whose
     # short chains are the jitter-sensitive ones: config1 measured 1.5 ms
     # quiet vs 4.7 ms after a config5 measurement) but BEFORE the serving

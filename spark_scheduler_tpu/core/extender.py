@@ -21,6 +21,8 @@ from typing import NamedTuple, Optional, Sequence
 from spark_scheduler_tpu.models.kube import Pod
 from spark_scheduler_tpu.core.binpacker import Binpacker
 from spark_scheduler_tpu.core.demands import DemandManager
+from spark_scheduler_tpu.core.feature_store import HostFeatureStore
+from spark_scheduler_tpu.core.lru import LRUCache
 from spark_scheduler_tpu.core.overhead import OverheadComputer
 from spark_scheduler_tpu.core.reservation_manager import (
     ReservationError,
@@ -114,7 +116,7 @@ class WindowTicket:
     __slots__ = (
         "args_list", "results", "roles", "timer_start", "window", "handle",
         "all_nodes", "by_name", "domains", "inflight_keys", "sync", "done",
-        "epoch", "featurize_ms", "solve_started",
+        "epoch", "featurize_ms", "featurize_phases", "solve_started",
     )
 
     def __init__(self, args_list):
@@ -135,9 +137,11 @@ class WindowTicket:
         # decisions are stale and the complete phase re-solves serially.
         self.epoch = -1
         # Flight-recorder phase anchors: host featurize cost of the window
-        # dispatch, and the wall time the device solve started (the
+        # dispatch (with its sub-phase breakdown: snapshot / tensors /
+        # domains / fifo), and the wall time the device solve started (the
         # complete phase's fetch closes the solve interval).
         self.featurize_ms = 0.0
+        self.featurize_phases: dict[str, float] = {}
         self.solve_started = 0.0
 
 
@@ -187,8 +191,17 @@ class SparkSchedulerExtender:
         # signature) -> (backend nodes_version, matching node names). The
         # O(nodes) pod_matches_node walk was a measured per-window hotspot
         # at 10k nodes even though serving workloads reuse a handful of
-        # selector shapes; invalidated by the node-mutation counter.
-        self._domain_cache: dict[tuple, tuple[int, list[str]]] = {}
+        # selector shapes; invalidated by the node-mutation counter, and
+        # LRU-evicting so a 65th live signature keeps the 64 hottest
+        # instead of wiping them all.
+        self._domain_cache: LRUCache = LRUCache(64)
+        # Event-sourced host feature store: the single featurize read of
+        # every serving path (roster + by-name map + dense usage/overhead,
+        # all epoch-versioned, O(changed) per window). Owns the
+        # capture-before-list node versioning dance.
+        self.features = HostFeatureStore(
+            backend, solver.registry, overhead_computer, reservation_manager
+        )
         # Bumped by every SOLO-path admission that changes capacity (a solo
         # driver's reservations, an executor reschedule / soft
         # reservation). Windows dispatched before such a change re-solve at
@@ -197,19 +210,6 @@ class SparkSchedulerExtender:
         # order.
         self._capacity_epoch = 0
 
-
-    def _list_nodes_versioned(self):
-        """(all_nodes, topo_version|None) — THE capture-before-list +
-        recheck-after dance every versioned cache rests on: the version is
-        read before the list and re-validated after, so a concurrent node
-        mutation can only make the version look stale (extra walk / cache
-        miss), never fresh over an unsynced list. Single owner; do not
-        inline at call sites."""
-        topo = getattr(self._backend, "nodes_version", None)
-        all_nodes = self._backend.list_nodes()
-        if topo != getattr(self._backend, "nodes_version", None):
-            topo = None  # raced a node mutation: treat as unversioned
-        return all_nodes, topo
 
     # ------------------------------------------------------------------ API
 
@@ -427,22 +427,30 @@ class SparkSchedulerExtender:
         # only raise site (PipelineDrainRequired), and raising before any
         # outcome is marked lets the serving loop retry the whole dispatch
         # without double-counting metrics or waste attempts.
-        # Topology version BEFORE the node snapshot (capture-before-list):
-        # a concurrent mutation then makes the version look stale (extra
-        # walk / cache miss, safe), never fresh over an unsynced list.
+        # ONE feature-store snapshot replaces the per-window list_nodes +
+        # name->node dict + overhead dict + usage copy of the old path:
+        # steady state it returns the resident epoch-versioned arrays
+        # (O(changed), usually O(1)); the capture-before-list versioning
+        # dance lives inside the store.
         featurize_start = self._clock()
-        all_nodes, topo = self._list_nodes_versioned()
+        snap = self.features.snapshot()
+        phases = t.featurize_phases
+        t_snap = self._clock()
+        phases["featurize_snapshot_ms"] = (t_snap - featurize_start) * 1e3
+        all_nodes, topo = snap.nodes, snap.nodes_version
         t.all_nodes = all_nodes
-        by_name = t.by_name = {n.name: n for n in all_nodes}
-        usage = self._rrm.reserved_usage()
-        overhead = self._overhead.get_overhead(all_nodes)
+        by_name = t.by_name = snap.by_name
         # Device-resident state threaded ACROSS windows: the previous
         # window's committed base (still on device) plus additive external
         # deltas — what makes dispatch-before-fetch pipelining exact
-        # (solver.build_tensors_pipelined).
+        # (solver.build_tensors_pipelined). The statics epoch lets the
+        # builder skip its per-window static-field array compares.
         tensors = self._solver.build_tensors_pipelined(
-            all_nodes, usage, overhead, topo_version=topo
+            all_nodes, snap.usage, snap.overhead,
+            topo_version=topo, statics_version=snap.statics_epoch,
         )
+        t_tensors = self._clock()
+        phases["featurize_tensors_ms"] = (t_tensors - t_snap) * 1e3
 
         args_list, results, timer_start = t.args_list, t.results, t.timer_start
         window = t.window
@@ -520,10 +528,10 @@ class SparkSchedulerExtender:
                         ]
                         domain_by_sig[sig] = names
                         if topo is not None:
-                            if len(self._domain_cache) >= 64:
-                                self._domain_cache.clear()
-                            self._domain_cache[sig] = (topo, names)
+                            self._domain_cache.put(sig, (topo, names))
             domains[i] = domain_by_sig[sig]
+        t_domains = self._clock()
+        phases["featurize_domains_ms"] = (t_domains - t_tensors) * 1e3
         # FIFO predecessor rows: one backend scan + one annotation parse per
         # pending driver for the WHOLE window (each request then filters the
         # shared snapshot, sparkpods.go:51-77 semantics unchanged).
@@ -580,7 +588,12 @@ class SparkSchedulerExtender:
                 )
             )
 
-        t.featurize_ms = (self._clock() - featurize_start) * 1e3
+        now = self._clock()
+        phases["featurize_fifo_ms"] = (now - t_domains) * 1e3
+        t.featurize_ms = (now - featurize_start) * 1e3
+        tel = self._solver.telemetry
+        if tel is not None:
+            tel.on_featurize(phases, self.features)
         t.solve_started = self._clock()
         t.handle = self._solver.pack_window_dispatch(
             self.binpacker.name, tensors, requests
@@ -610,37 +623,49 @@ class SparkSchedulerExtender:
         requests = t.handle.requests
         window, results, timer_start = t.window, t.results, t.timer_start
         all_nodes, by_name, domains = t.all_nodes, t.by_name, t.domains
+        commit_t0 = self._clock()
+
+        def record(k, pod, args, outcome, node, msg=""):
+            self._record_decision(
+                pod, ROLE_DRIVER, outcome, node, args.node_names, msg,
+                ctx={
+                    "featurize_ms": t.featurize_ms,
+                    **t.featurize_phases,
+                    "solve_ms": solve_ms,
+                    # The window-coalesced commit: classification + ONE
+                    # batched reservation write-back, measured from the
+                    # decisions landing on host to this record.
+                    "commit_ms": (self._clock() - commit_t0) * 1e3,
+                    # None when FIFO is off (rows then carries only
+                    # the request's own app — 0 would misread as
+                    # "first in queue").
+                    "queue_position": (
+                        len(requests[k].rows) - 1
+                        if self._config.fifo
+                        else None
+                    ),
+                    "solve_info": dispatch_info,
+                    # Multi-device engine: the pool slot whose
+                    # partition solved THIS request (None on the
+                    # single-device path).
+                    "device_id": (
+                        t.handle.request_device[k]
+                        if t.handle.request_device is not None
+                        else None
+                    ),
+                },
+            )
+
+        # Pass 1 — classify: denials finalize immediately (demand +
+        # record + failure response); admitted gangs queue for ONE
+        # coalesced reservation write-back below instead of a cache
+        # write + listener fan-out per decision.
+        admitted: list[tuple] = []  # (k, i, pod, res, args, packing)
         for k, (i, pod, res, args) in enumerate(window):
             d = decisions[k]
-            commit_start = self._clock()
-
-            def record(outcome, node, msg=""):
-                self._record_decision(
-                    pod, ROLE_DRIVER, outcome, node, args.node_names, msg,
-                    ctx={
-                        "featurize_ms": t.featurize_ms,
-                        "solve_ms": solve_ms,
-                        "commit_ms": (self._clock() - commit_start) * 1e3,
-                        # None when FIFO is off (rows then carries only
-                        # the request's own app — 0 would misread as
-                        # "first in queue").
-                        "queue_position": (
-                            len(requests[k].rows) - 1
-                            if self._config.fifo
-                            else None
-                        ),
-                        "solve_info": dispatch_info,
-                        # Multi-device engine: the pool slot whose
-                        # partition solved THIS request (None on the
-                        # single-device path).
-                        "device_id": (
-                            t.handle.request_device[k]
-                            if t.handle.request_device is not None
-                            else None
-                        ),
-                    },
-                )
-
+            if d.admitted:
+                admitted.append((k, i, pod, res, args, d.packing))
+                continue
             # Per-request trace span over the decision apply, same
             # name/tags as the solo path's — dashboards keyed on
             # select-node cover windowed serving too.
@@ -648,24 +673,40 @@ class SparkSchedulerExtender:
                 "select-node", role=ROLE_DRIVER,
                 pod=f"{pod.namespace}/{pod.name}",
             ) as sp:
-                if not d.admitted:
-                    self._demands.create_demand_for_application(pod, res)
-                    if d.earlier_blocked:
-                        outcome, msg = (
-                            FAILURE_EARLIER_DRIVER,
-                            "earlier drivers do not fit to the cluster",
-                        )
-                    else:
-                        outcome, msg = (
-                            FAILURE_FIT,
-                            "application does not fit to the cluster",
-                        )
-                    sp.tag("outcome", outcome)
-                    self._mark_outcome(pod, ROLE_DRIVER, outcome, timer_start)
-                    record(outcome, None, msg)
-                    results[i] = self._fail(args, outcome, msg)
-                    continue
-                packing = d.packing
+                self._demands.create_demand_for_application(pod, res)
+                if d.earlier_blocked:
+                    outcome, msg = (
+                        FAILURE_EARLIER_DRIVER,
+                        "earlier drivers do not fit to the cluster",
+                    )
+                else:
+                    outcome, msg = (
+                        FAILURE_FIT,
+                        "application does not fit to the cluster",
+                    )
+                sp.tag("outcome", outcome)
+                self._mark_outcome(pod, ROLE_DRIVER, outcome, timer_start)
+                record(k, pod, args, outcome, None, msg)
+                results[i] = self._fail(args, outcome, msg)
+
+        # One batched reservation write-back for the whole window: one
+        # write-mutex hold, one batched usage-tracker/overhead delta
+        # application, one (deferred) queue drain — instead of the full
+        # chain per admitted gang. Per-entry failures surface exactly as
+        # the serial create's ReservationError did.
+        errors = self._rrm.create_reservations_batch(
+            [
+                (pod, res, packing.driver_node, packing.executor_nodes)
+                for _k, _i, pod, res, _args, packing in admitted
+            ]
+        )
+
+        # Pass 2 — finalize admitted gangs against the batch outcome.
+        for (k, i, pod, res, args, packing), err in zip(admitted, errors):
+            with tracer().span(
+                "select-node", role=ROLE_DRIVER,
+                pod=f"{pod.namespace}/{pod.name}",
+            ) as sp:
                 if self._metrics is not None:
                     self._metrics.report_packing_efficiency(
                         self.binpacker.name, packing
@@ -678,11 +719,7 @@ class SparkSchedulerExtender:
                         else [by_name[nm] for nm in domains[i]],
                     )
                 self._demands.delete_demand_if_exists(pod)
-                try:
-                    self._rrm.create_reservations(
-                        pod, res, packing.driver_node, packing.executor_nodes
-                    )
-                except ReservationError as exc:
+                if err is not None:
                     # No rollback of the window's committed base: later
                     # window decisions stand even though this app holds
                     # nothing. That is the reference's own durability
@@ -694,38 +731,40 @@ class SparkSchedulerExtender:
                     self._mark_outcome(
                         pod, ROLE_DRIVER, FAILURE_INTERNAL, timer_start
                     )
-                    record(FAILURE_INTERNAL, None, str(exc))
-                    results[i] = self._fail(args, FAILURE_INTERNAL, str(exc))
+                    record(k, pod, args, FAILURE_INTERNAL, None, str(err))
+                    results[i] = self._fail(args, FAILURE_INTERNAL, str(err))
                     continue
                 if self._events is not None:
                     self._events.emit_application_scheduled(pod, res)
                 sp.tag("outcome", SUCCESS)
                 self._mark_outcome(pod, ROLE_DRIVER, SUCCESS, timer_start)
-                record(SUCCESS, packing.driver_node)
+                record(k, pod, args, SUCCESS, packing.driver_node)
                 results[i] = ExtenderFilterResult(
                     node_names=[packing.driver_node],
                     failed_nodes={},
                     outcome=SUCCESS,
                 )
 
-    def _build_serving_tensors(self, all_nodes, usage, overhead, topo=None):
-        """Device tensors for the SOLO serving paths, shared with the
-        pipelined window cache: one device-resident copy of cluster state,
-        and solo solves see the gangs of still-in-flight windows (the
-        threaded base) instead of a stale host-only view. If topology
-        changed while windows are in flight, fall back to an uncached
-        host-truth build for this one solve. `topo` is the backend node
-        version captured before `all_nodes` was listed."""
+    def _build_serving_tensors(self, snap):
+        """Device tensors for the SOLO serving paths from a feature-store
+        snapshot, shared with the pipelined window cache: one
+        device-resident copy of cluster state, and solo solves see the
+        gangs of still-in-flight windows (the threaded base) instead of a
+        stale host-only view. If topology changed while windows are in
+        flight, fall back to an uncached host-truth build for this one
+        solve."""
         from spark_scheduler_tpu.core.solver import PipelineDrainRequired
 
         try:
             return self._solver.build_tensors_pipelined(
-                all_nodes, usage, overhead, topo_version=topo
+                snap.nodes, snap.usage, snap.overhead,
+                topo_version=snap.nodes_version,
+                statics_version=snap.statics_epoch,
             )
         except PipelineDrainRequired:
             return self._solver.build_tensors(
-                all_nodes, usage, overhead,
-                full_node_list=True, topo_version=topo,
+                snap.nodes, snap.usage, snap.overhead,
+                full_node_list=True, topo_version=snap.nodes_version,
             )
 
     def _mark_outcome(self, pod, role, outcome, timer_start) -> None:
@@ -772,9 +811,10 @@ class SparkSchedulerExtender:
             failed_nodes=failed_nodes,
             queue_position=ctx.get("queue_position"),
             phases={
-                k: ctx[k]
-                for k in ("featurize_ms", "solve_ms", "commit_ms")
-                if k in ctx
+                k: v
+                for k, v in ctx.items()
+                if k in ("featurize_ms", "solve_ms", "commit_ms")
+                or k.startswith("featurize_")
             },
             solve=solve_info,
             device_id=ctx.get("device_id"),
@@ -837,9 +877,9 @@ class SparkSchedulerExtender:
             # absent from the candidate list (resource.go:273-286).
             return rr.spec.reservations[DRIVER_RESERVATION].node, SUCCESS, ""
 
-        all_nodes, topo = self._list_nodes_versioned()
+        snap = self.features.snapshot()
+        all_nodes = snap.nodes
         available_nodes = [n for n in all_nodes if pod_matches_node(driver, n)]
-        usage = self._rrm.reserved_usage()
 
         try:
             app_resources = spark_resources(driver)
@@ -864,10 +904,7 @@ class SparkSchedulerExtender:
             # for how this can differ from the sequential fallback). Cluster
             # state is device-resident: full node list + delta upload,
             # affinity filtering via the domain mask (VERDICT r2 #3).
-            overhead = self._overhead.get_overhead(all_nodes)
-            tensors = self._build_serving_tensors(
-                all_nodes, usage, overhead, topo
-            )
+            tensors = self._build_serving_tensors(snap)
             domain = self._solver.candidate_mask(
                 tensors, [n.name for n in available_nodes]
             )
@@ -884,7 +921,9 @@ class SparkSchedulerExtender:
         else:
             # Sequential fallback (batching disabled by config).
             overhead = self._overhead.get_overhead(available_nodes)
-            tensors = self._solver.build_tensors(available_nodes, usage, overhead)
+            tensors = self._solver.build_tensors(
+                available_nodes, snap.usage, overhead
+            )
             s0 = self._clock()
             ctx["featurize_ms"] = (s0 - t0) * 1e3
             if earlier:
@@ -1161,12 +1200,7 @@ class SparkSchedulerExtender:
         if stragglers:
             from spark_scheduler_tpu.models.resources import Resources as _R
 
-            all_nodes, topo = self._list_nodes_versioned()
-            usage = self._rrm.reserved_usage()
-            overhead = self._overhead.get_overhead(all_nodes)
-            tensors = self._build_serving_tensors(
-                all_nodes, usage, overhead, topo
-            )
+            tensors = self._build_serving_tensors(self.features.snapshot())
             decisions = self._solver.pack_window(
                 "tightly-pack",
                 tensors,
@@ -1382,12 +1416,7 @@ class SparkSchedulerExtender:
         if single_az_zone is not None:
             nodes = [n for n in nodes if n.zone == single_az_zone]
 
-        usage = self._rrm.reserved_usage()
-        all_nodes, topo = self._list_nodes_versioned()
-        overhead = self._overhead.get_overhead(all_nodes)
-        tensors = self._build_serving_tensors(
-            all_nodes, usage, overhead, topo
-        )
+        tensors = self._build_serving_tensors(self.features.snapshot())
         domain = self._solver.candidate_mask(tensors, [n.name for n in nodes])
         # A 1-executor gang with no driver = "first sorted node with room".
         packing = self._solver.pack(

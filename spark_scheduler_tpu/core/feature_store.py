@@ -1,0 +1,207 @@
+"""HostFeatureStore — the event-sourced host side of per-window featurize.
+
+Before this store, every serving window re-derived its host features from
+scratch: a full `backend.list_nodes()` snapshot, a fresh `{name: node}`
+dict, an `OverheadComputer.get_overhead` dict walk with a copy per node,
+and a `reserved_usage()` array copy — O(nodes) Python per decision window
+even when nothing changed between windows. That is the per-request
+state-rebuild anti-pattern the shared-state schedulers (Omega, Firmament)
+warn against: scheduler state should stay resident and absorb deltas.
+
+The store keeps every host feature RESIDENT and epoch-versioned:
+
+  nodes / by_name   the node roster (tuple + name->Node map), refreshed
+                    from the backend only when the backend's node-mutation
+                    counter moved (the capture-before-list versioning dance
+                    lives HERE now, its single owner);
+  usage             dense int64 [cap, 3] reservation usage over the
+                    solver's NodeRegistry index space, re-copied from the
+                    ReservedUsageTracker only when its version moved;
+  overhead          dense int64 [cap, 3] schedulable overhead, maintained
+                    incrementally by OverheadComputer's dense mirror and
+                    re-copied only when its version moved.
+
+`snapshot()` is the serving window's single featurize read: when nothing
+changed since the previous window it returns the SAME immutable arrays and
+tuples (zero work, zero copies); when k rows changed it costs one
+vectorized copy of the changed aggregate; only a node add/update/delete
+pays the O(nodes) roster walk — i.e. per-window featurize is
+O(window + dirty state), not O(nodes).
+
+`statics_epoch` bumps exactly when the roster was re-walked; the solver's
+pipelined builder keys its static-field equality check on it, skipping the
+eight per-window O(nodes) array compares when no node event occurred.
+
+Thread-safety: snapshots are built under the store lock against
+version-consistent copies, so informer/listener threads mutating the
+underlying aggregates can never tear a snapshot already handed out.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping, NamedTuple, Optional
+
+import numpy as np
+
+from spark_scheduler_tpu.models.resources import NUM_DIMS
+
+
+class FeatureSnapshot(NamedTuple):
+    """One window's host-feature view. Arrays are frozen (writeable=False)
+    and shared across snapshots until the underlying state changes — treat
+    everything here as read-only."""
+
+    epoch: int  # bumps on ANY tracked change
+    statics_epoch: int  # bumps only on roster (node) changes
+    nodes_version: Optional[int]  # backend nodes_version; None if racing
+    nodes: tuple  # full node roster
+    by_name: Mapping[str, Any]  # name -> Node over the same roster
+    usage: Any  # dense int64 [cap,3] (or {node: Resources} w/o tracker)
+    overhead: np.ndarray  # dense int64 [cap,3]
+
+
+class HostFeatureStore:
+    def __init__(self, backend, registry, overhead_computer, reservation_manager):
+        self._backend = backend
+        self._registry = registry
+        self._overhead = overhead_computer
+        self._rrm = reservation_manager
+        self._lock = threading.Lock()
+        self._nodes: tuple = ()
+        self._by_name: dict[str, Any] = {}
+        self._roster_topo: Optional[int] = None
+        self._roster_dirty = True
+        self._statics_epoch = 0
+        self._epoch = 0
+        self._usage: Optional[np.ndarray] = None
+        self._usage_version: Optional[int] = None
+        self._overhead_arr = np.zeros((1, NUM_DIMS), np.int64)
+        self._overhead_arr.flags.writeable = False
+        self._overhead_version: Optional[int] = None
+        # Live-roster row mask over the registry index space: the overhead
+        # copy zeroes non-live rows so the dense view equals the legacy
+        # get_overhead(all_nodes) dict exactly (a deleted node whose pods
+        # still exist keeps aggregate rows that the dict never surfaced).
+        self._roster_mask: Optional[np.ndarray] = None
+        # Instrumentation — the O(changed) claim as counters, consumed by
+        # the tier-1 budget test and the featurize telemetry gauges.
+        self.snapshots = 0
+        self.roster_rebuilds = 0
+        self.usage_refreshes = 0
+        self.overhead_refreshes = 0
+        overhead_computer.attach_registry(registry)
+        # Node events only mark the roster dirty (O(1)); the next snapshot
+        # pays the single re-list for the whole burst.
+        backend.subscribe(
+            "nodes",
+            on_add=self._on_node_event,
+            on_update=lambda old, new: self._on_node_event(new),
+            on_delete=self._on_node_event,
+        )
+
+    # -- events ---------------------------------------------------------------
+
+    def _on_node_event(self, *_args) -> None:
+        with self._lock:
+            self._roster_dirty = True
+
+    # -- snapshot -------------------------------------------------------------
+
+    def snapshot(self) -> FeatureSnapshot:
+        with self._lock:
+            self.snapshots += 1
+            self._refresh_roster()
+            usage = self._refresh_usage()
+            self._refresh_overhead()
+            return FeatureSnapshot(
+                epoch=self._epoch,
+                statics_epoch=self._statics_epoch,
+                nodes_version=self._roster_topo,
+                nodes=self._nodes,
+                by_name=self._by_name,
+                usage=usage,
+                overhead=self._overhead_arr,
+            )
+
+    def _refresh_roster(self) -> None:
+        """Re-list the roster only when a node event (or an unobserved
+        backend version move) says it drifted. Version captured BEFORE the
+        list and re-checked after — a concurrent mutation can only make the
+        roster look stale (one extra walk next snapshot), never fresh over
+        an unsynced list. This is the single owner of that dance; the
+        extender's per-window copy of it is gone."""
+        topo = getattr(self._backend, "nodes_version", None)
+        if not (
+            self._roster_dirty or topo is None or topo != self._roster_topo
+        ):
+            return
+        nodes = self._backend.list_nodes()
+        topo_after = getattr(self._backend, "nodes_version", None)
+        self._nodes = tuple(nodes)
+        self._by_name = {n.name: n for n in nodes}
+        raced = topo is None or topo != topo_after
+        self._roster_topo = None if raced else topo
+        self._roster_dirty = raced
+        # Rebuild the live-row mask (we are already on the O(nodes) path)
+        # and force the overhead copy to re-mask against it.
+        intern = self._registry.intern
+        idx = [intern(n.name) for n in nodes]
+        mask = np.zeros(max(self._registry.capacity, 1), dtype=bool)
+        mask[idx] = True
+        self._roster_mask = mask
+        self._overhead_version = None
+        self._statics_epoch += 1
+        self._epoch += 1
+        self.roster_rebuilds += 1
+
+    def _refresh_usage(self):
+        tracker = self._rrm.usage_tracker
+        if tracker is None:
+            # No tracker attached (legacy wiring): the map fallback has no
+            # version to key on, so every snapshot is a fresh walk.
+            self._epoch += 1
+            return self._rrm.reserved_usage()
+        version = tracker.version
+        if self._usage is None or version != self._usage_version:
+            arr = tracker.array()
+            arr.flags.writeable = False
+            self._usage = arr
+            self._usage_version = version
+            self._epoch += 1
+            self.usage_refreshes += 1
+        return self._usage
+
+    def _refresh_overhead(self) -> None:
+        version, arr = self._overhead.overhead_snapshot(self._overhead_version)
+        if arr is not None:  # None = unchanged since our cached copy
+            mask = self._roster_mask
+            if mask is not None:
+                rows = min(arr.shape[0], mask.shape[0])
+                arr[:rows][~mask[:rows]] = 0
+                arr[rows:] = 0  # interned-after-roster rows are not live
+            arr.flags.writeable = False
+            self._overhead_arr = arr
+            self._overhead_version = version
+            self._epoch += 1
+            # Overhead feeds `schedulable = allocatable - overhead`, a
+            # STATIC field of the cluster tensors: an overhead change must
+            # invalidate the solver's statics-epoch skip (back to the
+            # array compare, which sees the schedulable drift and forces
+            # the full re-upload) or the device would score efficiencies
+            # against a stale schedulable tensor.
+            self._statics_epoch += 1
+            self.overhead_refreshes += 1
+
+    # -- introspection --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "snapshots": self.snapshots,
+                "roster_rebuilds": self.roster_rebuilds,
+                "usage_refreshes": self.usage_refreshes,
+                "overhead_refreshes": self.overhead_refreshes,
+                "nodes": len(self._nodes),
+                "statics_epoch": self._statics_epoch,
+            }

@@ -34,9 +34,16 @@ from __future__ import annotations
 
 import threading
 
+import numpy as np
+
 from spark_scheduler_tpu.models.kube import Pod
-from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.models.resources import (
+    NUM_DIMS,
+    FrozenResources,
+    Resources,
+)
 from spark_scheduler_tpu.core.sparkpods import SPARK_SCHEDULER_NAME
+from spark_scheduler_tpu.store.cache import BatchableListener
 
 
 class _PodState:
@@ -58,6 +65,21 @@ class OverheadComputer:
         self._by_name: dict[str, set[tuple[str, str]]] = {}  # name -> keys
         self._overhead: dict[str, Resources] = {}
         self._nonsched: dict[str, Resources] = {}
+        # Frozen per-node views handed out by the query methods, memoized
+        # until that node's aggregate next changes — the old
+        # copy-every-Resources-under-the-lock walk was a measured per-call
+        # cost at 10k nodes, and no caller ever mutated the copies.
+        self._frozen: dict[int, dict[str, FrozenResources]] = {
+            id(self._overhead): {},
+            id(self._nonsched): {},
+        }
+        # Optional dense [cap, 3] int64 mirror of the schedulable-overhead
+        # aggregate over a NodeRegistry's index space (attach_registry) —
+        # the HostFeatureStore's zero-walk feed. `overhead_version` bumps on
+        # every applied overhead delta so snapshots can key on it.
+        self._registry = None
+        self._dense: np.ndarray | None = None
+        self.overhead_version = 0
         # Instrumentation: per-event membership recomputes (delta evidence).
         self.recomputes = 0
         backend.subscribe(
@@ -67,8 +89,12 @@ class OverheadComputer:
             on_delete=self._on_pod_delete,
         )
         # Reservation-membership feeds: an app's RR or soft reservations
-        # changing flips its pods between overhead and reserved.
-        reservation_manager.rr_cache.add_mutation_listener(self._on_rr_mutation)
+        # changing flips its pods between overhead and reserved. Batch-aware
+        # so a serving window's coalesced reservation write-back recomputes
+        # under one lock hold.
+        reservation_manager.rr_cache.add_mutation_listener(
+            BatchableListener(self._on_rr_mutation, self._on_rr_mutation_batch)
+        )
         if hasattr(reservation_manager.soft_store, "add_membership_listener"):
             reservation_manager.soft_store.add_membership_listener(
                 self._on_soft_membership
@@ -92,16 +118,33 @@ class OverheadComputer:
     def _on_pod_delete(self, pod: Pod) -> None:
         self._recompute(pod.namespace, pod.name)
 
-    def _on_rr_mutation(self, old, new) -> None:
-        """An app's RR changed: only pods whose Status.Pods membership
-        actually flipped can change overhead membership, so recompute the
-        symmetric difference (one pod per executor bind), not the union —
-        a union walk would make binding executor k of an n-gang O(k·n) and
-        the whole gang O(n³) via pod_has_reservation's slot scan."""
+    @staticmethod
+    def _rr_flipped_pods(old, new) -> set[tuple[str, str]]:
+        """Pods whose Status.Pods membership actually flipped: only those
+        can change overhead membership, so recompute the symmetric
+        difference (one pod per executor bind), not the union — a union
+        walk would make binding executor k of an n-gang O(k·n) and the
+        whole gang O(n³) via pod_has_reservation's slot scan."""
         old_pods = set((old.namespace, p) for p in old.status.pods.values()) if old else set()
         new_pods = set((new.namespace, p) for p in new.status.pods.values()) if new else set()
-        for ns, name in old_pods.symmetric_difference(new_pods):
+        return old_pods.symmetric_difference(new_pods)
+
+    def _on_rr_mutation(self, old, new) -> None:
+        for ns, name in self._rr_flipped_pods(old, new):
             self._recompute(ns, name)
+
+    def _on_rr_mutation_batch(self, pairs) -> None:
+        """A whole serving window's reservation commits as one batched
+        membership update: union of per-pair flips, recomputed under a
+        single (reentrant) lock hold."""
+        flipped: set[tuple[str, str]] = set()
+        for old, new in pairs:
+            flipped |= self._rr_flipped_pods(old, new)
+        if not flipped:
+            return
+        with self._lock:
+            for ns, name in flipped:
+                self._recompute(ns, name)
 
     def _on_soft_membership(self, app_id: str, pod_name: str) -> None:
         """A soft reservation was added/removed for an executor. Namespace is
@@ -151,35 +194,92 @@ class OverheadComputer:
             self._pods[key] = state
             self._by_name.setdefault(name, set()).add(key)
 
-    @staticmethod
-    def _add(agg: dict[str, Resources], node: str, res: Resources) -> None:
+    def _add(self, agg: dict[str, Resources], node: str, res: Resources) -> None:
         agg.setdefault(node, Resources.zero()).add(res)
+        self._on_agg_delta(agg, node, res, +1)
 
-    @staticmethod
-    def _sub(agg: dict[str, Resources], node: str, res: Resources) -> None:
+    def _sub(self, agg: dict[str, Resources], node: str, res: Resources) -> None:
         cur = agg.get(node)
         if cur is not None:
             cur.sub(res)
             if cur.is_zero():
                 del agg[node]
+            self._on_agg_delta(agg, node, res, -1)
+
+    def _on_agg_delta(self, agg, node: str, res: Resources, sign: int) -> None:
+        """One applied aggregate delta (caller holds the lock): invalidate
+        the node's frozen view and scatter into the dense mirror."""
+        self._frozen[id(agg)].pop(node, None)
+        if agg is self._overhead:
+            self.overhead_version += 1
+            if self._dense is not None:
+                idx = self._registry.intern(node)
+                if idx >= self._dense.shape[0]:
+                    grow = max(idx + 1, self._dense.shape[0] * 2, 8)
+                    self._dense = np.pad(
+                        self._dense, ((0, grow - self._dense.shape[0]), (0, 0))
+                    )
+                self._dense[idx] += sign * res.as_array().astype(np.int64)
+
+    # -- dense feed (HostFeatureStore) ---------------------------------------
+
+    def attach_registry(self, registry) -> None:
+        """Start maintaining the dense [cap, 3] int64 overhead mirror over
+        `registry`'s node-index space. Idempotent; rebuilt from the current
+        aggregate on (re)attach."""
+        with self._lock:
+            if self._registry is registry and self._dense is not None:
+                return
+            self._registry = registry
+            dense = np.zeros((max(registry.capacity, 1), NUM_DIMS), np.int64)
+            for node, res in self._overhead.items():
+                idx = registry.intern(node)
+                if idx >= dense.shape[0]:
+                    dense = np.pad(dense, ((0, idx + 1 - dense.shape[0]), (0, 0)))
+                dense[idx] += res.as_array().astype(np.int64)
+            self._dense = dense
+            self.overhead_version += 1
+
+    def overhead_snapshot(self, last_version: int | None = None):
+        """(version, dense copy | None): None when nothing changed since
+        `last_version` — the consistent-copy half of the feature store's
+        zero-copy snapshot protocol. Requires attach_registry."""
+        with self._lock:
+            if self._dense is None:
+                raise RuntimeError("attach_registry() before overhead_snapshot()")
+            if last_version is not None and last_version == self.overhead_version:
+                return self.overhead_version, None
+            return self.overhead_version, self._dense.copy()
 
     # -- queries -------------------------------------------------------------
 
+    def _frozen_views(
+        self, agg: dict[str, Resources], nodes
+    ) -> dict[str, FrozenResources]:
+        memo = self._frozen[id(agg)]
+        out: dict[str, FrozenResources] = {}
+        for n in nodes:
+            res = agg.get(n.name)
+            if res is None:
+                continue
+            view = memo.get(n.name)
+            if view is None:
+                view = memo[n.name] = FrozenResources(
+                    res.cpu_milli, res.mem_kib, res.gpu_milli
+                )
+            out[n.name] = view
+        return out
+
     def get_overhead(self, nodes) -> dict[str, Resources]:
+        """{node: overhead} for `nodes`, as immutable FrozenResources views
+        (memoized until the node's aggregate changes — no per-call deep
+        copies). Callers needing a mutable value must .copy()."""
         with self._lock:
-            return {
-                n.name: self._overhead[n.name].copy()
-                for n in nodes
-                if n.name in self._overhead
-            }
+            return self._frozen_views(self._overhead, nodes)
 
     def get_non_schedulable_overhead(self, nodes) -> dict[str, Resources]:
         with self._lock:
-            return {
-                n.name: self._nonsched[n.name].copy()
-                for n in nodes
-                if n.name in self._nonsched
-            }
+            return self._frozen_views(self._nonsched, nodes)
 
     # -- oracle (tests) ------------------------------------------------------
 

@@ -125,6 +125,33 @@ class ResourceReservationManager:
             self.soft_store.create_soft_reservation_if_not_exists(app_id)
         return rr
 
+    def create_reservations_batch(
+        self, entries: list[tuple]
+    ) -> list[Optional[ReservationError]]:
+        """A serving window's reservation commits COALESCED: every entry
+        still goes through `create_reservations` (so per-entry semantics —
+        idempotency, soft shells, failure raising, test fault injection —
+        are exactly the serial path's), but under ONE deferred-notification
+        context: the usage tracker and overhead store receive a single
+        batched delta application per window instead of a listener fan-out
+        per reservation.
+
+        `entries` is [(driver, app_resources, driver_node, executor_nodes)]
+        in window order. Returns one slot per entry: None on success, else
+        the ReservationError that entry raised — the caller fails just that
+        request, exactly as the serial path did."""
+        out: list[Optional[ReservationError]] = []
+        with self.rr_cache.deferred_notifications():
+            for driver, app_resources, driver_node, executor_nodes in entries:
+                try:
+                    self.create_reservations(
+                        driver, app_resources, driver_node, executor_nodes
+                    )
+                    out.append(None)
+                except ReservationError as exc:
+                    out.append(exc)
+        return out
+
     # -- executor binding ladder -------------------------------------------
 
     def find_already_bound_reservation_node(

@@ -686,10 +686,13 @@ class PlacementSolver:
         self._closed = False
         # Candidate-mask memo: serving windows pass the same (usually
         # cluster-wide) candidate list once per request, and building the
-        # [N] bool mask is a Python walk over every name. Keyed by the full
+        # [N] bool mask is a walk over every name. Keyed by the full
         # name tuple + registry epoch + padded size, so a stale mapping can
         # never serve (collision-safe: dict equality compares the tuple).
-        self._cand_cache: dict[tuple, np.ndarray] = {}
+        # LRU-evicting: a 65th live signature must not wipe the 64 hottest.
+        from spark_scheduler_tpu.core.lru import LRUCache
+
+        self._cand_cache: LRUCache = LRUCache(64)
         # Topology-version memo (see build_tensors' topo_version contract):
         # lets the native tensor build skip its O(nodes) sync walk between
         # requests when no node changed.
@@ -897,6 +900,7 @@ class PlacementSolver:
         usage,
         overhead,
         topo_version: Optional[int] = None,
+        statics_version: Optional[int] = None,
     ) -> ClusterTensors:
         """Device-resident availability threaded ACROSS serving windows.
 
@@ -916,7 +920,14 @@ class PlacementSolver:
 
         Raises PipelineDrainRequired when a non-availability field changed
         while a window is still in flight — fetch it first, then retry.
-        Single-threaded by contract (the predicate batcher thread)."""
+        Single-threaded by contract (the predicate batcher thread).
+
+        `statics_version` is the HostFeatureStore's statics epoch: when the
+        caller passes one and it matches the epoch of the resident pipeline
+        state, the eight per-window O(nodes) static-field array compares
+        are skipped outright (the epoch bumps on every node event, so an
+        unchanged epoch proves the fields unchanged). Without it (or on a
+        mismatch) the array compares run as before."""
         host = self.build_tensors(
             nodes, usage, overhead,
             full_node_list=True, topo_version=topo_version,
@@ -925,14 +936,17 @@ class PlacementSolver:
         p = self._pipe
         if p is not None and not self._resolve_base(p):
             p = None  # pooled combine failed: pipeline dead, full re-upload
-        if (
-            p is not None
-            and p["host"].available.shape == host.available.shape
-            and all(
+        if p is not None and p["host"].available.shape == host.available.shape:
+            statics_same = (
+                statics_version is not None
+                and statics_version == p.get("statics_version")
+            ) or all(
                 np.array_equal(getattr(p["host"], f), getattr(host, f))
                 for f in _STATIC_FIELDS
             )
-        ):
+        else:
+            statics_same = False
+        if statics_same:
             cur = host.available.astype(np.int64)
             delta = cur - p["mirror"]
             dirty = np.flatnonzero(delta.any(axis=1))
@@ -980,7 +994,10 @@ class PlacementSolver:
                     self.last_state_upload = "reuse"
                 tensors = dataclasses.replace(p["tensors"], available=avail)
                 tensors.host = host
-                p.update(host=host, tensors=tensors, avail=avail, mirror=cur)
+                p.update(
+                    host=host, tensors=tensors, avail=avail, mirror=cur,
+                    statics_version=statics_version,
+                )
                 return tensors
         if p is not None and p["unfetched"]:
             if self.telemetry is not None:
@@ -1003,6 +1020,7 @@ class PlacementSolver:
             "avail": tensors.available,
             "mirror": host.available.astype(np.int64),
             "unfetched": [],
+            "statics_version": statics_version,
         }
         return tensors
 
@@ -1164,9 +1182,7 @@ class PlacementSolver:
             # after it — otherwise the mask may mix old and new name->index
             # mappings; rebuild.
             if self.registry.epoch == epoch:
-                if len(self._cand_cache) >= 64:
-                    self._cand_cache.clear()
-                self._cand_cache[key] = mask
+                self._cand_cache.put(key, mask)
                 return mask
         # Registry churning continuously: one consistent build under the
         # registry's lock (uncached — the epoch is stale by construction).

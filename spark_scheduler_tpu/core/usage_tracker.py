@@ -32,6 +32,7 @@ import numpy as np
 
 from spark_scheduler_tpu.models.cluster import NodeRegistry
 from spark_scheduler_tpu.models.resources import NUM_DIMS, Resources
+from spark_scheduler_tpu.store.cache import BatchableListener
 
 
 class ReservedUsageTracker:
@@ -41,11 +42,21 @@ class ReservedUsageTracker:
         self._soft_store = soft_store
         self._lock = threading.RLock()
         self._dense = np.zeros((0, NUM_DIMS), dtype=np.int64)
+        # Monotonic change counter: bumped under the lock by every applied
+        # delta / rebuild. The HostFeatureStore keys its zero-copy snapshot
+        # on it — an unchanged version proves the cached copy is current.
+        self.version = 0
         # Instrumentation: number of scatter deltas applied since attach —
         # the "per-request host work proportional to the delta" evidence.
         self.deltas_applied = 0
         self.rebuilds = 0
-        rr_cache.add_mutation_listener(self._on_rr_mutation)
+        # Batch-aware: a serving window's coalesced reservation write-back
+        # (create_reservations_batch under rr_cache.deferred_notifications)
+        # applies all its per-slot diffs under ONE lock hold instead of one
+        # per reservation.
+        rr_cache.add_mutation_listener(
+            BatchableListener(self._on_rr_mutation, self._on_rr_mutation_batch)
+        )
         soft_store.add_delta_listener(self._on_soft_delta)
         self.rebuild()
 
@@ -89,6 +100,7 @@ class ReservedUsageTracker:
             for node, res in self._soft_store.used_soft_reservation_resources().items():
                 self._scatter(node, res, +1)
             self.rebuilds += 1
+            self.version += 1
 
     def _ensure_row(self, idx: int) -> None:
         if idx >= self._dense.shape[0]:
@@ -102,26 +114,38 @@ class ReservedUsageTracker:
         self._ensure_row(idx)
         self._dense[idx] += sign * res.as_array().astype(np.int64)
         self.deltas_applied += 1
+        self.version += 1
 
     # -- listeners -----------------------------------------------------------
 
-    def _on_rr_mutation(self, old, new) -> None:
-        """Per-slot diff of a ResourceReservation change: O(slots of one app).
-        Status-only updates (executor pod bindings — the most common RR
-        mutation) change no Spec slot and are skipped outright."""
+    def _apply_rr_mutation(self, old, new) -> None:
+        """Per-slot diff of one ResourceReservation change (caller holds the
+        lock): O(slots of one app). Status-only updates (executor pod
+        bindings — the most common RR mutation) change no Spec slot and are
+        skipped outright."""
         if (
             old is not None
             and new is not None
             and old.spec.reservations == new.spec.reservations
         ):
             return
+        if old is not None:
+            for res in old.spec.reservations.values():
+                self._scatter(res.node, res.resources, -1)
+        if new is not None:
+            for res in new.spec.reservations.values():
+                self._scatter(res.node, res.resources, +1)
+
+    def _on_rr_mutation(self, old, new) -> None:
         with self._lock:
-            if old is not None:
-                for res in old.spec.reservations.values():
-                    self._scatter(res.node, res.resources, -1)
-            if new is not None:
-                for res in new.spec.reservations.values():
-                    self._scatter(res.node, res.resources, +1)
+            self._apply_rr_mutation(old, new)
+
+    def _on_rr_mutation_batch(self, pairs) -> None:
+        """A whole serving window's reservation commits as ONE update: one
+        lock hold, all per-slot diffs applied back to back."""
+        with self._lock:
+            for old, new in pairs:
+                self._apply_rr_mutation(old, new)
 
     def _on_soft_delta(self, node: str, res: Resources, sign: int) -> None:
         with self._lock:

@@ -202,6 +202,50 @@ class Resources:
         )
 
 
+class FrozenResources(Resources):
+    """Read-only Resources view.
+
+    Shared-aggregate queries (OverheadComputer.get_overhead) used to
+    deep-copy every value under their lock so callers could not corrupt the
+    aggregate; profiling showed the copies, not the lock, were the cost.
+    A frozen view is handed out instead: mutators raise, `copy()` stays the
+    escape hatch for a caller that genuinely needs a mutable value.
+
+    Equality is by value against ANY Resources (the generated dataclass
+    `__eq__` is class-exact and would make `Resources(...) ==
+    FrozenResources(...)` silently False for equal triples)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        object.__setattr__(self, "_frozen", True)
+
+    def __setattr__(self, name, value):
+        # Direct field writes must fail too, not just the mutator methods
+        # — a shared memoized view silently corrupted by `view.cpu_milli
+        # -= x` would poison every later reader.
+        if getattr(self, "_frozen", False):
+            raise TypeError(
+                "frozen Resources view — call .copy() before mutating"
+            )
+        object.__setattr__(self, name, value)
+
+    def _reject(self, *_args, **_kwargs):
+        raise TypeError(
+            "frozen Resources view — call .copy() before mutating"
+        )
+
+    add = _reject
+    sub = _reject
+    set_max = _reject
+
+    def __eq__(self, other):
+        if isinstance(other, Resources):
+            return self.as_tuple() == other.as_tuple()
+        return NotImplemented
+
+    __hash__ = None  # mutable-by-family type, same as Resources
+
+
 def format_quantity_milli(milli: int) -> str:
     """Milli-units -> k8s quantity string ("1500m", or "2" when integral)."""
     if milli % 1000 == 0:

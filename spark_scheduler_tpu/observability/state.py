@@ -110,4 +110,10 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
     recorder = getattr(app, "recorder", None)
     if recorder is not None:
         out["flight_recorder"] = recorder.stats()
+    features = getattr(getattr(app, "extender", None), "features", None)
+    if features is not None:
+        # Host feature store: how often per-window featurize actually
+        # re-walked state vs served the resident snapshot (the O(changed)
+        # evidence, live).
+        out["feature_store"] = features.stats()
     return out

@@ -36,6 +36,20 @@ DEVICE_FETCH_MS = "foundry.spark.scheduler.solver.device.fetch.ms"
 DEVICE_RESIDENT_AGE = (
     "foundry.spark.scheduler.solver.device.resident.age.seconds"
 )
+# Host featurize (core/feature_store.py): per-window sub-phase wall times
+# tagged phase=snapshot|tensors|domains|fifo, and the store's O(changed)
+# evidence counters (roster re-walks vs snapshots served resident).
+FEATURIZE_MS = "foundry.spark.scheduler.solver.featurize.ms"
+FEATURIZE_SNAPSHOTS = "foundry.spark.scheduler.solver.featurize.snapshots"
+FEATURIZE_ROSTER_REBUILDS = (
+    "foundry.spark.scheduler.solver.featurize.roster.rebuilds"
+)
+FEATURIZE_USAGE_REFRESHES = (
+    "foundry.spark.scheduler.solver.featurize.usage.refreshes"
+)
+FEATURIZE_OVERHEAD_REFRESHES = (
+    "foundry.spark.scheduler.solver.featurize.overhead.refreshes"
+)
 
 # The one real-compile event (trace/lowering events also fire per compile
 # but would triple-count).
@@ -128,6 +142,28 @@ class SolverTelemetry:
             path=path,
         ).update(min(1.0, rows / denom))
         self.sync_compile_gauges()
+
+    def on_featurize(self, phases: dict, store=None) -> None:
+        """One serving window's host-featurize breakdown. `phases` maps
+        record keys ("featurize_snapshot_ms", ...) to wall ms; `store` is
+        the HostFeatureStore whose counters become gauges (how often the
+        roster/usage/overhead actually refreshed vs served resident)."""
+        for key, ms in phases.items():
+            phase = key[len("featurize_"):]
+            if phase.endswith("_ms"):
+                phase = phase[:-3]
+            self.registry.histogram(FEATURIZE_MS, phase=phase).update(ms)
+        if store is not None:
+            self.registry.gauge(FEATURIZE_SNAPSHOTS).set(store.snapshots)
+            self.registry.gauge(FEATURIZE_ROSTER_REBUILDS).set(
+                store.roster_rebuilds
+            )
+            self.registry.gauge(FEATURIZE_USAGE_REFRESHES).set(
+                store.usage_refreshes
+            )
+            self.registry.gauge(FEATURIZE_OVERHEAD_REFRESHES).set(
+                store.overhead_refreshes
+            )
 
     def on_pack(self, *, nodes: int, emax: int) -> None:
         self.registry.counter(

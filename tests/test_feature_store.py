@@ -1,0 +1,356 @@
+"""HostFeatureStore: per-window featurize is O(changed), not O(nodes).
+
+Three layers:
+  - the tier-1 BUDGET test: a 10k-node store absorbs 50 incremental
+    events and serves steady-state snapshots without a single O(nodes)
+    roster re-walk (instrumented counters, not timing — timing guards
+    flake on shared CI boxes; the counters ARE the loop evidence);
+  - zero-copy semantics: unchanged state returns the same frozen arrays
+    and roster tuples, object-identical across snapshots;
+  - the satellite fixes that ride along: LRU eviction for the domain /
+    candidate caches, frozen overhead views, and the snapshot's
+    equivalence with the legacy per-window rebuild.
+"""
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.core.lru import LRUCache
+from spark_scheduler_tpu.models.kube import Container, Pod
+from spark_scheduler_tpu.models.resources import FrozenResources, Resources
+from spark_scheduler_tpu.models.reservations import new_resource_reservation
+from spark_scheduler_tpu.server.app import build_scheduler_app
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.store.backend import InMemoryBackend
+from spark_scheduler_tpu.testing.harness import (
+    INSTANCE_GROUP_LABEL,
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+NS = "namespace"
+
+
+def _app_with_nodes(n_nodes):
+    backend = InMemoryBackend()
+    names = []
+    for i in range(n_nodes):
+        node = new_node(f"fs-n{i}", zone=f"zone{i % 4}")
+        backend.add_node(node)
+        names.append(node.name)
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            sync_writes=True, instance_group_label=INSTANCE_GROUP_LABEL
+        ),
+    )
+    return backend, app, names
+
+
+def _reservation(names, j, execs=2):
+    driver = static_allocation_spark_pods(f"fs-app-{j}", execs)[0]
+    return new_resource_reservation(
+        names[j % len(names)],
+        [names[(j + k + 1) % len(names)] for k in range(execs)],
+        driver,
+        Resources.from_quantities("1", "1Gi"),
+        Resources.from_quantities("1", "1Gi"),
+    )
+
+
+# ----------------------------------------------------------- budget (tier-1)
+
+
+def test_budget_10k_nodes_steady_state_featurize_is_o_changed():
+    """THE regression guard for the optimisation: build a 10k-node store,
+    apply 50 incremental events (reservation commits), and assert the
+    steady-state snapshots did NO O(nodes) work — the roster-rebuild
+    counter (the store's only O(nodes) Python walk) must not move, and
+    the refresh counters must track exactly the events applied."""
+    backend, app, names = _app_with_nodes(10_000)
+    store = app.extender.features
+
+    cold = store.snapshot()
+    assert store.roster_rebuilds == 1  # the one cold build
+    assert len(cold.nodes) == 10_000
+
+    rebuilds_before = store.roster_rebuilds
+    usage_refreshes_before = store.usage_refreshes
+    for j in range(50):
+        assert app.rr_cache.create(_reservation(names, j))
+        snap = store.snapshot()
+        # The roster was untouched: same tuple/dict objects, zero walks.
+        assert snap.nodes is cold.nodes
+        assert snap.by_name is cold.by_name
+        assert snap.statics_epoch == cold.statics_epoch
+    assert store.roster_rebuilds == rebuilds_before, (
+        "steady-state featurize paid an O(nodes) roster re-walk"
+    )
+    # Usage refreshed once per dirty window — one vectorized copy per
+    # event, never per node.
+    assert store.usage_refreshes - usage_refreshes_before == 50
+
+    # The snapshots carried the commits: reserved rows are non-zero.
+    assert snap.usage.any()
+
+    # A NODE event is the only thing that pays the walk — exactly once.
+    backend.add_node(new_node("fs-late", zone="zone0"))
+    snap2 = store.snapshot()
+    assert store.roster_rebuilds == rebuilds_before + 1
+    assert len(snap2.nodes) == 10_001
+    # Bumps at least once for the roster walk (the re-masked overhead copy
+    # may bump it again) — what matters is that the solver's epoch skip is
+    # invalidated.
+    assert snap2.statics_epoch > cold.statics_epoch
+    app.stop()
+
+
+def test_snapshot_is_zero_copy_when_clean():
+    backend, app, names = _app_with_nodes(8)
+    store = app.extender.features
+    s1 = store.snapshot()
+    s2 = store.snapshot()
+    assert s2.nodes is s1.nodes
+    assert s2.by_name is s1.by_name
+    assert s2.usage is s1.usage
+    assert s2.overhead is s1.overhead
+    assert s2.epoch == s1.epoch
+    # Frozen: the shared arrays cannot be scribbled on by a consumer.
+    with pytest.raises(ValueError):
+        s1.usage[0, 0] = 1
+    with pytest.raises(ValueError):
+        s1.overhead[0, 0] = 1
+    app.stop()
+
+
+def test_snapshot_matches_legacy_rebuild():
+    """The snapshot's arrays must equal what the legacy per-window rebuild
+    derived: usage == reserved_usage(), overhead rows == get_overhead
+    map — through build_tensors the two views are byte-identical."""
+    backend, app, names = _app_with_nodes(16)
+    store, solver = app.extender.features, app.solver
+    # Overhead: an unreserved non-spark pod bound to a node.
+    backend.add_pod(
+        Pod(
+            name="ov-pod",
+            namespace="kube-system",
+            node_name=names[3],
+            scheduler_name="default-scheduler",
+            phase="Running",
+            containers=[
+                Container(requests=Resources.from_quantities("500m", "256Mi"))
+            ],
+        )
+    )
+    assert app.rr_cache.create(_reservation(names, 0))
+    snap = store.snapshot()
+
+    legacy_nodes = backend.list_nodes()
+    legacy_usage = app.reservation_manager.reserved_usage()
+    legacy_overhead = app.overhead_computer.get_overhead(legacy_nodes)
+
+    rows = min(snap.usage.shape[0], legacy_usage.shape[0])
+    assert np.array_equal(snap.usage[:rows], legacy_usage[:rows])
+
+    t_snap = solver.build_tensors(
+        snap.nodes, snap.usage, snap.overhead, full_node_list=True
+    )
+    t_legacy = solver.build_tensors(
+        legacy_nodes, legacy_usage, legacy_overhead, full_node_list=True
+    )
+    for field in ("available", "schedulable", "zone_id", "valid"):
+        assert np.array_equal(
+            np.asarray(getattr(t_snap, field)),
+            np.asarray(getattr(t_legacy, field)),
+        ), field
+    app.stop()
+
+
+# ------------------------------------------------------------- LRU satellite
+
+
+def test_lru_cache_65th_signature_keeps_the_64_hottest():
+    """The domain-cache satellite: overflow evicts the LRU entry only —
+    a 65th signature must keep the 64 hottest resident (the old
+    `.clear()` wiped all of them)."""
+    cache = LRUCache(64)
+    for i in range(64):
+        cache.put(("sig", i), i)
+    # Touch 1..63 so ("sig", 0) is the coldest.
+    for i in range(1, 64):
+        assert cache.get(("sig", i)) == i
+    cache.put(("sig", 64), 64)
+    assert len(cache) == 64
+    assert ("sig", 0) not in cache  # only the LRU entry fell out
+    for i in range(1, 65):
+        assert ("sig", i) in cache
+
+
+def test_domain_cache_lru_in_extender():
+    """Integration pin: the extender's affinity-domain memo survives an
+    overflow — filling it past capacity does not clear the hot entries."""
+    h = Harness(binpack_algo="tightly-pack", fifo=False)
+    h.add_nodes(*[new_node(f"n{i}", zone=f"zone{i % 2}") for i in range(4)])
+    ext = h.extender
+    topo = h.backend.nodes_version
+    for i in range(70):
+        ext._domain_cache.put((("ig", f"group-{i}"),), (topo, [f"n{i % 4}"]))
+    assert len(ext._domain_cache) == 64
+    # The most recent 64 signatures survived.
+    assert ((("ig", "group-69"),)) in ext._domain_cache
+    assert ((("ig", "group-6"),)) in ext._domain_cache
+    assert ((("ig", "group-5"),)) not in ext._domain_cache
+
+
+# --------------------------------------------------------- frozen overheads
+
+
+def test_get_overhead_returns_frozen_views():
+    backend, app, names = _app_with_nodes(4)
+    backend.add_pod(
+        Pod(
+            name="ov-pod",
+            namespace="kube-system",
+            node_name=names[0],
+            scheduler_name="default-scheduler",
+            phase="Running",
+            containers=[
+                Container(requests=Resources.from_quantities("1", "1Gi"))
+            ],
+        )
+    )
+    oc = app.overhead_computer
+    overhead = oc.get_overhead(backend.list_nodes())
+    assert names[0] in overhead
+    view = overhead[names[0]]
+    assert isinstance(view, FrozenResources)
+    # Value-equal with plain Resources, both directions.
+    expected = Resources.from_quantities("1", "1Gi")
+    assert view == expected and expected == view
+    with pytest.raises(TypeError):
+        view.add(Resources.from_quantities("1", "1Gi"))
+    with pytest.raises(TypeError):
+        view.sub(expected)
+    # copy() is the mutable escape hatch, and mutating it does not touch
+    # the aggregate.
+    mutable = view.copy()
+    mutable.add(Resources.from_quantities("1", "0"))
+    again = oc.get_overhead(backend.list_nodes())[names[0]]
+    assert again == expected
+    # Memoized: repeated queries reuse the same view object until the
+    # aggregate changes.
+    assert again is view
+    oracle = oc.compute_node_overhead_oracle(names[0])[0]
+    assert view == oracle
+    app.stop()
+
+
+def test_frozen_view_invalidated_on_aggregate_change():
+    backend, app, names = _app_with_nodes(4)
+    oc = app.overhead_computer
+
+    def add_ov(name, node):
+        backend.add_pod(
+            Pod(
+                name=name,
+                namespace="kube-system",
+                node_name=node,
+                scheduler_name="default-scheduler",
+                phase="Running",
+                containers=[
+                    Container(requests=Resources.from_quantities("1", "1Gi"))
+                ],
+            )
+        )
+
+    add_ov("ov-1", names[0])
+    v1 = oc.get_overhead(backend.list_nodes())[names[0]]
+    add_ov("ov-2", names[0])
+    v2 = oc.get_overhead(backend.list_nodes())[names[0]]
+    assert v2 is not v1
+    assert v2 == Resources.from_quantities("2", "2Gi")
+    # Dense mirror tracked the same deltas.
+    version, dense = oc.overhead_snapshot(None)
+    idx = app.solver.registry.index_of(names[0])
+    assert Resources.from_array(dense[idx]) == v2
+    app.stop()
+
+
+def test_overhead_of_deleted_node_is_masked_like_the_legacy_dict():
+    """A deleted node whose pods still exist keeps rows in the dense
+    overhead aggregate; the legacy get_overhead(all_nodes) dict never
+    surfaced them. The snapshot must match the dict exactly — non-live
+    rows zeroed — or the soak's drained-mirror invariant (which rebuilds
+    from the dict) would diverge from the serving path."""
+    backend, app, names = _app_with_nodes(4)
+    store = app.extender.features
+    backend.add_pod(
+        Pod(
+            name="ghost-ov",
+            namespace="kube-system",
+            node_name=names[1],
+            scheduler_name="default-scheduler",
+            phase="Running",
+            containers=[
+                Container(requests=Resources.from_quantities("1", "1Gi"))
+            ],
+        )
+    )
+    idx = app.solver.registry.index_of(names[1])
+    snap = store.snapshot()
+    assert snap.overhead[idx].any()
+
+    backend.delete("nodes", "", names[1])  # pod survives the node
+    snap2 = store.snapshot()
+    assert not snap2.overhead[idx].any(), (
+        "dense overhead leaked a deleted node's row past the roster mask"
+    )
+    # And the raw aggregate still remembers it: re-adding the node
+    # resurfaces the overhead, exactly like the dict would.
+    backend.add_node(new_node(names[1], zone="zone1"))
+    snap3 = store.snapshot()
+    assert snap3.overhead[idx].any()
+    app.stop()
+
+
+def test_overhead_change_invalidates_statics_epoch():
+    """Regression pin (review finding): `schedulable = allocatable -
+    overhead` is a STATIC field of the cluster tensors, and overhead can
+    change with NO node event (pod churn). The statics epoch must bump on
+    overhead refreshes, or the solver's epoch skip would leave a stale
+    schedulable tensor on device and window decisions could diverge from
+    the reference path."""
+    backend, app, names = _app_with_nodes(4)
+    store, solver = app.extender.features, app.solver
+    s1 = store.snapshot()
+    t1 = solver.build_tensors_pipelined(
+        s1.nodes, s1.usage, s1.overhead,
+        topo_version=s1.nodes_version, statics_version=s1.statics_epoch,
+    )
+    # Overhead-only event: an unreserved pod binds to a node.
+    backend.add_pod(
+        Pod(
+            name="stale-ov",
+            namespace="kube-system",
+            node_name=names[0],
+            scheduler_name="default-scheduler",
+            phase="Running",
+            containers=[
+                Container(requests=Resources.from_quantities("500m", "512Mi"))
+            ],
+        )
+    )
+    s2 = store.snapshot()
+    assert s2.statics_epoch != s1.statics_epoch
+    t2 = solver.build_tensors_pipelined(
+        s2.nodes, s2.usage, s2.overhead,
+        topo_version=s2.nodes_version, statics_version=s2.statics_epoch,
+    )
+    # The device-resident schedulable tensor followed host truth.
+    idx = solver.registry.index_of(names[0])
+    host_sched = np.asarray(getattr(t2, "host", t2).schedulable)
+    dev_sched = np.asarray(t2.schedulable)
+    assert np.array_equal(dev_sched[idx], host_sched[idx])
+    assert dev_sched[idx][0] == 8000 - 500  # allocatable - overhead
+    app.stop()

@@ -242,7 +242,7 @@ def test_capacity_epoch_resolves_stale_window():
     # (blind to the in-flight gang) and admits onto the same node.
     orig_build = solver.build_tensors_pipelined
 
-    def blind_build(nodes, usage, overhead, topo_version=None):
+    def blind_build(nodes, usage, overhead, topo_version=None, **_kw):
         return solver.build_tensors(nodes, usage, overhead)
 
     solver.build_tensors_pipelined = blind_build

@@ -127,3 +127,46 @@ def test_scheduling_decisions_unchanged_by_tracker(algo):
                 placed.append(tuple(r.node_names))
         results[use_tracker] = placed
     assert results[True] == results[False]
+
+
+def test_dense_and_map_usage_produce_identical_tensors():
+    """Satellite parity pin (ISSUE 5): the dense `usage_tracker.array()`
+    fast path and the `get_reserved_resources()` map fallback must yield
+    byte-identical tensors through `build_tensors` — the serving suites
+    only ever exercise the fast path, so this is the map fallback's one
+    equivalence anchor."""
+    h = Harness()
+    h.add_nodes(*[new_node(f"n{i}", zone=f"z{i % 2}") for i in range(6)])
+    nodes = [f"n{i}" for i in range(6)]
+    for i in range(3):
+        pods = static_allocation_spark_pods(f"par-app-{i}", 3)
+        assert all(r.ok for r in h.schedule_app(pods, nodes))
+
+    rrm = h.app.reservation_manager
+    solver = h.app.solver
+    all_nodes = h.backend.list_nodes()
+    overhead = h.app.overhead_computer.get_overhead(all_nodes)
+
+    dense = rrm.usage_tracker.array()
+    assert dense.any(), "fixture scheduled nothing"
+    tracker, rrm.usage_tracker = rrm.usage_tracker, None
+    try:
+        as_map = rrm.reserved_usage()
+        assert isinstance(as_map, dict) and as_map
+    finally:
+        rrm.usage_tracker = tracker
+
+    t_dense = solver.build_tensors(
+        all_nodes, dense, overhead, full_node_list=True
+    )
+    t_map = solver.build_tensors(
+        all_nodes, as_map, overhead, full_node_list=True
+    )
+    for field in (
+        "available", "schedulable", "zone_id", "name_rank", "valid",
+        "unschedulable", "ready",
+    ):
+        assert np.array_equal(
+            np.asarray(getattr(t_dense, field)),
+            np.asarray(getattr(t_map, field)),
+        ), field
