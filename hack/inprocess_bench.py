@@ -79,6 +79,7 @@ def main() -> int:
                 "n": len(lats),
                 "nodes": n_nodes,
                 "device": str(jax.devices()[0]),
+                "fused_k": 1,
                 "path": "in-process predicate_batch (no HTTP, no tunnel)",
             }
         )

@@ -137,6 +137,7 @@ def _run_arm(pool: int) -> dict:
             (solver.last_solve_info or {}).get("partitions")
         ),
         "pipelined": True,
+        "fused_k": 1,
         "path": (
             "single-device serving path (engine off)"
             if pool == 1

@@ -64,6 +64,17 @@ def main(argv=None) -> int:
         "node-axis-sharded solve on the slot's sub-mesh",
     )
     srv.add_argument(
+        "--fuse-windows",
+        type=int,
+        default=None,
+        help="fused multi-window device dispatch: when the predicate "
+        "backlog exceeds one window, claim up to K windows and solve "
+        "them in ONE device program carrying committed state on-device "
+        "between windows (K windows share one device round trip); "
+        "overrides the install config's solver.fuse-windows (default 1 "
+        "= unfused)",
+    )
+    srv.add_argument(
         "--autoscaler",
         action="store_true",
         help="enable the in-process elastic autoscaler: consume pending "
@@ -174,6 +185,8 @@ def main(argv=None) -> int:
         config.solver_device_pool = args.device_pool
         config.solver_mesh_groups = None
         config.solver_mesh_node_shards = None
+    if args.fuse_windows is not None:
+        config.solver_fuse_windows = args.fuse_windows
     if args.mesh is not None:
         try:
             groups, shards = (int(x) for x in args.mesh.lower().split("x"))

@@ -16,8 +16,11 @@ This replaces the reference's per-request map-building + sort + greedy loops
 from __future__ import annotations
 
 import dataclasses
+import itertools as _itertools
 import threading
 import time as _time
+import warnings as _warnings
+import weakref as _weakref
 from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -44,6 +47,35 @@ from spark_scheduler_tpu.ops.efficiency import avg_packing_efficiency_np
 # VERDICT r2 #2). Derived, not enumerated — a new strategy registered in
 # BINPACK_FUNCTIONS must also be taught to the batched scan.
 BATCHABLE_STRATEGIES = frozenset(BINPACK_FUNCTIONS)
+
+# Simulated-RTT device shim (testing/rtt_shim.py). When installed, the
+# serving path calls it with "h2d" on the dispatcher thread at every
+# window-batch upload/dispatch, "dispatch" on the thread running a pooled
+# slot's program launch, and "d2h" on the thread paying a decision-blob
+# pull — each call sleeps its configured share of a device round trip, so
+# the fused dispatch's RTT amortization is benchable on CPU. None keeps
+# every hot-path hook a single global read.
+_DEVICE_SHIM = None
+
+
+def set_device_shim(shim) -> None:
+    """Install (or clear, with None) the process-wide device shim."""
+    global _DEVICE_SHIM
+    _DEVICE_SHIM = shim
+
+
+def _shim(kind: str) -> None:
+    s = _DEVICE_SHIM
+    if s is not None:
+        s(kind)
+
+
+def _shimmed_device_get(x):
+    """jax.device_get with the simulated d2h boundary, on the calling
+    (fetch-pool) thread — concurrent pulls overlap exactly as the real
+    tunnel's concurrent device_get RPCs do."""
+    _shim("d2h")
+    return jax.device_get(x)
 
 def _build_segmented_window(
     requests, drv_arr, exc_arr, counts, skip_arr, cand_per_req, dom_per_req
@@ -430,16 +462,36 @@ class _PoolSlot:
 
 
 class _DevicePool:
-    """Round-robin slot allocator for the multi-device window-solve engine."""
+    """Slot allocator for the multi-device window-solve engine:
+    least-loaded first (round-robin tiebreak), so a fresh window-batch
+    UPLOADS to an idle slot while the busy slots keep SOLVING — the
+    upload/solve/fetch double-buffer across slots. Slot choice never
+    affects decisions (every slot serves the same resident statics), so
+    pure round-robin and least-loaded are byte-identical; least-loaded
+    just keeps the overlap engaged when solve times are uneven."""
 
     def __init__(self, slots):
         self.slots = [_PoolSlot(s) for s in slots]
         self._next = 0
 
     def next_slot(self) -> _PoolSlot:
-        slot = self.slots[self._next]
-        self._next = (self._next + 1) % len(self.slots)
-        return slot
+        n = len(self.slots)
+        best, best_i = None, 0
+        for off in range(n):
+            i = (self._next + off) % n
+            s = self.slots[i]
+            if best is None or s.inflight < best.inflight:
+                best, best_i = s, i
+                if s.inflight == 0:
+                    break
+        self._next = (best_i + 1) % n
+        return best
+
+    def occupancy(self) -> float:
+        """Fraction of slots with at least one in-flight solve — the
+        overlap-occupancy telemetry sample taken at each dispatch."""
+        busy = sum(1 for s in self.slots if s.inflight > 0)
+        return busy / max(1, len(self.slots))
 
     def release(self):
         for s in self.slots:
@@ -572,7 +624,8 @@ class WindowHandle:
         "strategy", "blob", "blob_future", "requests", "flat_rows",
         "host_avail", "host_schedulable", "priors", "placements", "n",
         "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
-        "info", "parts", "request_device",
+        "info", "parts", "request_device", "dispatch_id", "dispatched_at",
+        "fused_decisions", "released", "__weakref__",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
@@ -607,8 +660,34 @@ class WindowHandle:
         self.row_skippable = None
         self.seg_map = None  # pallas window path: (seg_idx, row_idx)
         # Flight-recorder dispatch info: {"path", "nodes", "rows",
-        # "row_bucket", "emax", "compile_cache_hit"} — set at dispatch.
+        # "row_bucket", "emax", "compile_cache_hit", "dispatch_id",
+        # "fused_k"} — set at dispatch.
         self.info = None
+        # Monotone per-solver id of the device dispatch that solved this
+        # window. Every FusedWindowView of one fused batch shares its
+        # umbrella's id — the serving loop's pipeline-depth accounting
+        # counts DISPATCHES, not windows.
+        self.dispatch_id = None
+        self.dispatched_at = 0.0
+        # Fused umbrella only: memoized ("ok", decisions) / ("err", exc)
+        # of the one real fetch, shared by every view's pack_window_fetch.
+        self.fused_decisions = None
+        self.released = False
+
+    def release_buffers(self) -> None:
+        """Drop the dispatch's staging buffers: the device decision blob
+        and any in-flight pulls (close()/discard_pipeline() — a discarded
+        fused batch must not keep its [K, ...] device blob alive through
+        view handles parked in the serving pipeline). A later fetch of a
+        released handle fails fast instead of pulling freed state."""
+        self.released = True
+        self.blob = None
+        fut = self.blob_future
+        if fut is not None:
+            fut.cancel()
+        if self.parts:
+            for p in self.parts:
+                p.future.cancel()
 
     def fetch_ready(self) -> bool:
         """True when every decision pull this window started eagerly has
@@ -624,6 +703,65 @@ class WindowHandle:
         if self.parts is not None:
             return True
         return self.blob_future is not None
+
+
+class FusedWindowView:
+    """One sub-window of a fused K-window dispatch
+    (PlacementSolver.pack_windows_dispatch): a slice view over the
+    umbrella WindowHandle that solved the K windows' concatenated
+    segmented batch in one device program. Duck-typed to the WindowHandle
+    surface the serving loop and extender consume (fetch_ready /
+    has_eager_fetch / requests / request_device / info / dispatch_id);
+    pack_window_fetch on a view fetches the umbrella ONCE (memoized on
+    the owner) and returns the view's request slice — the first completed
+    view pays the single d2h, the rest are free."""
+
+    __slots__ = ("owner", "lo", "hi", "index", "fused_k", "info")
+
+    def __init__(self, owner: "WindowHandle", lo: int, hi: int,
+                 index: int, fused_k: int):
+        self.owner = owner
+        self.lo = lo
+        self.hi = hi
+        self.index = index
+        self.fused_k = fused_k
+        # Per-view copy so a record's solve_info names the view's position
+        # inside the fused batch without mutating the shared owner info.
+        self.info = {**(owner.info or {}), "fused_index": index}
+
+    @property
+    def dispatch_id(self):
+        return self.owner.dispatch_id
+
+    @property
+    def strategy(self):
+        return self.owner.strategy
+
+    @property
+    def requests(self):
+        return self.owner.requests[self.lo:self.hi]
+
+    @property
+    def request_device(self):
+        rd = self.owner.request_device
+        return rd[self.lo:self.hi] if rd is not None else None
+
+    # Serving-loop eager-fetch surface (server/http.py eager_futures).
+    @property
+    def parts(self):
+        return self.owner.parts
+
+    @property
+    def blob_future(self):
+        return self.owner.blob_future
+
+    def fetch_ready(self) -> bool:
+        if self.owner.fused_decisions is not None:
+            return True
+        return self.owner.fetch_ready()
+
+    def has_eager_fetch(self) -> bool:
+        return self.owner.has_eager_fetch()
 
 
 class PlacementSolver:
@@ -650,9 +788,36 @@ class PlacementSolver:
             slots = make_pool_slots(pool_spec[0], pool_spec[1])
             if len(slots) > 1 or pool_spec[1] > 1:
                 self._pool = _DevicePool(slots)
+        if (
+            self._pool is not None
+            and any(s.is_mesh for s in self._pool.slots)
+            and jax.default_backend() != "tpu"
+        ):
+            # Startup warning, not an error: the config is legal, but
+            # node-axis GSPMD sharding needs an ICI-class interconnect —
+            # the CPU mesh measured 0.5x the plain pool (PR 4) and used
+            # to degrade silently.
+            _warnings.warn(
+                "solver.mesh node-shards="
+                f"{pool_spec[1]} on backend {jax.default_backend()!r}: "
+                "node-axis sharding needs an ICI-class interconnect "
+                "(measured 0.5x on a CPU mesh); serving will be slower "
+                "than an unsharded pool of the same devices",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         # Statics epoch: bumped on every full host upload (topology or
         # attribute change); pool replicas re-upload when their epoch lags.
         self._static_epoch = 0
+        # Fused multi-window dispatch (pack_windows_dispatch): monotone
+        # dispatch ids for the serving loop's depth accounting, and weak
+        # refs to live fused umbrellas so close()/discard_pipeline() can
+        # release their [K, ...] staging buffers even while view handles
+        # are still parked in the serving pipeline.
+        self._dispatch_seq = _itertools.count(1)
+        self._fused_owners: "_weakref.WeakSet[WindowHandle]" = (
+            _weakref.WeakSet()
+        )
         # How the LAST pipelined/cached build reached the device
         # ("full" | "delta" | "reuse") — flight-recorder state_upload.
         self.last_state_upload: str | None = None
@@ -872,6 +1037,7 @@ class PlacementSolver:
         self._inflight_futures.clear()
         self._pipe = None
         self._dev = None
+        self._release_fused()
         self._release_pool()
 
     def _release_pool(self) -> None:
@@ -888,11 +1054,24 @@ class PlacementSolver:
         decisions are being discarded (capacity changed under them) — the
         host view is the durable truth once every surviving window has
         applied. Pool replicas are released with it (the next build bumps
-        the statics epoch, so every slot re-uploads on its next turn)."""
+        the statics epoch, so every slot re-uploads on its next turn), and
+        so are the staging buffers of any un-fetched FUSED batches — their
+        decisions are being discarded with the pipeline (the caller's
+        epoch bump re-solves every in-flight window from host truth), so
+        keeping the [K, ...] device blobs alive through parked view
+        handles would be a restart-shaped leak."""
         self._pipe = None
+        self._release_fused()
         self._release_pool()
         if self.telemetry is not None:
             self.telemetry.on_pipeline_event("discard")
+
+    def _release_fused(self) -> None:
+        for h in list(self._fused_owners):
+            h.release_buffers()
+        # WeakSet: survivors were only kept alive by external view refs;
+        # they are released now and need no second pass.
+        self._fused_owners.clear()
 
     def build_tensors_pipelined(
         self,
@@ -1221,7 +1400,8 @@ class PlacementSolver:
             # round-trip (SURVEY.md §7 latency budget). Efficiency reporting
             # runs as pure numpy on the host-resident cluster arrays — zero
             # extra pulls.
-            blob = jax.device_get(
+            _shim("h2d")
+            blob = _shimmed_device_get(
                 _pack_blob(
                     tensors,
                     jnp.asarray(driver_resources.as_array()),
@@ -1435,6 +1615,10 @@ class PlacementSolver:
             window_requests=len(requests), window_rows=b, batched=True,
             path=path,
         ):
+            # One simulated h2d/dispatch boundary per DISPATCH, on the
+            # dispatcher thread — a fused K-window batch pays this once
+            # where K sequential dispatches pay it K times.
+            _shim("h2d")
             if use_pallas:
                 win, seg_idx, row_idx, s_pad, r_pad = (
                     _build_segmented_window(
@@ -1493,6 +1677,10 @@ class PlacementSolver:
                 if tel is not None
                 else None
             ),
+            "dispatch_id": next(self._dispatch_seq),
+            # Overwritten by pack_windows_dispatch when this dispatch
+            # carries a fused K-window batch.
+            "fused_k": 1,
         }
         # The solo batched-admission path (a single-segment pack_window)
         # reads this right after its solve, like pack()'s callers do.
@@ -1528,13 +1716,15 @@ class PlacementSolver:
         handle.row_skippable = skip_arr
         handle.seg_map = seg_map  # pallas path: [S,R] blob -> flat rows
         handle.info = info
+        handle.dispatch_id = info["dispatch_id"]
+        handle.dispatched_at = self._clock()
         if pipelined:
             p["unfetched"].append(handle)
             # Start the device->host pull NOW on the fetch thread: over a
             # tunneled device the transfer RTT dominates, and starting it at
             # dispatch lets it elapse under the next window's host build.
             handle.blob_future = _shared_fetch_pool().submit(
-                jax.device_get, blob
+                _shimmed_device_get, blob
             )
             self._track(handle.blob_future)
         return handle
@@ -1543,6 +1733,62 @@ class PlacementSolver:
         """Register an in-flight pool future for cancel-on-close()."""
         self._inflight_futures.add(fut)
         fut.add_done_callback(self._inflight_futures.discard)
+
+    def dispatch_occupancy(self) -> float:
+        """Busy fraction of the dispatch surface at this instant: pooled =
+        fraction of slots with an in-flight solve; single device = 1.0
+        when a dispatched window is still un-fetched (a new dispatch
+        overlaps it). The overlap-occupancy telemetry sample."""
+        if self._pool is not None:
+            return self._pool.occupancy()
+        p = self._pipe
+        return 1.0 if p is not None and p["unfetched"] else 0.0
+
+    def pack_windows_dispatch(
+        self,
+        strategy: str,
+        tensors,
+        request_windows: Sequence[Sequence[WindowRequest]],
+    ) -> "list[FusedWindowView]":
+        """FUSED K-window dispatch on the resident carry state (ROADMAP
+        Open item 2): the K serving windows concatenate into ONE segmented
+        batch — a window boundary is an ordinary segment boundary, so the
+        committed base carries ON DEVICE across the windows exactly as
+        `available_after` is threaded between K sequential dispatches
+        (ops/batched.py AppBatch window mode; fuse_app_batches pins the
+        identity at the ops layer) — and ship as one h2d of K window
+        blobs, one jitted dispatch, and one d2h of K placements instead
+        of K full device round trips.
+
+        Decisions are byte-identical to dispatching the K windows
+        sequentially back-to-back (the fused-vs-sequential equivalence
+        suite pins this across churn, K, and domain partitioning); the
+        caller's contract is that all K windows were claimed from the
+        queue at one instant, before any of them completed — exactly the
+        PredicateBatcher's fused claim. On a device pool the concatenated
+        batch rides the same partition/overlap machinery as a single
+        window (disjoint-domain partitions still solve concurrently).
+
+        Returns one FusedWindowView per window; fetch each IN DISPATCH
+        ORDER via pack_window_fetch — the first fetch pays the single
+        blocking pull, later views are free."""
+        windows = [list(w) for w in request_windows]
+        occupancy = self.dispatch_occupancy()
+        flat: list[WindowRequest] = [r for w in windows for r in w]
+        owner = self.pack_window_dispatch(strategy, tensors, flat)
+        k = len(windows)
+        if owner.info is not None:
+            owner.info["fused_k"] = k
+        self._fused_owners.add(owner)
+        if self.telemetry is not None:
+            self.telemetry.on_fused_dispatch(k, occupancy)
+        views: list[FusedWindowView] = []
+        lo = 0
+        for i, w in enumerate(windows):
+            hi = lo + len(w)
+            views.append(FusedWindowView(owner, lo, hi, i, k))
+            lo = hi
+        return views
 
     def _dispatch_pooled(
         self, strategy, tensors, requests, *, host, drv_arr, exc_arr,
@@ -1636,6 +1882,10 @@ class PlacementSolver:
                 commit=commit_g, reset=reset_g,
             )
             epoch = self._static_epoch
+            # Simulated h2d boundary on the DISPATCHER thread: the pooled
+            # engine still ships one window-batch upload per partition
+            # submit over the single tunnel link.
+            _shim("h2d")
             if idx is None:
                 statics = slot.resident_statics(host, epoch, self._clock, tel)
                 sub_avail = slot.place_avail(base)
@@ -1668,6 +1918,7 @@ class PlacementSolver:
             def run():
                 t0 = self._clock()
                 try:
+                    _shim("dispatch")
                     blob, after = fn(
                         sub_avail, statics, apps,
                         fill=strategy, emax=emax, num_zones=num_zones,
@@ -1678,6 +1929,7 @@ class PlacementSolver:
                     raise
                 after_fut.set_result(after)
                 t1 = self._clock()
+                _shim("d2h")
                 blob_np = np.asarray(jax.device_get(blob))
                 t2 = self._clock()
                 return {
@@ -1768,6 +2020,8 @@ class PlacementSolver:
                 if tel is not None
                 else None
             ),
+            "dispatch_id": next(self._dispatch_seq),
+            "fused_k": 1,
         }
         self.last_solve_info = info
         if tel is not None:
@@ -1792,12 +2046,35 @@ class PlacementSolver:
         handle.parts = parts
         handle.request_device = request_device
         handle.info = info
+        handle.dispatch_id = info["dispatch_id"]
+        handle.dispatched_at = self._clock()
         p["unfetched"].append(handle)
         return handle
 
-    def pack_window_fetch(self, handle: "WindowHandle") -> list[WindowDecision]:
+    def pack_window_fetch(self, handle) -> list[WindowDecision]:
         """Block on a dispatched window's decisions and reconstruct the
-        per-request outcomes (the second half of pack_window)."""
+        per-request outcomes (the second half of pack_window). A
+        FusedWindowView fetches its umbrella ONCE (memoized — including a
+        failure, which every sub-window of the batch must surface
+        identically) and slices its own requests' decisions out."""
+        if isinstance(handle, FusedWindowView):
+            owner = handle.owner
+            res = owner.fused_decisions
+            if res is None:
+                try:
+                    res = ("ok", self.pack_window_fetch(owner))
+                except BaseException as exc:
+                    res = ("err", exc)
+                owner.fused_decisions = res
+            kind, val = res
+            if kind == "err":
+                raise val
+            return val[handle.lo:handle.hi]
+        if handle.released:
+            # close()/discard_pipeline() dropped this dispatch's staging
+            # buffers; its decisions are gone by design (the caller's
+            # epoch machinery re-solves from host truth).
+            raise RuntimeError("window dispatch was discarded")
         if not handle.requests:
             return []
         if handle.parts is not None:
@@ -1813,7 +2090,7 @@ class PlacementSolver:
                 if handle.blob_future is not None:
                     blob = handle.blob_future.result()
                 else:
-                    blob = jax.device_get(handle.blob)
+                    blob = _shimmed_device_get(handle.blob)
             except Exception:
                 # The device base embodies this window's (now unknowable)
                 # placements while no reservation was created for them.
@@ -1859,7 +2136,20 @@ class PlacementSolver:
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
             p["mirror"] -= placements
+        self._note_dispatch_complete(handle)
         return decisions
+
+    def _note_dispatch_complete(self, handle) -> None:
+        """Amortized round-trip telemetry: dispatch -> decisions-on-host
+        wall time divided by the dispatch's fused window count — the
+        per-window share of the device round trip a fused batch pays."""
+        tel = self.telemetry
+        if tel is None or not handle.dispatched_at:
+            return
+        k = max(1, (handle.info or {}).get("fused_k", 1))
+        tel.on_dispatch_complete(
+            (self._clock() - handle.dispatched_at) * 1e3 / k, k
+        )
 
     def _fetch_pooled(self, handle: "WindowHandle") -> list[WindowDecision]:
         """Fetch + reconstruct a pooled (possibly partitioned) window.
@@ -1938,6 +2228,7 @@ class PlacementSolver:
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
             p["mirror"] -= placements
+        self._note_dispatch_complete(handle)
         return results
 
     def _reconstruct_requests(

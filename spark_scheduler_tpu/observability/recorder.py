@@ -68,6 +68,12 @@ class DecisionRecord:
     # "cpu:1" — None outside the multi-device engine. Lets /debug/decisions
     # attribute a latency outlier to one device.
     device_id: Optional[str] = None
+    # Fused multi-window dispatch: how many serving windows shared this
+    # decision's device dispatch (1 = unfused), and the solver's monotone
+    # id of that dispatch — every decision of one fused batch shares the
+    # id, so /debug/decisions groups the K windows one round trip served.
+    fused_k: Optional[int] = None
+    dispatch_id: Optional[int] = None
     # How the solve's cluster state reached the device: "full" re-upload,
     # "delta" row scatter, or "reuse" of the resident replica — a "full"
     # on a latency outlier marks a cold device replica.
@@ -117,6 +123,8 @@ class FlightRecorder:
         solve: Optional[dict] = None,
         device_id: Optional[str] = None,
         state_upload: Optional[str] = None,
+        fused_k: Optional[int] = None,
+        dispatch_id: Optional[int] = None,
     ) -> DecisionRecord:
         if (
             failed_nodes
@@ -152,6 +160,8 @@ class FlightRecorder:
             solve=solve,
             device_id=device_id,
             state_upload=state_upload,
+            fused_k=fused_k,
+            dispatch_id=dispatch_id,
         )
         with self._lock:
             self._ring.append(rec)

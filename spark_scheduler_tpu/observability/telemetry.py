@@ -36,6 +36,19 @@ DEVICE_FETCH_MS = "foundry.spark.scheduler.solver.device.fetch.ms"
 DEVICE_RESIDENT_AGE = (
     "foundry.spark.scheduler.solver.device.resident.age.seconds"
 )
+# Fused multi-window dispatch engine (core/solver.py
+# pack_windows_dispatch): how many windows each device dispatch carried,
+# the per-window share of the dispatch->decisions round trip, and how
+# busy the dispatch surface (pool slots / in-flight pipeline) was when a
+# new dispatch launched — the upload/solve/fetch overlap actually
+# engaging.
+DISPATCH_FUSED_K = "foundry.spark.scheduler.solver.dispatch.fused.k"
+DISPATCH_AMORTIZED_RTT_MS = (
+    "foundry.spark.scheduler.solver.dispatch.amortized.rtt.ms"
+)
+DISPATCH_OVERLAP_OCCUPANCY = (
+    "foundry.spark.scheduler.solver.dispatch.overlap.occupancy"
+)
 # Host featurize (core/feature_store.py): per-window sub-phase wall times
 # tagged phase=snapshot|tensors|domains|fifo, and the store's O(changed)
 # evidence counters (roster re-walks vs snapshots served resident).
@@ -170,6 +183,26 @@ class SolverTelemetry:
             SOLO_PACKS, nodes=str(nodes), emax=str(emax)
         ).inc()
         self.sync_compile_gauges()
+
+    # -- fused dispatch ------------------------------------------------------
+
+    def on_fused_dispatch(self, fused_k: int, occupancy: float) -> None:
+        """One fused multi-window dispatch: its batch size (fused_k = 1
+        means the fused claim found only one window's worth of backlog)
+        and the dispatch surface's busy fraction at launch."""
+        self.registry.histogram(DISPATCH_FUSED_K).update(fused_k)
+        self.registry.histogram(DISPATCH_OVERLAP_OCCUPANCY).update(
+            round(occupancy, 4)
+        )
+
+    def on_dispatch_complete(
+        self, amortized_rtt_ms: float, fused_k: int
+    ) -> None:
+        """Dispatch -> decisions-on-host wall time per WINDOW of the
+        dispatch (the fused batch divides one device round trip by K)."""
+        self.registry.histogram(
+            DISPATCH_AMORTIZED_RTT_MS, fused=str(fused_k)
+        ).update(round(amortized_rtt_ms, 3))
 
     # -- device pool ---------------------------------------------------------
 

@@ -93,6 +93,14 @@ class AppBatch(NamedTuple):
     # its reservation AND it is re-packed hypothetically,
     # resource.go:221-258 + GetReservedResources) — so windowed == solo
     # serving, decision for decision.
+    #
+    # FUSED MULTI-WINDOW batches (solver.pack_windows_dispatch) are
+    # ordinary segmented batches: K serving windows concatenated in
+    # dispatch order need no device-side window marker, because a window
+    # boundary IS a segment boundary — the scan's committed base carries
+    # across it exactly as `available_after` would be threaded between K
+    # sequential dispatches (fuse_app_batches pins the identity). That is
+    # what lets K queued windows ride ONE h2d + ONE dispatch + ONE d2h.
     commit: jnp.ndarray | None = None  # [B] bool
     reset: jnp.ndarray | None = None  # [B] bool
 
@@ -450,4 +458,68 @@ def make_app_batch(
         domain=_pad_mask(domain),
         commit=_pad_vec(commit, fill=False, dtype=bool),
         reset=_pad_vec(reset, fill=False, dtype=bool),
+    )
+
+
+def fuse_app_batches(batches, *, pad_to: int | None = None) -> AppBatch:
+    """Concatenate K segmented WINDOW batches into ONE fused segmented
+    batch — the ops-layer contract of the fused multi-window dispatch
+    engine (core/solver.py pack_windows_dispatch).
+
+    The fused scan's decisions are IDENTICAL to running the K batches
+    sequentially with `available_after` threaded between them: a window
+    boundary is just a segment boundary (the next window's first row has
+    reset=True, rewinding working availability to the committed base the
+    previous window left), FIFO blocking is already segment-local, and
+    priority orders are already re-sorted per segment. Each input batch's
+    padding rows (app_valid=False) are stripped before concatenation and
+    the fused batch re-pads once at the end, so fused row count is the sum
+    of REAL rows, not of padded buckets.
+
+    Every batch must be segmented (commit/reset set) and share the node
+    axis; per-row masks are synthesized all-true for batches that carried
+    none when any other batch carries them (matching the kernel's own
+    synthesis, so decisions cannot shift)."""
+    import numpy as np
+
+    if not batches:
+        raise ValueError("fuse_app_batches requires at least one batch")
+    n = None
+    for b in batches:
+        if b.commit is None or b.reset is None:
+            raise ValueError(
+                "fuse_app_batches requires segmented window batches"
+            )
+        for m in (b.driver_cand, b.domain):
+            if m is not None:
+                m_n = np.asarray(m).shape[1]
+                if n is None:
+                    n = m_n
+                elif n != m_n:
+                    raise ValueError("node axes differ across batches")
+    any_cand = any(b.driver_cand is not None for b in batches)
+    any_dom = any(b.domain is not None for b in batches)
+
+    def _real(b, field, synth_mask=False):
+        arr = getattr(b, field)
+        sel = np.flatnonzero(np.asarray(b.app_valid))
+        if arr is None:
+            if not synth_mask:
+                return None
+            return np.ones((len(sel), n), bool)
+        return np.asarray(arr)[sel]
+
+    cat = lambda field, synth=False: np.concatenate(
+        [_real(b, field, synth) for b in batches]
+    )
+    return make_app_batch(
+        cat("driver_req"),
+        cat("exec_req"),
+        cat("exec_count"),
+        pad_to=pad_to,
+        skippable=cat("skippable"),
+        driver_cand=cat("driver_cand", any_cand) if any_cand else None,
+        domain=cat("domain", any_dom) if any_dom else None,
+        commit=cat("commit"),
+        reset=cat("reset"),
     )

@@ -156,6 +156,16 @@ class InstallConfig:
     solver_device_pool: int = 1
     solver_mesh_groups: Optional[int] = None
     solver_mesh_node_shards: Optional[int] = None
+    # Fused multi-window device dispatch (`solver.fuse-windows`): when the
+    # predicate backlog holds more than one window's worth of requests,
+    # the batcher claims up to fuse-windows x predicate-max-window of them
+    # and dispatches the sub-windows as ONE fused device program carrying
+    # the committed base on-device between windows — K windows share one
+    # h2d + dispatch + d2h round trip (the tunneled-TPU
+    # `device_rtt_floor_ms` amortizes by K). Decisions are byte-identical
+    # to sequential single-window dispatch (equivalence-suite pinned).
+    # 1 (default) = today's one-window-per-dispatch behavior.
+    solver_fuse_windows: int = 1
     # Scheduling flight recorder (observability/): every extender decision
     # appends an explainable DecisionRecord (verdict, per-node failure map,
     # FIFO queue position, padding bucket, compile-cache hit, phase wall
@@ -319,6 +329,9 @@ class InstallConfig:
                 if (v := block_key(mesh_block, "node-shards", None))
                 is not None
                 else None
+            ),
+            solver_fuse_windows=int(
+                block_key(solver_block, "fuse-windows", 1)
             ),
             runtime_config_path=raw.get("runtime-config-path"),
             jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),

@@ -124,14 +124,23 @@ class PredicateBatcher:
 
     def __init__(
         self, extender, max_window: int = 32, hold_ms: float = 25.0,
-        registry=None, pipeline_depth: int = 3,
+        registry=None, pipeline_depth: int = 3, fuse_windows: int = 1,
     ):
         self._extender = extender
         self._max_window = max_window
         # How many dispatched windows may be awaiting their decision pull
         # at once. Concurrent device_get RPCs overlap (the fetch pool), so
         # depth N divides the per-window round-trip cost by up to N.
+        # With fusion, depth counts DISPATCHES (a fused batch of K windows
+        # is one round trip) — see _run's inflight_dispatches.
         self._pipeline_depth = max(1, pipeline_depth)
+        # Fused multi-window dispatch (`solver.fuse-windows`): when the
+        # backlog holds more than one window's worth of requests, claim up
+        # to fuse_windows x max_window of them and dispatch the sub-windows
+        # as ONE fused device program (extender.predicate_windows_dispatch)
+        # — K windows share one h2d + dispatch + d2h round trip instead of
+        # paying one each. 1 = today's one-window-per-dispatch behavior.
+        self._fuse_windows = max(1, fuse_windows)
         # Window-size histogram + wait time in the tagged registry (the
         # reference's metric discipline for every serving subsystem,
         # metrics/metrics.go:29-76).
@@ -176,6 +185,10 @@ class PredicateBatcher:
         # Windows dispatched while another window was still in flight (the
         # dispatch-before-fetch overlap actually engaging).
         self.pipelined_windows = 0
+        # Fused claims actually taken (>1 sub-window in one dispatch) and
+        # the largest fused batch seen.
+        self.fused_dispatches = 0
+        self.max_fused_k = 1
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="predicate-batcher"
         )
@@ -316,6 +329,17 @@ class PredicateBatcher:
             fut = getattr(handle, "blob_future", None)
             return [fut] if fut is not None else []
 
+        def inflight_dispatches() -> int:
+            """Pipeline depth in DEVICE ROUND TRIPS: every sub-window of
+            one fused dispatch shares its umbrella's dispatch_id, so a
+            fused batch of K windows counts ONCE against pipeline_depth —
+            K tickets, one in-flight decision pull."""
+            ids = set()
+            for t, _ in pending:
+                did = getattr(t.handle, "dispatch_id", None)
+                ids.add(did if did is not None else id(t))
+            return len(ids)
+
         while True:
             with self._cv:
                 while not self._queue and not self._stopped and not pending:
@@ -371,8 +395,12 @@ class PredicateBatcher:
                         entry[1].set()
                     self._queue.clear()
                     return
-                batch = self._queue[: self._max_window]
-                del self._queue[: self._max_window]
+                # Fused claim: take up to fuse-windows x max-window of the
+                # backlog; anything past one window's worth splits into
+                # sub-windows dispatched as ONE fused device program.
+                claim = self._max_window * self._fuse_windows
+                batch = self._queue[:claim]
+                del self._queue[:claim]
                 if batch and len(self.claim_log) < self.CLAIM_LOG_CAP:
                     self.claim_log.append((
                         len(batch), len(self._queue), len(pending),
@@ -388,33 +416,42 @@ class PredicateBatcher:
                         self._busy_until = (
                             _time.monotonic() + self._busy_ttl_s
                         )
-            new_ticket = None
+            dispatched: list = []
             if batch:
+                sub_batches = [
+                    batch[i : i + self._max_window]
+                    for i in range(0, len(batch), self._max_window)
+                ]
                 try:
-                    new_ticket = self._dispatch_window(batch)
+                    dispatched = self._dispatch_batches(sub_batches)
                 except PipelineDrainRequired:
                     # Topology changed under in-flight windows: apply them
                     # first, then the fresh full upload is safe.
                     complete_all()
                     try:
-                        new_ticket = self._dispatch_window(batch)
+                        dispatched = self._dispatch_batches(sub_batches)
                     except Exception as exc:
                         self._fail_batch(batch, exc)
                 except Exception as exc:
                     self._fail_batch(batch, exc)
-            if new_ticket is not None:
-                self._last_had_solve = new_ticket.handle is not None
+            if dispatched:
+                self._last_had_solve = any(
+                    t.handle is not None for t, _ in dispatched
+                )
+            for new_ticket, sub in dispatched:
                 if new_ticket.handle is None:
                     # No dispatched device solve (lone request -> solo path,
                     # or a batch that didn't window): its serve must observe
                     # every earlier window's reservations, and there is no
-                    # fetch to overlap — drain, then serve now.
+                    # fetch to overlap — drain, then serve now. (Inside a
+                    # fused claim this drains the group's earlier views —
+                    # one umbrella fetch — before the solo serve.)
                     complete_all()
-                    self._complete_window((new_ticket, batch))
+                    self._complete_window((new_ticket, sub))
                 else:
                     if pending:
                         self.pipelined_windows += 1
-                    pending.append((new_ticket, batch))
+                    pending.append((new_ticket, sub))
                     # Wake the loop the moment this window's decision pulls
                     # land (every partition's, on the multi-device engine),
                     # so its complete never waits on a cv timeout.
@@ -422,10 +459,11 @@ class PredicateBatcher:
                         fut.add_done_callback(lambda _f: self._notify())
             # Heads whose pull already landed complete at zero cost, and
             # the depth bound backpressures (blocking complete) when the
-            # pipeline is full.
+            # pipeline is full — counted in DISPATCHES, so a fused batch
+            # of K windows occupies one depth slot, not K.
             while pending and head_ready():
                 complete_head()
-            if len(pending) >= self._pipeline_depth:
+            while pending and inflight_dispatches() >= self._pipeline_depth:
                 complete_head()
             if not batch and pending and not self._queue:
                 head = pending[0][0]
@@ -453,6 +491,34 @@ class PredicateBatcher:
     def _notify(self) -> None:
         with self._cv:
             self._cv.notify_all()
+
+    def _dispatch_batches(self, sub_batches):
+        """Dispatch one claim: a single window (the classic path), or a
+        FUSED group of K sub-windows solved by one device dispatch
+        (extender.predicate_windows_dispatch). Returns [(ticket, batch)]
+        in dispatch order — completions stay strictly FIFO."""
+        if len(sub_batches) == 1:
+            return [(self._dispatch_window(sub_batches[0]), sub_batches[0])]
+        from spark_scheduler_tpu.tracing import tracer
+
+        with tracer().span(
+            "predicate-window-fused",
+            windows=len(sub_batches),
+            requests=sum(len(s) for s in sub_batches),
+        ):
+            tickets = self._extender.predicate_windows_dispatch(
+                [[e[0] for e in sub] for sub in sub_batches]
+            )
+        # Stats AFTER the dispatch landed: a PipelineDrainRequired retry
+        # re-enters this method for the same claim and must not count the
+        # aborted attempt as a served fused dispatch.
+        self.fused_dispatches += 1
+        self.max_fused_k = max(self.max_fused_k, len(sub_batches))
+        if self._registry is not None:
+            self._registry.histogram(
+                "foundry.spark.scheduler.predicate.fused.windows"
+            ).update(len(sub_batches))
+        return list(zip(tickets, sub_batches))
 
     def _dispatch_window(self, batch):
         from spark_scheduler_tpu.tracing import tracer
@@ -529,6 +595,9 @@ class PredicateBatcher:
             "requests_served": self.requests_served,
             "max_window_seen": self.max_window_seen,
             "pipelined_windows": self.pipelined_windows,
+            "fuse_windows": self._fuse_windows,
+            "fused_dispatches": self.fused_dispatches,
+            "max_fused_k": self.max_fused_k,
             "queue_depth": self.queue_depth(),
             "mean_window": (
                 round(self.requests_served / self.windows_served, 2)
@@ -656,6 +725,10 @@ class SchedulerHTTPServer:
             # With a device pool, keep at least pool-size windows in
             # flight so every slot can hold work.
             pipeline_depth=max(3, getattr(app.solver, "pool_size", 1)),
+            # Fused multi-window dispatch (`solver.fuse-windows` /
+            # --fuse-windows): deep backlogs ride one device round trip
+            # per K windows instead of one each.
+            fuse_windows=getattr(cfg, "solver_fuse_windows", 1),
         )
         self.telemetry = TransportTelemetry(self.transport_name)
         self.routes = SchedulerRoutes(self)

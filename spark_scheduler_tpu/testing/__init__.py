@@ -7,3 +7,4 @@ from spark_scheduler_tpu.testing.harness import (  # noqa: F401
     static_allocation_spark_pods,
     dynamic_allocation_spark_pods,
 )
+from spark_scheduler_tpu.testing.rtt_shim import SimulatedRTT  # noqa: F401

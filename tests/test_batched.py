@@ -396,6 +396,103 @@ def test_strict_fifo_blocking():
     assert bool(got2.admitted[2])
 
 
+def _random_window_batch(rng, c, n_segments, pad_to=None):
+    """A segmented WINDOW batch: each segment is 1-3 rows (hypothetical
+    prefix + committing request row), with per-row candidate/domain
+    masks — the shape core/solver.py pack_window dispatches."""
+    n = c.available.shape[0]
+    drv, exc, counts, skip, cand, dom, commit, reset = (
+        [], [], [], [], [], [], [], [],
+    )
+    for _ in range(n_segments):
+        seg_rows = int(rng.integers(1, 4))
+        cand_mask = rng.random(n) < 0.8
+        dom_mask = rng.random(n) < 0.9
+        for j in range(seg_rows):
+            d = rng.integers(1, 5, size=3).astype(np.int32)
+            e = rng.integers(1, 5, size=3).astype(np.int32)
+            d[2] = e[2] = 0
+            drv.append(d)
+            exc.append(e)
+            counts.append(int(rng.integers(1, 5)))
+            skip.append(bool(rng.random() < 0.4))
+            cand.append(cand_mask)
+            dom.append(dom_mask)
+            commit.append(j == seg_rows - 1)
+            reset.append(j == 0)
+    return make_app_batch(
+        np.stack(drv), np.stack(exc), np.asarray(counts, np.int32),
+        pad_to=pad_to, skippable=skip,
+        driver_cand=np.stack(cand), domain=np.stack(dom),
+        commit=commit, reset=reset,
+    )
+
+
+def test_fuse_app_batches_matches_sequential_carry():
+    """The fused multi-window identity at the ops layer: ONE scan over
+    fuse_app_batches(K windows) == K sequential batched_fifo_pack calls
+    with available_after threaded between them, row for row — including
+    when the input batches carry padding rows that fusing must strip."""
+    import dataclasses
+
+    from spark_scheduler_tpu.ops.batched import fuse_app_batches
+
+    rng = np.random.default_rng(21)
+    c = random_cluster(rng, 24)
+    batches = [
+        _random_window_batch(rng, c, 3, pad_to=None),
+        _random_window_batch(rng, c, 2, pad_to=9),  # padding rows stripped
+        _random_window_batch(rng, c, 4, pad_to=None),
+    ]
+
+    # Sequential: thread the committed base across the K windows.
+    cur = c
+    seq = []
+    for b in batches:
+        out = batched_fifo_pack(
+            cur, b, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES
+        )
+        valid = np.asarray(b.app_valid)
+        for i in np.flatnonzero(valid):
+            seq.append(
+                (
+                    int(out.driver_node[i]),
+                    [int(x) for x in np.asarray(out.executor_nodes[i])],
+                    bool(out.admitted[i]),
+                    bool(out.packed[i]),
+                )
+            )
+        cur = dataclasses.replace(cur, available=out.available_after)
+    seq_after = np.asarray(cur.available)
+
+    fused = fuse_app_batches(batches)
+    out = batched_fifo_pack(
+        c, fused, fill="tightly-pack", emax=EMAX, num_zones=NUM_ZONES
+    )
+    got = [
+        (
+            int(out.driver_node[i]),
+            [int(x) for x in np.asarray(out.executor_nodes[i])],
+            bool(out.admitted[i]),
+            bool(out.packed[i]),
+        )
+        for i in np.flatnonzero(np.asarray(fused.app_valid))
+    ]
+    assert got == seq
+    np.testing.assert_array_equal(
+        np.asarray(out.available_after), seq_after
+    )
+
+
+def test_fuse_app_batches_requires_segmented():
+    from spark_scheduler_tpu.ops.batched import fuse_app_batches
+
+    rng = np.random.default_rng(2)
+    plain = random_apps(rng, 3)
+    with pytest.raises(ValueError, match="segmented"):
+        fuse_app_batches([plain])
+
+
 def test_sharded_matches_unsharded():
     rng = np.random.default_rng(3)
     c = random_cluster(rng, 64)  # divisible by the 8-device "nodes" axis

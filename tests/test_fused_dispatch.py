@@ -1,0 +1,517 @@
+"""Fused multi-window device dispatch on resident carry state.
+
+The engine (core/solver.py pack_windows_dispatch + extender
+predicate_windows_dispatch + the PredicateBatcher's fused claim) solves K
+queued serving windows in ONE device program — one h2d of K window blobs,
+one jitted dispatch, one d2h of K placements — with the committed base
+carried on-device between windows. These tests pin:
+
+  - fused K-window decisions BYTE-IDENTICAL to sequential single-window
+    dispatch across randomized usage churn, K in {1, 2, 4, 8}, with and
+    without domain partitioning (device pool);
+  - the RTT amortization property, structurally, via the simulated-RTT
+    device shim (testing/rtt_shim.py): K fused windows fire ONE h2d and
+    ONE d2h where K sequential dispatches fire K each;
+  - restart-leak hygiene: close()/discard_pipeline() release the fused
+    [K, ...] staging buffers and cancel queued work, and a later fetch of
+    a released dispatch fails fast;
+  - the non-ICI node-shards startup warning;
+  - tier-1 smoke: a 2-device pool server with fuse-windows=4 boots,
+    serves a concurrent burst, and exports the
+    foundry.spark.scheduler.solver.dispatch.* gauges at /metrics with
+    fused_k/dispatch_id on the flight-recorder records.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.core.extender import ExtenderArgs
+from spark_scheduler_tpu.core.solver import (
+    FusedWindowView,
+    PlacementSolver,
+    WindowRequest,
+)
+from spark_scheduler_tpu.models.kube import Node, ZONE_LABEL
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    INSTANCE_GROUP_LABEL,
+    new_node,
+    static_allocation_spark_pods,
+)
+from spark_scheduler_tpu.testing.rtt_shim import SimulatedRTT
+
+ONE = Resources.from_quantities("1", "1Gi")
+TWO = Resources.from_quantities("2", "2Gi")
+
+
+def _nodes(n, groups=1):
+    out = []
+    for i in range(n):
+        labels = {ZONE_LABEL: f"z{i % 2}"}
+        out.append(
+            Node(
+                name=f"n{i:03d}",
+                allocatable=Resources.from_quantities(
+                    "8", "8Gi", "1", round_up=False
+                ),
+                labels=labels,
+            )
+        )
+    return out
+
+
+def _random_windows(rng, nodes, k, per, *, domains=None, fifo_rows=False):
+    """K windows of `per` WindowRequests each. `domains` = list of
+    disjoint node-name lists to cycle through (the partition topology);
+    fifo_rows adds hypothetical earlier-driver prefixes."""
+    names = [n.name for n in nodes]
+    windows = []
+    r = 0
+    for _ in range(k):
+        reqs = []
+        for _ in range(per):
+            rows = []
+            if fifo_rows:
+                for _ in range(int(rng.integers(0, 3))):
+                    rows.append(
+                        (ONE, ONE, int(rng.integers(1, 3)),
+                         bool(rng.random() < 0.5))
+                    )
+            res = TWO if rng.random() < 0.3 else ONE
+            rows.append((res, ONE, int(rng.integers(1, 4)), False))
+            if domains is not None:
+                dom = domains[r % len(domains)]
+                cand = dom
+            else:
+                dom, cand = None, names
+            reqs.append(
+                WindowRequest(
+                    rows=rows,
+                    driver_candidate_names=cand,
+                    domain_node_names=dom,
+                )
+            )
+            r += 1
+        windows.append(reqs)
+    return windows
+
+
+def _random_usage(rng, nodes):
+    """Randomized external churn: a usage map debiting a few nodes."""
+    usage = {}
+    for n in nodes:
+        if rng.random() < 0.3:
+            usage[n.name] = Resources.from_quantities(
+                str(int(rng.integers(1, 4))), "1Gi"
+            )
+    return usage
+
+
+def _run_sequential(solver, nodes, batches, usages, strategy):
+    """The serving loop's own order: inside a batch, dispatch every window
+    back-to-back (pipelined — the next build applies zero external delta),
+    then fetch all; churn lands between batches."""
+    out = []
+    for usage, wins in zip(usages, batches):
+        handles = []
+        for w in wins:
+            t = solver.build_tensors_pipelined(nodes, usage, {})
+            handles.append(solver.pack_window_dispatch(strategy, t, w))
+        for h in handles:
+            out.extend(solver.pack_window_fetch(h))
+    return out
+
+
+def _run_fused(solver, nodes, batches, usages, strategy):
+    out = []
+    for usage, wins in zip(usages, batches):
+        t = solver.build_tensors_pipelined(nodes, usage, {})
+        views = solver.pack_windows_dispatch(strategy, t, wins)
+        assert all(isinstance(v, FusedWindowView) for v in views)
+        assert len({v.dispatch_id for v in views}) == 1
+        for v in views:
+            out.extend(solver.pack_window_fetch(v))
+    return out
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 8])
+def test_fused_matches_sequential_with_churn(k):
+    rng = np.random.default_rng(100 + k)
+    nodes = _nodes(16)
+    n_batches = 3
+    batches = [
+        _random_windows(rng, nodes, k, 2, fifo_rows=True)
+        for _ in range(n_batches)
+    ]
+    usages = [{}] + [_random_usage(rng, nodes) for _ in range(n_batches - 1)]
+
+    seq = _run_sequential(
+        PlacementSolver(use_native=False), nodes, batches, usages,
+        "tightly-pack",
+    )
+    fused = _run_fused(
+        PlacementSolver(use_native=False), nodes, batches, usages,
+        "tightly-pack",
+    )
+    assert len(seq) == len(fused) == n_batches * k * 2
+    for i, (a, b) in enumerate(zip(seq, fused)):
+        assert a == b, f"decision {i} diverged: {a} vs {b}"
+
+
+def test_fused_matches_sequential_single_az_strategy():
+    """The single-AZ plug-board strategies ride the same segmented scan —
+    one fused case pins them too."""
+    rng = np.random.default_rng(7)
+    nodes = _nodes(12)
+    batches = [_random_windows(rng, nodes, 4, 2)]
+    seq = _run_sequential(
+        PlacementSolver(use_native=False), nodes, batches, [{}],
+        "single-az-tightly-pack",
+    )
+    fused = _run_fused(
+        PlacementSolver(use_native=False), nodes, batches, [{}],
+        "single-az-tightly-pack",
+    )
+    assert seq == fused
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_fused_pooled_partitioned_matches_single_device(k):
+    """Fused dispatch on a 2-slot device pool, windows pinned to two
+    disjoint instance-group domains (the partition topology): decisions
+    byte-identical to the sequential single-device path."""
+    rng = np.random.default_rng(30 + k)
+    nodes = _nodes(16)
+    half = [n.name for n in nodes[:8]], [n.name for n in nodes[8:]]
+    batches = [
+        _random_windows(rng, nodes, k, 2, domains=half) for _ in range(2)
+    ]
+    usages = [{}, _random_usage(rng, nodes)]
+    seq = _run_sequential(
+        PlacementSolver(use_native=False), nodes, batches, usages,
+        "tightly-pack",
+    )
+    pooled = PlacementSolver(use_native=False, device_pool=2)
+    assert pooled.pool_size == 2
+    fused = _run_fused(pooled, nodes, batches, usages, "tightly-pack")
+    assert seq == fused
+
+
+def test_rtt_shim_amortizes_round_trips():
+    """The structural amortization claim: K sequential window dispatches
+    fire K h2d and K d2h boundaries; ONE fused dispatch of the same K
+    windows fires exactly one of each — same decisions."""
+    rng = np.random.default_rng(5)
+    nodes = _nodes(12)
+    batches = [_random_windows(rng, nodes, 4, 2)]
+
+    shim = SimulatedRTT(rtt_ms=2.0)
+    with shim:
+        seq = _run_sequential(
+            PlacementSolver(use_native=False), nodes, batches, [{}],
+            "tightly-pack",
+        )
+    seq_counts = dict(shim.counts)
+    assert seq_counts["h2d"] == 4
+    assert seq_counts["d2h"] == 4
+
+    shim2 = SimulatedRTT(rtt_ms=2.0)
+    with shim2:
+        fused = _run_fused(
+            PlacementSolver(use_native=False), nodes, batches, [{}],
+            "tightly-pack",
+        )
+    assert shim2.counts["h2d"] == 1
+    assert shim2.counts["d2h"] == 1
+    assert seq == fused
+
+
+def test_close_releases_fused_staging_buffers():
+    """The restart-leak contract extended to fused batches: close() must
+    release the [K, ...] staging blob and fail later fetches fast, even
+    while view handles are still parked outside the solver."""
+    rng = np.random.default_rng(11)
+    nodes = _nodes(8)
+    solver = PlacementSolver(use_native=False)
+    t = solver.build_tensors_pipelined(nodes, {}, {})
+    views = solver.pack_windows_dispatch(
+        "tightly-pack", t, _random_windows(rng, nodes, 3, 1)
+    )
+    owner = views[0].owner
+    solver.close()
+    assert owner.released
+    assert owner.blob is None
+    assert not solver._inflight_futures
+    with pytest.raises(RuntimeError, match="discarded"):
+        solver.pack_window_fetch(views[1])
+
+
+def test_discard_pipeline_releases_fused_staging_buffers():
+    rng = np.random.default_rng(12)
+    nodes = _nodes(8)
+    solver = PlacementSolver(use_native=False)
+    t = solver.build_tensors_pipelined(nodes, {}, {})
+    views = solver.pack_windows_dispatch(
+        "tightly-pack", t, _random_windows(rng, nodes, 2, 1)
+    )
+    solver.discard_pipeline()
+    assert views[0].owner.released
+    assert views[0].owner.blob is None
+    with pytest.raises(RuntimeError, match="discarded"):
+        solver.pack_window_fetch(views[0])
+    # The pipeline rebuilds from host truth and serves fresh windows.
+    t2 = solver.build_tensors_pipelined(nodes, {}, {})
+    views2 = solver.pack_windows_dispatch(
+        "tightly-pack", t2, _random_windows(rng, nodes, 2, 1)
+    )
+    decisions = [d for v in views2 for d in solver.pack_window_fetch(v)]
+    assert all(d.admitted for d in decisions)
+
+
+def test_close_releases_fused_pooled_dispatch():
+    """Pooled fused dispatch: close() cancels part futures and releases
+    per-slot resident state (the PR 4 pool contract, fused path)."""
+    rng = np.random.default_rng(13)
+    nodes = _nodes(16)
+    half = [n.name for n in nodes[:8]], [n.name for n in nodes[8:]]
+    solver = PlacementSolver(use_native=False, device_pool=2)
+    t = solver.build_tensors_pipelined(nodes, {}, {})
+    views = solver.pack_windows_dispatch(
+        "tightly-pack", t, _random_windows(rng, nodes, 2, 2, domains=half)
+    )
+    solver.close()
+    assert views[0].owner.released
+    for slot in solver._pool.slots:
+        assert slot.statics is None and not slot.sub_statics
+    with pytest.raises(RuntimeError):
+        solver.pack_window_fetch(views[0])
+
+
+def test_mesh_warning_on_non_ici_backend():
+    """node-shards > 1 on a CPU backend used to degrade silently
+    (measured 0.5x in PR 4); now it warns at startup. A plain pool of
+    un-sharded devices stays silent."""
+    with pytest.warns(RuntimeWarning, match="node-shards"):
+        PlacementSolver(use_native=False, mesh=(1, 2))
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        PlacementSolver(use_native=False, device_pool=2)
+
+
+def test_extender_fused_windows_dispatch_matches_sequential():
+    """Extender-level equivalence through the full staging path
+    (in-flight dedup, FIFO rows, domains, reservations): a fused 2-window
+    dispatch places every gang exactly where the sequential pipelined
+    dispatch does, and the flight recorder carries fused_k/dispatch_id."""
+
+    def build(fuse):
+        h = Harness(binpack_algo="tightly-pack", fifo=True)
+        h.add_nodes(
+            *[new_node(f"en{i}", zone=f"zone{i % 2}") for i in range(10)]
+        )
+        names = [f"en{i}" for i in range(10)]
+        argss = []
+        for j in range(8):
+            pod = static_allocation_spark_pods(f"fx-{fuse}-{j}", 2)[0]
+            h.add_pods(pod)
+            argss.append(ExtenderArgs(pod=pod, node_names=names))
+        return h, argss
+
+    h_seq, args_seq = build("seq")
+    tickets = [
+        h_seq.extender.predicate_window_dispatch(args_seq[i : i + 4])
+        for i in (0, 4)
+    ]
+    seq_results = [
+        r for t in tickets for r in h_seq.extender.predicate_window_complete(t)
+    ]
+
+    h_fused, args_fused = build("fused")
+    fused_tickets = h_fused.extender.predicate_windows_dispatch(
+        [args_fused[:4], args_fused[4:]]
+    )
+    assert len(fused_tickets) == 2
+    fused_results = [
+        r
+        for t in fused_tickets
+        for r in h_fused.extender.predicate_window_complete(t)
+    ]
+    assert [r.node_names for r in seq_results] == [
+        r.node_names for r in fused_results
+    ]
+    assert all(r.ok for r in fused_results)
+    # Every fused decision shares one dispatch id and reports fused_k=2.
+    recs = h_fused.app.recorder.query(role="driver", limit=16)
+    fused_recs = [r for r in recs if r.get("fused_k")]
+    assert fused_recs and all(r["fused_k"] == 2 for r in fused_recs)
+    assert len({r["dispatch_id"] for r in fused_recs}) == 1
+
+
+def test_extender_fused_dedups_inflight_apps_across_subwindows():
+    """The same app submitted in two sub-windows of one fused claim: the
+    duplicate defers to the post-window solo loop of its own ticket,
+    which serves the reserved node via the idempotent-retry branch —
+    exactly the pipelined cross-window behavior."""
+    h = Harness(binpack_algo="tightly-pack", fifo=False)
+    h.add_nodes(*[new_node(f"dd{i}") for i in range(4)])
+    names = [f"dd{i}" for i in range(4)]
+    pod = static_allocation_spark_pods("fx-dup", 1)[0]
+    h.add_pods(pod)
+    args = ExtenderArgs(pod=pod, node_names=names)
+    other = static_allocation_spark_pods("fx-other", 1)[0]
+    h.add_pods(other)
+    tickets = h.extender.predicate_windows_dispatch(
+        [[args, ExtenderArgs(pod=other, node_names=names)], [args]]
+    )
+    res = [
+        r for t in tickets for r in h.extender.predicate_window_complete(t)
+    ]
+    assert all(r.ok for r in res), res
+    # Both submissions of the duplicate got the SAME reserved node.
+    assert res[0].node_names == res[2].node_names
+
+
+def test_fused_claim_without_drivers_skips_featurize():
+    """An executor-heavy fused claim with no driver anywhere must not pay
+    the shared snapshot/tensor build (or risk a spurious
+    PipelineDrainRequired) — the sequential path gates on driver_ids the
+    same way."""
+    h = Harness(binpack_algo="tightly-pack", fifo=False)
+    h.add_nodes(*[new_node(f"xe{i}") for i in range(4)])
+    names = [f"xe{i}" for i in range(4)]
+    # Two sub-windows of non-spark pods (roles resolve to neither driver
+    # nor executor): no device work should be provoked.
+    from spark_scheduler_tpu.models.kube import Container, Pod
+
+    def plain(name):
+        p = Pod(
+            name=name, namespace="namespace",
+            containers=[Container(requests=ONE)],
+        )
+        h.add_pods(p)
+        return ExtenderArgs(pod=p, node_names=names)
+
+    before = dict(h.app.solver.device_state_stats)
+    tickets = h.extender.predicate_windows_dispatch(
+        [[plain("px-0"), plain("px-1")], [plain("px-2"), plain("px-3")]]
+    )
+    assert all(t.handle is None for t in tickets)
+    assert h.app.solver.device_state_stats == before
+    res = [
+        r for t in tickets for r in h.extender.predicate_window_complete(t)
+    ]
+    assert all(r.outcome == "failure-non-spark-pod" for r in res)
+
+
+def test_server_smoke_fused_pool_exports_dispatch_gauges():
+    """Tier-1 smoke: 2-device CPU pool + fuse-windows=4 server boots,
+    serves a concurrent burst (the simulated-RTT shim keeps windows in
+    flight long enough for the backlog to fuse), and the
+    foundry.spark.scheduler.solver.dispatch.* series reach /metrics."""
+    from spark_scheduler_tpu.metrics import MetricRegistry, SchedulerMetrics
+    from spark_scheduler_tpu.server.app import build_scheduler_app
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+    from spark_scheduler_tpu.server.kube_io import pod_to_k8s
+    from spark_scheduler_tpu.store.backend import InMemoryBackend
+
+    backend = InMemoryBackend()
+    group_names = {}
+    for g in range(2):
+        group_names[g] = []
+        for i in range(6):
+            n = new_node(
+                f"fg{g}-n{i}", zone=f"zone{i % 2}", instance_group=f"fgroup-{g}"
+            )
+            backend.add_node(n)
+            group_names[g].append(n.name)
+    registry = MetricRegistry()
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=True,
+            sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+            solver_device_pool=2,
+            solver_fuse_windows=4,
+            predicate_max_window=2,
+        ),
+        metrics=SchedulerMetrics(registry, INSTANCE_GROUP_LABEL),
+    )
+    assert app.solver.pool_size == 2
+    server = SchedulerHTTPServer(
+        app, registry, host="127.0.0.1", port=0, request_timeout_s=120.0
+    )
+    server.start()
+    shim = SimulatedRTT(rtt_ms=0.0, h2d_ms=25.0, d2h_ms=50.0)
+    shim.install()
+    n_clients = 12
+    errors: list = []
+    results = [None] * n_clients
+
+    def client(i):
+        try:
+            g = i % 2
+            pod = static_allocation_spark_pods(
+                f"fsrv-{i}", 2, instance_group=f"fgroup-{g}"
+            )[0]
+            backend.add_pod(pod)
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", server.port, timeout=120
+            )
+            body = json.dumps(
+                {"Pod": pod_to_k8s(pod), "NodeNames": group_names[g]}
+            ).encode()
+            conn.request("POST", "/predicates", body=body)
+            results[i] = json.loads(conn.getresponse().read())
+            conn.close()
+        except Exception as exc:  # surfaced after join
+            errors.append(exc)
+
+    try:
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.002)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        for i, r in enumerate(results):
+            assert r and r.get("NodeNames"), (i, r)
+            assert r["NodeNames"][0] in group_names[i % 2]
+        assert server.batcher.fused_dispatches >= 1, server.batcher.stats()
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", server.port, timeout=60
+        )
+        conn.request("GET", "/metrics")
+        snap = json.loads(conn.getresponse().read())
+        conn.close()
+        prefix = "foundry.spark.scheduler.solver.dispatch."
+        dispatch_series = sorted(
+            name for name in snap if name.startswith(prefix)
+        )
+        assert prefix + "fused.k" in dispatch_series, sorted(snap)
+        assert prefix + "amortized.rtt.ms" in dispatch_series
+        assert prefix + "overlap.occupancy" in dispatch_series
+        # Flight-recorder records of the fused windows carry the grouping.
+        recs = app.recorder.query(role="driver", limit=64)
+        assert any((r.get("fused_k") or 1) > 1 for r in recs)
+    finally:
+        shim.uninstall()
+        server.stop()
+    # stop() -> solver.close(): fused staging + pool replicas released.
+    assert app.solver._pipe is None
+    for slot in app.solver._pool.slots:
+        assert slot.statics is None and not slot.sub_statics
