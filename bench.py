@@ -444,7 +444,9 @@ def bench_config6_beyond_baseline(rng):
     )
 
 
-def _serving_fixture(n_nodes=500, max_window=None, transport="threaded"):
+def _serving_fixture(
+    n_nodes=500, max_window=None, transport="threaded", ingest="python",
+):
     _enable_compile_cache()
     from spark_scheduler_tpu.server.app import build_scheduler_app
     from spark_scheduler_tpu.server.config import InstallConfig
@@ -471,7 +473,7 @@ def _serving_fixture(n_nodes=500, max_window=None, transport="threaded"):
     # off: a bench must measure the backlog, not refuse it.
     server = SchedulerHTTPServer(
         app, host="127.0.0.1", port=0, request_timeout_s=600.0,
-        transport=transport, shed_queue_depth=0,
+        transport=transport, ingest=ingest, shed_queue_depth=0,
     )
     server.start()
     return backend, app, server, node_names
@@ -543,7 +545,7 @@ def _recorder_phase_stats(app) -> dict:
     return out
 
 
-def bench_serving_http(rng, transport="threaded"):
+def bench_serving_http(rng, transport="threaded", ingest="python"):
     """Wall-clock p50 of the SERVED path with a SINGLE sequential client:
     POST /predicates -> extender -> batched solver -> reservation
     write-back, over a 500-node cluster. Includes host tensor deltas,
@@ -554,7 +556,10 @@ def bench_serving_http(rng, transport="threaded"):
 
     from spark_scheduler_tpu.testing.harness import static_allocation_spark_pods
 
-    backend, app, server, node_names = _serving_fixture(transport=transport)
+    backend, app, server, node_names = _serving_fixture(
+        transport=transport, ingest=ingest
+    )
+    ingest_lane = server.ingest_name  # post-degrade: what actually served
     conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=60)
     latencies_ms = []
     n_requests, warmup = 40, 6
@@ -576,6 +581,8 @@ def bench_serving_http(rng, transport="threaded"):
         server.stop()
     p50 = float(np.percentile(latencies_ms, 50))
     suffix = "" if transport == "threaded" else f"_{transport}"
+    if ingest != "python":
+        suffix = f"{suffix}_{ingest}"
     _emit(
         f"serving_http_predicate_p50_ms_500_nodes{suffix}",
         p50,
@@ -583,6 +590,7 @@ def bench_serving_http(rng, transport="threaded"):
         {
             "nodes": 500,
             "transport": transport,
+            "ingest": ingest_lane,
             "requests": len(latencies_ms),
             "p95_ms": round(float(np.percentile(latencies_ms, 95)), 3),
             "path": "HTTP /predicates -> batched admission -> write-back",
@@ -705,7 +713,7 @@ def bench_serving_http_concurrent(rng, transport="threaded"):
     )
 
 
-def bench_serving_http_concurrent_10k(rng, transport="threaded"):
+def bench_serving_http_concurrent_10k(rng, transport="threaded", ingest="python"):
     """VERDICT r4 #1: the SERVED system at north-star scale. Every serving
     metric before r5 was captured at 500 nodes; the 10k-node 26x number was
     kernel-only. This drives 1000 driver gang admissions over HTTP against
@@ -715,7 +723,8 @@ def bench_serving_http_concurrent_10k(rng, transport="threaded"):
     _bench_serving_concurrent(
         rng, n_nodes=10_000, n_clients=100, per_client=5, warmup_rounds=1,
         repeats=2, suffix="10k_nodes", max_window=128,
-        inprocess_control=(transport == "threaded"), transport=transport,
+        inprocess_control=(transport == "threaded" and ingest == "python"),
+        transport=transport, ingest=ingest,
     )
 
 
@@ -738,12 +747,16 @@ def bench_serving_http_concurrent_64c(rng, transport="threaded"):
 def _bench_serving_concurrent(
     rng, *, n_nodes, n_clients, per_client, warmup_rounds, repeats, suffix,
     max_window=None, inprocess_control=False, transport="threaded",
+    ingest="python",
 ):
     if transport != "threaded":
         suffix = f"{suffix}_{transport}"
+    if ingest != "python":
+        suffix = f"{suffix}_{ingest}"
     backend, app, server, node_names = _serving_fixture(
-        n_nodes, max_window=max_window, transport=transport
+        n_nodes, max_window=max_window, transport=transport, ingest=ingest
     )
+    ingest_lane = server.ingest_name  # post-degrade: what actually served
 
     def precompile_window_buckets():
         """Force the device compiles for every window SHAPE BUCKET the run
@@ -885,6 +898,7 @@ def _bench_serving_concurrent(
                 "windows_of": window,
                 "windows": n_windows,
                 "transport": "none",
+                "ingest": "none",
                 "pipelined": True,
                 "fused_k": 1,
                 "path": (
@@ -895,6 +909,7 @@ def _bench_serving_concurrent(
         stats = server.batcher.stats()
         dev_stats = dict(app.solver.device_state_stats)
         phase_stats = _recorder_phase_stats(app)
+        ingest_stats = server.ingest_stats()
         server.stop()  # quiesce before the invariant walk below
     # System-level invariant at this scale: no node over-committed by the
     # reservations the run left behind (reservations + overhead <=
@@ -929,6 +944,10 @@ def _bench_serving_concurrent(
     detail = {
         "nodes": n_nodes,
         "transport": transport,
+        "ingest": ingest_lane,
+        # Zero-copy hit ratio / decode time / fallback count on the
+        # native lane; a lane marker otherwise.
+        "ingest_stats": ingest_stats,
         "overcommitted_nodes": overcommitted,
         "concurrent_clients": n_clients,
         "requests": total,
@@ -1171,11 +1190,80 @@ def bench_transport_rig_ceiling(rng):
             "vs_baseline": vs,
             "detail": {
                 "transport": transport,
+                "ingest": "python",
                 "async_over_threaded": ratio,
                 "clients": 16,
                 "body": "predicate-shaped, 500 node names",
                 "path": "null handler: read body, canned decision",
                 "r05_threaded": 372.4,
+            },
+        }
+        _RESULTS.append(entry)
+        print(json.dumps(entry), flush=True)
+
+
+def bench_ingest_decode(rng):
+    """Ingest hot path in isolation, no server: turn a 10k-name predicate
+    body (~200 KB — the north-star wire shape) into (pod, node_names) via
+    (a) the python lane (json.loads + extender_args_from_k8s), (b) the
+    native JSON fast path, (c) the native binary protocol. CPU-only and
+    seconds-cheap, so the lane A/B lands in every round's artifact even
+    where the full 10k serving sections are solve-bound (this container's
+    CPU backend). Skips to a recorded zero when the toolchain is absent."""
+    from spark_scheduler_tpu import native
+    from spark_scheduler_tpu.server import ingest as ingest_mod
+    from spark_scheduler_tpu.server.kube_io import (
+        extender_args_from_k8s,
+        pod_to_k8s,
+    )
+    from spark_scheduler_tpu.testing.harness import (
+        static_allocation_spark_pods,
+    )
+
+    names = [f"bench-node-{i:05d}" for i in range(10_000)]
+    driver = static_allocation_spark_pods("ingest-bench", 8)[0]
+    pod_raw = pod_to_k8s(driver)
+    body_json = json.dumps({"Pod": pod_raw, "NodeNames": names}).encode()
+    body_bin = ingest_mod.encode_predicate_binary(pod_raw, names)
+    reps = 30
+
+    def timed(fn):
+        fn()  # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps * 1e3  # ms/request
+
+    python_ms = timed(lambda: extender_args_from_k8s(json.loads(body_json)))
+    arms = {"python_json": python_ms}
+    if native.available():
+        codec = ingest_mod.NativeIngestCodec()
+
+        def native_json():
+            assert codec.decode_predicate_body(body_json, binary=False)
+
+        def native_bin():
+            assert codec.decode_predicate_body(body_bin, binary=True)
+
+        arms["native_json"] = timed(native_json)
+        arms["native_binary"] = timed(native_bin)
+    for arm, ms in arms.items():
+        speedup = round(python_ms / ms, 1) if ms else None
+        entry = {
+            "metric": f"ingest_decode_10k_names_ms_{arm}",
+            "value": round(ms, 3),
+            "unit": "ms",
+            # Bar: the python lane itself is the 1.0 reference.
+            "vs_baseline": speedup,
+            "detail": {
+                "names": len(names),
+                "body_bytes": len(
+                    body_bin if arm == "native_binary" else body_json
+                ),
+                "repeats": reps,
+                "speedup_vs_python": speedup,
+                "native_available": native.available(),
+                "path": "predicate body -> (pod, node_names) ticket",
             },
         }
         _RESULTS.append(entry)
@@ -1201,6 +1289,7 @@ def bench_serving_http_executors(rng, transport="threaded"):
     from spark_scheduler_tpu.server.kube_io import pod_to_k8s
 
     backend, app, server, node_names = _serving_fixture(transport=transport)
+    server_ingest_lane = server.ingest_name
     n_apps, execs_per_app, n_workers = 8, 16, 16
     exec_pods = []
     conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=600)
@@ -1285,6 +1374,7 @@ def bench_serving_http_executors(rng, transport="threaded"):
     detail = {
         "nodes": 500,
         "transport": transport,
+        "ingest": server_ingest_lane,
         "executors": len(lats),
         "p95_ms": round(float(np.percentile(lats, 95)), 3),
         "bindings_per_s": round(bps, 1),
@@ -1319,6 +1409,7 @@ def bench_serving_http_executors(rng, transport="threaded"):
             "windows_of": window,
             "executors": len(rest),
             "transport": "none",
+            "ingest": "none",
             "path": "predicate_window_dispatch/complete, no HTTP framing",
             "target": "VERDICT r4 #2: >= 500 bindings/s",
         },
@@ -1524,6 +1615,8 @@ def bench_serving_inprocess(rng):
             f"{out.stderr[-800:]}"
         )
     data = json.loads(lines[-1])
+    data.setdefault("transport", "none")
+    data.setdefault("ingest", "none")  # in-process: no serving lane in play
     p50 = data["p50_ms"]
     _record(
         "serving_inprocess_predicate_p50_ms_500_nodes",
@@ -2020,8 +2113,14 @@ def main() -> None:
     # Transport A/B headline: null-handler rig ceiling per transport
     # (pure CPU HTTP; cheap, and the async >= 2x threaded bar lives here).
     guarded("transport_rig_ceiling", bench_transport_rig_ceiling, rng)
+    # Ingest-lane decode A/B (CPU-only, seconds): json.loads vs the native
+    # JSON fast path vs the binary protocol on a 10k-name body.
+    guarded("ingest_decode", bench_ingest_decode, rng)
     guarded("serving_http", bench_serving_http, rng)
     guarded("serving_http_async", bench_serving_http, rng, "async")
+    guarded(
+        "serving_http_native", bench_serving_http, rng, "async", "native"
+    )
     # Flight-recorder overhead: in-process on-vs-off control pair, cheap,
     # before the long concurrent benches heat the box.
     guarded("recorder_overhead", bench_recorder_overhead, rng)
@@ -2063,6 +2162,20 @@ def main() -> None:
     guarded(
         "serving_http_concurrent_10k_async",
         bench_serving_http_concurrent_10k, rng, "async",
+    )
+    # Native zero-copy ingest A/B at scale (ROADMAP Open item 1): the same
+    # 10k-node drive on the native lane, both transports, against the
+    # in-process control the threaded/python arm above emits — the
+    # HTTP-vs-in-process gap closer (bar: >= 0.8x in-process). Skips to a
+    # recorded zero-value section on toolchain-less hosts (the fixture
+    # degrades with a RuntimeWarning and the `ingest` field says python).
+    guarded(
+        "serving_http_concurrent_10k_native",
+        bench_serving_http_concurrent_10k, rng, "threaded", "native",
+    )
+    guarded(
+        "serving_http_concurrent_10k_async_native",
+        bench_serving_http_concurrent_10k, rng, "async", "native",
     )
     if emit_config5 is not None:
         emit_config5()  # north star — the headline, measured up top

@@ -45,6 +45,16 @@ def main(argv=None) -> int:
         " install config's server.transport",
     )
     srv.add_argument(
+        "--ingest",
+        choices=("python", "native"),
+        default=None,
+        help="serving ingest lane: 'python' (json.loads per predicate "
+        "body, default) or 'native' (C++ request framing + zero-copy "
+        "predicate decode via native/runtime.cpp; degrades to python "
+        "with a RuntimeWarning when the toolchain is missing); overrides "
+        "the install config's server.ingest",
+    )
+    srv.add_argument(
         "--device-pool",
         type=int,
         default=None,
@@ -177,6 +187,8 @@ def main(argv=None) -> int:
         config.autoscaler_enabled = True
     if args.transport is not None:
         config.server_transport = args.transport
+    if args.ingest is not None:
+        config.server_ingest = args.ingest
     if args.device_pool is not None:
         # The flag overrides the WHOLE engine config: a configured
         # solver.mesh would otherwise win inside the solver and make

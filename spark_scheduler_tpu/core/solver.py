@@ -1333,7 +1333,17 @@ class PlacementSolver:
 
     def candidate_mask(self, tensors, node_names: Sequence[str]) -> np.ndarray:
         n = tensors.available.shape[0]
-        names = tuple(node_names)
+        # Native-ingest tickets (server/ingest.NativeNodeNames) are hashable
+        # by content digest with memcmp equality — key the cache on the
+        # ticket itself so a steady-state request (kube-scheduler resends
+        # the same candidate list every call) hits WITHOUT materializing
+        # its 10k names or hashing a 10k-string tuple; only a cold miss
+        # iterates. Plain lists keep the tuple key.
+        names = (
+            node_names
+            if getattr(node_names, "names_digest", None) is not None
+            else tuple(node_names)
+        )
 
         def _build() -> np.ndarray:
             mask = np.zeros(n, dtype=bool)

@@ -9,10 +9,20 @@ with g++ on first use and caching the artifact under native/build/. Exposes:
   NativeShardedQueue — the write-back queue of store/queue.py with the
                        dedup/shard/blocking semantics implemented in C++
                        (store/queue.go:22-144 parity).
+  IngestConn         — incremental HTTP/1.1 request framer over a
+                       connection-owned C++ buffer (the async transport's
+                       `server.ingest: native` lane).
+  PredicateSlot      — reusable arena slot a predicate body decodes into
+                       (pod JSON span + '\0'-separated candidate-name blob
+                       with offsets and an FNV-1a 64 digest) — the
+                       zero-copy ticket server/ingest.py wraps.
 
 `available()` reports whether the library could be built/loaded; all
 consumers fall back to the pure-Python implementations when it is False, so
-the framework works on toolchain-less hosts.
+the framework works on toolchain-less hosts. A build/load failure is logged
+ONCE (svc1log warn) and remembered in `load_error()` — never raised from
+import or from `available()` — so a missing toolchain degrades the native
+lanes instead of taking the server down.
 """
 
 from __future__ import annotations
@@ -33,6 +43,27 @@ _SO = os.path.join(_REPO_ROOT, "native", "build", "libsched_runtime.so")
 _lib = None
 _lib_lock = threading.Lock()
 _load_failed = False
+_load_error: str | None = None
+
+
+def _note_failure(message: str) -> None:
+    """Remember + log the first build/load failure exactly once. Consumers
+    keep working on the pure-Python lanes; `load_error()` lets the server
+    explain WHY `server.ingest: native` degraded."""
+    global _load_failed, _load_error
+    _load_failed = True
+    if _load_error is not None:
+        return
+    _load_error = message
+    try:
+        from spark_scheduler_tpu.tracing import svc1log
+
+        svc1log().warn(
+            "native runtime unavailable; pure-Python fallbacks in use",
+            error=message,
+        )
+    except Exception:
+        pass
 
 
 def _build() -> bool:
@@ -50,8 +81,54 @@ def _build() -> bool:
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         return True
-    except Exception:
+    except FileNotFoundError:
+        _note_failure(f"compiler not found: {cmd[0]}")
         return False
+    except subprocess.CalledProcessError as exc:
+        tail = (exc.stderr or b"")[-500:].decode(errors="replace")
+        _note_failure(f"native build failed: {tail}")
+        return False
+    except Exception as exc:
+        _note_failure(f"native build failed: {exc!r}")
+        return False
+
+
+class IngestEvent(ctypes.Structure):
+    """Mirror of native/runtime.cpp's IngestEvent: one framed request (or
+    reject / need-more) from the incremental HTTP/1.1 framer. Offsets index
+    the connection buffer (`IngestConn.ptr`), valid until the next
+    `next()` call."""
+
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("status", ctypes.c_int32),
+        ("flags", ctypes.c_int32),
+        ("body_error", ctypes.c_int32),
+        ("err_code", ctypes.c_int32),
+        ("pad_", ctypes.c_int32),
+        ("method_off", ctypes.c_int64),
+        ("method_len", ctypes.c_int64),
+        ("target_off", ctypes.c_int64),
+        ("target_len", ctypes.c_int64),
+        ("head_off", ctypes.c_int64),
+        ("head_len", ctypes.c_int64),
+        ("body_off", ctypes.c_int64),
+        ("body_len", ctypes.c_int64),
+        ("declared_len", ctypes.c_int64),
+        ("parse_ns", ctypes.c_int64),
+    ]
+
+
+# Event kinds.
+EV_NEED_MORE, EV_REQUEST, EV_REJECT = 0, 1, 2
+# Deferred body-error codes (mapped to the routing layer's exceptions).
+BODY_ERR_TRANSFER_ENCODING, BODY_ERR_CONTENT_LENGTH, BODY_ERR_TOO_LARGE = (
+    1, 2, 3,
+)
+# Reject detail codes.
+REJECT_HEADER_TOO_LARGE, REJECT_REQUEST_LINE, REJECT_HEADER_LINE = 1, 2, 3
+# Request flags.
+FLAG_KEEP_ALIVE, FLAG_CLOSE_AFTER, FLAG_PREDICATE = 1, 2, 4
 
 
 def _bind(lib) -> None:
@@ -95,6 +172,50 @@ def _bind(lib) -> None:
     lib.queue_len.restype = i64
     lib.queue_num_buckets.argtypes = [ctypes.c_void_p]
     lib.queue_num_buckets.restype = i64
+    # ---- ingest lane (predicate slots + HTTP framer) ----
+    lib.pslot_create.restype = ctypes.c_void_p
+    lib.pslot_destroy.argtypes = [ctypes.c_void_p]
+    lib.ingest_live_slots.restype = i64
+    lib.predicate_decode_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+    lib.predicate_decode_json.restype = i32
+    lib.predicate_decode_binary.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, i64,
+    ]
+    lib.predicate_decode_binary.restype = i32
+    lib.pslot_pod_ptr.argtypes = [ctypes.c_void_p]
+    lib.pslot_pod_ptr.restype = ctypes.c_void_p
+    lib.pslot_pod_len.argtypes = [ctypes.c_void_p]
+    lib.pslot_pod_len.restype = i64
+    lib.pslot_blob_ptr.argtypes = [ctypes.c_void_p]
+    lib.pslot_blob_ptr.restype = ctypes.c_void_p
+    lib.pslot_blob_len.argtypes = [ctypes.c_void_p]
+    lib.pslot_blob_len.restype = i64
+    lib.pslot_offs_ptr.argtypes = [ctypes.c_void_p]
+    lib.pslot_offs_ptr.restype = ctypes.c_void_p
+    lib.pslot_names_count.argtypes = [ctypes.c_void_p]
+    lib.pslot_names_count.restype = i64
+    lib.pslot_digest.argtypes = [ctypes.c_void_p]
+    lib.pslot_digest.restype = u64
+    lib.pslot_decode_ns.argtypes = [ctypes.c_void_p]
+    lib.pslot_decode_ns.restype = i64
+    lib.pslot_blob_equal.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.pslot_blob_equal.restype = i32
+    lib.ingest_conn_create.argtypes = [i64, i64]
+    lib.ingest_conn_create.restype = ctypes.c_void_p
+    lib.ingest_conn_destroy.argtypes = [ctypes.c_void_p]
+    lib.ingest_conn_feed.argtypes = [ctypes.c_void_p, ctypes.c_char_p, i64]
+    lib.ingest_conn_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(IngestEvent),
+    ]
+    lib.ingest_conn_next.restype = i32
+    lib.ingest_conn_ptr.argtypes = [ctypes.c_void_p]
+    lib.ingest_conn_ptr.restype = ctypes.c_void_p
+    lib.ingest_conn_decode_json.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.ingest_conn_decode_json.restype = i32
+    lib.ingest_conn_decode_binary.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.ingest_conn_decode_binary.restype = i32
 
 
 def _load():
@@ -121,13 +242,27 @@ def _load():
             lib = ctypes.CDLL(_SO)
             _bind(lib)
             _lib = lib
-        except OSError:
-            _load_failed = True
+        except OSError as exc:
+            _note_failure(f"failed to load {_SO}: {exc}")
     return _lib
 
 
 def available() -> bool:
     return _load() is not None
+
+
+def load_error() -> str | None:
+    """Why the native runtime is unavailable (None when loaded or not yet
+    attempted)."""
+    _load()
+    return _load_error
+
+
+def live_slot_count() -> int:
+    """Live predicate arena slots (the ingest telemetry's arena-occupancy
+    gauge); 0 when the native runtime is unavailable."""
+    lib = _load()
+    return int(lib.ingest_live_slots()) if lib is not None else 0
 
 
 def _i64p(arr: np.ndarray):
@@ -293,3 +428,124 @@ class NativeShardedQueue:
     @property
     def num_buckets(self) -> int:
         return int(self._lib.queue_num_buckets(self._h))
+
+
+class PredicateSlot:
+    """One reusable arena slot a predicate body decodes into. The slot owns
+    the tokenized candidate-name blob and the pod JSON span; it is the
+    TICKET the serving path carries (server/ingest.py wraps it in a
+    NativeNodeNames) — freed when the last reference drops."""
+
+    __slots__ = ("_lib", "_h")
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.pslot_create()
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.pslot_destroy(self._h)
+            self._h = None
+
+    def decode_json(self, body: bytes) -> bool:
+        return bool(
+            self._lib.predicate_decode_json(self._h, body, len(body))
+        )
+
+    def decode_binary(self, body: bytes) -> bool:
+        return bool(
+            self._lib.predicate_decode_binary(self._h, body, len(body))
+        )
+
+    @property
+    def names_count(self) -> int:
+        return int(self._lib.pslot_names_count(self._h))
+
+    @property
+    def digest(self) -> int:
+        return int(self._lib.pslot_digest(self._h))
+
+    @property
+    def decode_ns(self) -> int:
+        return int(self._lib.pslot_decode_ns(self._h))
+
+    def pod_json(self) -> bytes:
+        n = self._lib.pslot_pod_len(self._h)
+        if not n:
+            return b"{}"
+        return ctypes.string_at(self._lib.pslot_pod_ptr(self._h), n)
+
+    def names_blob(self) -> bytes:
+        n = self._lib.pslot_blob_len(self._h)
+        if not n:
+            return b""
+        return ctypes.string_at(self._lib.pslot_blob_ptr(self._h), n)
+
+    def name_at(self, i: int) -> str:
+        count = self.names_count
+        if not 0 <= i < count:
+            raise IndexError(i)
+        offs = ctypes.cast(
+            self._lib.pslot_offs_ptr(self._h),
+            ctypes.POINTER(ctypes.c_int32),
+        )
+        start, end = offs[i], offs[i + 1] - 1  # exclude the '\0'
+        return ctypes.string_at(
+            self._lib.pslot_blob_ptr(self._h) + start, end - start
+        ).decode("utf-8")
+
+    def blob_equal(self, other: "PredicateSlot") -> bool:
+        return bool(self._lib.pslot_blob_equal(self._h, other._h))
+
+
+class IngestConn:
+    """Per-connection incremental HTTP/1.1 framer (the native ingest lane's
+    transport half). `feed` appends received bytes; `next` returns the next
+    IngestEvent — offsets valid until the FOLLOWING `next` call, which
+    reclaims the consumed prefix. `decode_into` tokenizes the last framed
+    request's body straight from the connection buffer into a slot (the
+    body bytes never materialize as a Python object)."""
+
+    __slots__ = ("_lib", "_h", "_ev")
+
+    def __init__(self, max_body_bytes: int | None, max_header_bytes: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._h = lib.ingest_conn_create(
+            -1 if max_body_bytes is None else int(max_body_bytes),
+            int(max_header_bytes),
+        )
+        self._ev = IngestEvent()
+
+    def __del__(self):
+        self.close()
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ingest_conn_destroy(self._h)
+            self._h = None
+
+    def feed(self, data: bytes) -> None:
+        self._lib.ingest_conn_feed(self._h, data, len(data))
+
+    def next(self) -> IngestEvent:
+        self._lib.ingest_conn_next(self._h, ctypes.byref(self._ev))
+        return self._ev
+
+    def read(self, off: int, length: int) -> bytes:
+        if not length:
+            return b""
+        return ctypes.string_at(self._lib.ingest_conn_ptr(self._h) + off, length)
+
+    def decode_into(self, slot: PredicateSlot, *, binary: bool) -> bool:
+        fn = (
+            self._lib.ingest_conn_decode_binary
+            if binary
+            else self._lib.ingest_conn_decode_json
+        )
+        return bool(fn(self._h, slot._h))

@@ -269,8 +269,11 @@ class TransportTelemetry:
     gauges under the server prefix) — the same pull discipline as the
     predicate batcher's stats."""
 
-    def __init__(self, transport: str):
+    def __init__(self, transport: str, ingest: str = "python"):
         self.transport = transport
+        # Which ingest lane the server resolved to (post-degrade): rides
+        # the transport snapshot so a scrape shows transport x ingest.
+        self.ingest = ingest
         self._lock = threading.Lock()
         self.open_connections = 0
         self.connections_total = 0
@@ -332,6 +335,7 @@ class TransportTelemetry:
         requests = self.requests_total
         return {
             "transport": self.transport,
+            "ingest": self.ingest,
             "open_connections": self.open_connections,
             "connections_total": self.connections_total,
             "requests_total": requests,

@@ -82,6 +82,13 @@ class InstallConfig:
     # framing and explicit backpressure; see server/transport_async.py).
     # YAML: `server.transport`.
     server_transport: str = "threaded"
+    # Serving ingest lane: "python" (json.loads + dict walk per predicate
+    # body) or "native" (the C++ framer/decoder in native/runtime.cpp:
+    # request framing and the candidate-name bulk never touch Python on
+    # the hot path — see server/ingest.py). Composes with either
+    # transport; degrades to "python" with a RuntimeWarning when the
+    # native runtime cannot be built. YAML: `server.ingest`.
+    server_ingest: str = "python"
     # Largest request body either transport will buffer; bigger bodies are
     # answered 413 with the body drained (keep-alive survives). The 10k-node
     # predicate bodies measure ~200 KB, so 16 MiB is generous headroom.
@@ -283,6 +290,9 @@ class InstallConfig:
             request_timeout_s=_parse_duration(raw.get("request-timeout", 30.0)),
             server_transport=str(
                 server_block.get("transport", raw.get("transport", "threaded"))
+            ),
+            server_ingest=str(
+                server_block.get("ingest", raw.get("ingest", "python"))
             ),
             max_body_bytes=int(
                 server_block.get(

@@ -68,6 +68,14 @@ from spark_scheduler_tpu.server.transport_threaded import (  # noqa: F401
 )
 
 TRANSPORTS = ("threaded", "async")
+# Ingest lanes (`server.ingest`): how a framed predicate body becomes
+# ExtenderArgs — "python" (json.loads + dict walk) or "native" (the C++
+# framer/decoder in native/runtime.cpp emitting zero-copy tickets). See
+# server/ingest.py. The native lane composes with BOTH transports: the
+# async transport swaps its Python parser for the native framer, the
+# threaded transport keeps stdlib framing and routes predicate bodies
+# through the native decoder.
+INGESTS = ("python", "native")
 
 
 class _CallbackEvent:
@@ -624,6 +632,7 @@ def _build_transport(
     max_connections,
     telemetry,
     name: str,
+    ingest_codec=None,
 ):
     if transport == "async":
         from spark_scheduler_tpu.server.transport_async import AsyncTransport
@@ -641,6 +650,7 @@ def _build_transport(
             max_connections=max_connections,
             telemetry=telemetry,
             name=name,
+            ingest_codec=ingest_codec,
         )
     if transport != "threaded":
         raise ValueError(
@@ -675,6 +685,7 @@ class SchedulerHTTPServer:
         debug_routes: bool = False,
         request_log: bool = False,
         transport: str | None = None,
+        ingest: str | None = None,
         max_body_bytes: int | None = None,
         max_connections: int | None = None,
         shed_queue_depth: int | None = None,
@@ -699,6 +710,39 @@ class SchedulerHTTPServer:
         self.transport_name = transport or getattr(
             cfg, "server_transport", "threaded"
         )
+        # Ingest lane: native requested + native runtime unavailable =>
+        # DEGRADE to the python lane with a startup RuntimeWarning (and a
+        # telemetry flag) — never an exception; a toolchain-less host still
+        # serves, just without the zero-copy path.
+        self.ingest_name = ingest or getattr(cfg, "server_ingest", "python")
+        if self.ingest_name not in INGESTS:
+            raise ValueError(
+                f"unknown server ingest {self.ingest_name!r}; "
+                f"expected one of {INGESTS}"
+            )
+        self.ingest_codec = None
+        self._ingest_telemetry = None
+        if self.ingest_name == "native":
+            from spark_scheduler_tpu.server.ingest import try_native_codec
+
+            self.ingest_codec = try_native_codec()
+            if self.ingest_codec is None:
+                import warnings
+
+                from spark_scheduler_tpu import native as _native
+
+                from spark_scheduler_tpu.server.ingest import IngestTelemetry
+
+                warnings.warn(
+                    "server.ingest: native requested but the native runtime "
+                    f"is unavailable ({_native.load_error() or 'not built'}); "
+                    "degrading to the python ingest lane",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                self.ingest_name = "python"
+                self._ingest_telemetry = IngestTelemetry("python")
+                self._ingest_telemetry.degraded = True
         self.max_body_bytes = (
             max_body_bytes
             if max_body_bytes is not None
@@ -730,7 +774,9 @@ class SchedulerHTTPServer:
             # per K windows instead of one each.
             fuse_windows=getattr(cfg, "solver_fuse_windows", 1),
         )
-        self.telemetry = TransportTelemetry(self.transport_name)
+        self.telemetry = TransportTelemetry(
+            self.transport_name, ingest=self.ingest_name
+        )
         self.routes = SchedulerRoutes(self)
         self._transport = _build_transport(
             self.transport_name,
@@ -746,6 +792,7 @@ class SchedulerHTTPServer:
             max_connections=self.max_connections,
             telemetry=self.telemetry,
             name=f"scheduler-http-{self.transport_name}",
+            ingest_codec=self.ingest_codec,
         )
         self.tls = self._transport.tls
 
@@ -753,6 +800,17 @@ class SchedulerHTTPServer:
 
     def transport_stats(self) -> dict:
         return self.telemetry.stats()
+
+    def ingest_stats(self) -> dict:
+        """`foundry.spark.scheduler.server.ingest.*` snapshot: the codec's
+        live counters on the native lane, a degraded/zeroed record when
+        native was requested but unavailable, a plain lane marker on the
+        python lane."""
+        if self.ingest_codec is not None:
+            return self.ingest_codec.stats()
+        if self._ingest_telemetry is not None:
+            return self._ingest_telemetry.stats()
+        return {"ingest": self.ingest_name, "degraded": 0}
 
     def on_queue_shed(self) -> None:
         self.telemetry.on_queue_shed()

@@ -69,6 +69,13 @@ class Request:
     headers: object
     body: bytes = b""
     body_error: Exception | None = None
+    # Native-ingest hand-off: the transport already decoded the predicate
+    # body into a (pod, node_names) ticket — the route must not re-parse.
+    predicate_parsed: object = None
+    # The transport already TRIED the native decoder (hit or miss): on a
+    # miss the route must go straight to the Python parser instead of
+    # re-tokenizing the same ~200 KB body a second time.
+    native_decode_attempted: bool = False
 
     def json(self):
         if self.body_error is not None:
@@ -98,6 +105,89 @@ def text_response(status: int, text: str, content_type: str) -> Response:
 
 _NOT_FOUND = {"error": "not found"}
 
+# Canned hot-path bodies: liveness/readiness probes and 404s are hit every
+# scrape interval (and 404 floods under misconfigured probes); re-running
+# json.dumps per request for a constant payload was pure GIL time. Bytes
+# are json.dumps-identical (pinned by tests/test_ingest_native.py).
+_NOT_FOUND_BODY = json.dumps(_NOT_FOUND).encode()
+_LIVENESS_BODY = json.dumps({"status": "up"}).encode()
+_READY_BODY = json.dumps({"ready": True}).encode()
+_NOT_READY_BODY = json.dumps({"ready": False}).encode()
+# Queue-depth 503s vary only in the depth digit — splice it in.
+_SHED_PRE = b'{"error": "scheduler overloaded", "queue_depth": '
+
+
+def not_found_response() -> Response:
+    return Response(404, _NOT_FOUND_BODY)
+
+
+# ------------------------------------------------- filter-result encoding
+
+# Serialized FailedNodes maps keyed by (candidate-names key, message): a
+# fleet's failure storms repeat the SAME uniform 10k-entry map per
+# candidate list, and json.dumps of that map (~ms at 10k nodes) dominated
+# the failure path. The key is the node_names object itself when it
+# carries a content digest (the native lane's NativeNodeNames — hash is
+# the digest, equality memcmps the blob) or a tuple of the names
+# otherwise; either way a colliding hash cannot alias two lists.
+_FAILED_MAP_CACHE_CAP = 32
+_failed_map_cache = None
+
+
+def _encode_failed_nodes(failed: dict, node_names) -> bytes:
+    payload = None
+    if (
+        node_names is not None
+        and len(failed) == len(node_names) > 8
+    ):
+        vals = iter(failed.values())
+        first = next(vals)
+        if all(v == first for v in vals) and all(
+            a is b or a == b for a, b in zip(failed, node_names)
+        ):
+            global _failed_map_cache
+            if _failed_map_cache is None:
+                from spark_scheduler_tpu.core.lru import LRUCache
+
+                _failed_map_cache = LRUCache(_FAILED_MAP_CACHE_CAP)
+            key_names = (
+                node_names
+                if getattr(node_names, "names_digest", None) is not None
+                else tuple(node_names)
+            )
+            key = (key_names, first)
+            payload = _failed_map_cache.get(key)
+            if payload is None:
+                payload = json.dumps(dict(failed)).encode()
+                _failed_map_cache.put(key, payload)
+            return payload
+    return json.dumps(dict(failed)).encode()
+
+
+def encode_filter_result(result, node_names=None) -> bytes:
+    """ExtenderFilterResult response bytes, byte-identical to
+    `json.dumps(filter_result_to_k8s(result))` (test-pinned) without
+    re-serializing the hot shapes: the success body is a template splice
+    around the decision bytes, and uniform failure maps reuse the cached
+    per-candidate-list fragment."""
+    error = ""
+    if result.outcome == "failure-internal" and result.failed_nodes:
+        error = next(iter(result.failed_nodes.values()))
+    names = ", ".join(json.dumps(n) for n in result.node_names)
+    if result.failed_nodes:
+        failed = _encode_failed_nodes(result.failed_nodes, node_names)
+    else:
+        failed = b"{}"
+    return (
+        b'{"NodeNames": ['
+        + names.encode()
+        + b'], "FailedNodes": '
+        + failed
+        + b', "Error": '
+        + json.dumps(error).encode()
+        + b"}"
+    )
+
 
 class SyncRoutes:
     """Base routing contract both transports drive. Synchronous-only route
@@ -121,10 +211,10 @@ class ConversionRoutes(SyncRoutes):
 
     def handle(self, req: Request) -> Response:
         if req.method == "GET" and req.path == "/status/liveness":
-            return json_response(200, {"status": "up"})
+            return Response(200, _LIVENESS_BODY)
         if req.method == "POST" and req.path == "/convert":
             return _convert(req)
-        return json_response(404, _NOT_FOUND)
+        return not_found_response()
 
 
 def _convert(req: Request) -> Response:
@@ -174,7 +264,7 @@ class SchedulerRoutes(SyncRoutes):
         except Exception as exc:  # route bodies own their error mapping;
             # this is the last-resort 500 (never a dropped connection)
             return json_response(500, {"error": str(exc)})
-        return json_response(404, _NOT_FOUND)
+        return not_found_response()
 
     # ------------------------------------------------------------------ GET
 
@@ -182,10 +272,12 @@ class SchedulerRoutes(SyncRoutes):
         s = self._s
         path = req.path
         if path == "/status/liveness":
-            return json_response(200, {"status": "up"})
+            return Response(200, _LIVENESS_BODY)
         if path == "/status/readiness":
             up = s.ready.is_set()
-            return json_response(200 if up else 503, {"ready": up})
+            return Response(
+                200 if up else 503, _READY_BODY if up else _NOT_READY_BODY
+            )
         if path == "/metrics":
             return self._metrics(req)
         if path == "/debug/traces" and s.debug_routes:
@@ -198,7 +290,7 @@ class SchedulerRoutes(SyncRoutes):
             from spark_scheduler_tpu.observability import debug_state_snapshot
 
             return json_response(200, debug_state_snapshot(s.app))
-        return json_response(404, _NOT_FOUND)
+        return not_found_response()
 
     def _metrics(self, req: Request) -> Response:
         s = self._s
@@ -230,6 +322,14 @@ class SchedulerRoutes(SyncRoutes):
                     if isinstance(v, (int, float))
                 }
             )
+            ingest_stats = getattr(s, "ingest_stats", dict)()
+            extra.update(
+                {
+                    f"foundry.spark.scheduler.server.ingest.{k}": v
+                    for k, v in ingest_stats.items()
+                    if isinstance(v, (int, float))
+                }
+            )
             return text_response(
                 200,
                 render_prometheus(snap, extra_gauges=extra),
@@ -237,6 +337,7 @@ class SchedulerRoutes(SyncRoutes):
             )
         snap["predicate_batcher"] = s.batcher.stats()
         snap["server_transport"] = s.transport_stats()
+        snap["server_ingest"] = getattr(s, "ingest_stats", dict)()
         return json_response(200, snap)
 
     def _debug_decisions(self, req: Request) -> Response:
@@ -279,7 +380,7 @@ class SchedulerRoutes(SyncRoutes):
             return json_response(
                 200 if out_dir else 409, {"profiling": False, "dir": out_dir}
             )
-        return json_response(404, _NOT_FOUND)
+        return not_found_response()
 
     def _profile_start(self, req: Request) -> Response:
         from spark_scheduler_tpu.tracing import start_jax_profile
@@ -326,7 +427,7 @@ class SchedulerRoutes(SyncRoutes):
                 else:
                     s.app.backend.update_pod(pod)
                 return json_response(200, {"applied": pod.name})
-            return json_response(404, _NOT_FOUND)
+            return not_found_response()
         except Exception as exc:
             return json_response(error_code(exc), {"error": str(exc)})
 
@@ -341,15 +442,44 @@ class SchedulerRoutes(SyncRoutes):
                     return json_response(404, {"error": "pod not found"})
                 s.app.backend.delete_pod(pod)
                 return json_response(200, {"deleted": name})
-            return json_response(404, _NOT_FOUND)
+            return not_found_response()
         except Exception as exc:  # e.g. concurrent-delete race
             return json_response(500, {"error": str(exc)})
 
     # ----------------------------------------------------------- predicates
 
     def _parse_predicate(self, req: Request):
+        """(pod, node_names) for POST /predicates, by lane:
+
+          - the async transport's native framer may have decoded the body
+            already (`req.predicate_parsed` — the zero-copy ticket);
+          - a binary-protocol body decodes natively when the codec is
+            loaded, through the pure-Python decoder otherwise;
+          - a JSON body tries the native fast path on the native lane, and
+            ANY deviation falls back to the Python parser below —
+            identical decisions either way, the miss is telemetry.
+        """
+        parsed = req.predicate_parsed
+        if parsed is not None:
+            return parsed
+        if req.body_error is not None:
+            raise req.body_error
+        from spark_scheduler_tpu.server import ingest
         from spark_scheduler_tpu.server.kube_io import extender_args_from_k8s
 
+        codec = None
+        if not req.native_decode_attempted:
+            codec = getattr(self._s, "ingest_codec", None)
+        if ingest.is_binary_content_type(req.headers.get("Content-Type")):
+            if codec is not None:
+                parsed = codec.decode_predicate_body(req.body, binary=True)
+                if parsed is not None:
+                    return parsed
+            return ingest.decode_predicate_binary_py(req.body)
+        if codec is not None:
+            parsed = codec.decode_predicate_body(req.body, binary=False)
+            if parsed is not None:
+                return parsed
         return extender_args_from_k8s(req.json())
 
     def _shed_response(self) -> Response | None:
@@ -364,14 +494,11 @@ class SchedulerRoutes(SyncRoutes):
         depth = s.batcher.queue_depth()  # one lock round-trip per check
         if depth >= threshold:
             s.on_queue_shed()
-            return json_response(
-                503, {"error": "scheduler overloaded", "queue_depth": depth}
-            )
+            return Response(503, _SHED_PRE + str(depth).encode() + b"}")
         return None
 
     @staticmethod
-    def _predicate_ok(pod, result) -> Response:
-        from spark_scheduler_tpu.server.kube_io import filter_result_to_k8s
+    def _predicate_ok(pod, result, node_names=None) -> Response:
         from spark_scheduler_tpu.tracing import pod_safe_params, svc1log
 
         svc1log().info(
@@ -380,7 +507,7 @@ class SchedulerRoutes(SyncRoutes):
             nodes=list(result.node_names),
             **pod_safe_params(pod),
         )
-        return json_response(200, filter_result_to_k8s(result))
+        return Response(200, encode_filter_result(result, node_names))
 
     @staticmethod
     def _predicate_err(pod, exc) -> Response:
@@ -424,7 +551,7 @@ class SchedulerRoutes(SyncRoutes):
                 root.tag("outcome", "failure-internal")
                 return self._predicate_err(pod, exc)
             root.tag("outcome", result.outcome)
-            return self._predicate_ok(pod, result)
+            return self._predicate_ok(pod, result, node_names)
 
     def _predicate_nowait(self, req: Request, respond, schedule_timeout):
         """Event-loop path: no thread parks. The batcher invokes `done`
@@ -484,7 +611,7 @@ class SchedulerRoutes(SyncRoutes):
                     resp = self._predicate_err(pod, exc)
                 else:
                     span.tags["outcome"] = result.outcome
-                    resp = self._predicate_ok(pod, result)
+                    resp = self._predicate_ok(pod, result, node_names)
             tracer().finish_detached(span)
             respond(resp)
 

@@ -31,6 +31,17 @@ pure overhead. This transport replaces them with ONE event loop:
 The loop runs in one daemon thread; `routes.handle_nowait` must never
 block it (the predicate route hands off to the batcher and responds from
 its completion callback via call_soon_threadsafe).
+
+With `server.ingest: native` the Python parser above is replaced per
+connection by the C++ incremental framer (native/runtime.cpp IngestConn):
+received bytes feed a connection-owned C++ buffer, framed-request events
+come back as offset spans, and a POST /predicates body is tokenized
+STRAIGHT OUT of that buffer into a predicate arena slot — the ~200 KB
+candidate-name bulk never materializes as Python objects; the routing
+layer receives the decoded (pod, NativeNodeNames) ticket on the Request.
+Framing strictness (RFC 7230 Content-Length/TE rules, 431/400 rejects,
+413 drain) is the same by construction — the conformance suite in
+tests/test_ingest_native.py runs the same edges against both framers.
 """
 
 from __future__ import annotations
@@ -171,12 +182,18 @@ class _HTTPProtocol(asyncio.Protocol):
         # per-request parse state carried from headers into body/drain
         "_method", "_target", "_headers", "_need", "_body_error",
         "_keep_alive", "_close_after", "_req_t0",
+        "_nconn",  # native framer connection (server.ingest: native)
     )
 
     def __init__(self, t: "AsyncTransport"):
         self._t = t
         self._transport = None
         self._buf = bytearray()
+        self._nconn = (
+            t.ingest_codec.new_conn(t.max_body_bytes, _MAX_HEADER_BYTES)
+            if t.ingest_codec is not None
+            else None
+        )
         self._state = _HEADERS
         self._hdr_scan = 0
         self._shed = False
@@ -229,6 +246,9 @@ class _HTTPProtocol(asyncio.Protocol):
             self._idle_handle = None
         self._closing = True
         self._slots.clear()  # late responds see done-or-gone slots
+        if self._nconn is not None:
+            self._nconn.close()  # release the C++ connection buffer now
+            self._nconn = None
 
     def close(self):
         self._closing = True
@@ -264,8 +284,99 @@ class _HTTPProtocol(asyncio.Protocol):
         tel = self._t.telemetry
         if tel is not None:
             tel.bytes_in += len(data)
+        if self._nconn is not None:
+            self._nconn.feed(data)
+            self._parse_native()
+            return
         self._buf += data
         self._parse()
+
+    # ----------------------------------------------------- native framing
+
+    def _parse_native(self):
+        """Drain framed-request events from the C++ framer — the native
+        twin of `_parse`. Body bytes are copied out ONLY when the route
+        needs them (non-predicate routes, fast-path misses); a predicate
+        body decodes in place into an arena slot."""
+        from spark_scheduler_tpu import native as _n
+        from spark_scheduler_tpu.server.ingest import is_binary_content_type
+
+        conn = self._nconn
+        codec = self._t.ingest_codec
+        tel = self._t.telemetry
+        while not self._closing:
+            ev = conn.next()
+            if ev.kind == _n.EV_NEED_MORE:
+                return
+            if ev.kind == _n.EV_REJECT:
+                msg = {
+                    _n.REJECT_HEADER_TOO_LARGE: "header block too large",
+                    _n.REJECT_REQUEST_LINE: "malformed request line",
+                    _n.REJECT_HEADER_LINE: "malformed header line",
+                }.get(ev.err_code, "malformed request")
+                self._reject_connection(ev.status, msg)
+                return
+            t0 = time.perf_counter()
+            head = conn.read(ev.head_off, ev.head_len)
+            lines = head.decode("latin-1").split("\r\n")
+            headers = Headers()
+            for line in lines[1:]:
+                if not line:
+                    continue
+                name, _, value = line.partition(":")
+                headers.add(name.strip(), value.strip())
+            self._method = conn.read(ev.method_off, ev.method_len).decode(
+                "latin-1"
+            )
+            self._target = conn.read(ev.target_off, ev.target_len).decode(
+                "latin-1"
+            )
+            self._headers = headers
+            self._req_t0 = t0
+            self._keep_alive = bool(ev.flags & _n.FLAG_KEEP_ALIVE)
+            self._close_after = bool(ev.flags & _n.FLAG_CLOSE_AFTER)
+            self._body_error = None
+            stop_after = False
+            if ev.body_error == _n.BODY_ERR_TRANSFER_ENCODING:
+                self._body_error = UnsupportedTransferEncoding(
+                    "Transfer-Encoding not supported; send Content-Length"
+                )
+                stop_after = True  # nothing after an unframed body parses
+            elif ev.body_error == _n.BODY_ERR_CONTENT_LENGTH:
+                self._body_error = UnframeableBody("invalid Content-Length")
+                stop_after = True
+            elif ev.body_error == _n.BODY_ERR_TOO_LARGE:
+                if tel is not None:
+                    tel.on_body_rejected()
+                self._body_error = BodyTooLarge(
+                    f"request body of {ev.declared_len} bytes exceeds "
+                    f"max-body-bytes={self._t.max_body_bytes}"
+                )
+            body = b""
+            parsed = None
+            attempted = False
+            if self._body_error is None and ev.body_len:
+                if ev.flags & _n.FLAG_PREDICATE:
+                    # Zero-copy hand-off: tokenize the body out of the
+                    # connection buffer into a predicate slot; only a
+                    # fast-path miss copies the bytes up for json.loads.
+                    attempted = True
+                    parsed = codec.decode_from_conn(
+                        conn,
+                        binary=is_binary_content_type(
+                            headers.get("Content-Type")
+                        ),
+                    )
+                if parsed is None:
+                    body = conn.read(ev.body_off, ev.body_len)
+            if tel is not None:
+                tel.parse_s += ev.parse_ns / 1e9
+                tel.parse_samples += 1
+            codec.telemetry.on_parse_ns(ev.parse_ns)
+            self._dispatch(body, parsed, attempted)
+            if stop_after:
+                self._closing = True
+                return
 
     def _parse(self):
         buf = self._buf
@@ -401,7 +512,8 @@ class _HTTPProtocol(asyncio.Protocol):
 
     # ------------------------------------------------------------ dispatch
 
-    def _dispatch(self, body: bytes):
+    def _dispatch(self, body: bytes, predicate_parsed=None,
+                  native_decode_attempted=False):
         parsed = urlparse(self._target)
         headers = self._headers
         req = Request(
@@ -411,6 +523,8 @@ class _HTTPProtocol(asyncio.Protocol):
             headers=headers,
             body=body,
             body_error=self._body_error,
+            predicate_parsed=predicate_parsed,
+            native_decode_attempted=native_decode_attempted,
         )
         self._conn_requests += 1
         tel = self._t.telemetry
@@ -554,6 +668,7 @@ class AsyncTransport:
         max_connections: int = 512,
         telemetry=None,
         name: str = "scheduler-http-async",
+        ingest_codec=None,
     ):
         self.routes = routes
         self.request_timeout_s = request_timeout_s
@@ -561,6 +676,10 @@ class AsyncTransport:
         self.max_body_bytes = max_body_bytes
         self.max_connections = max_connections
         self.telemetry = telemetry
+        # Native ingest lane: when set, connections frame via the C++
+        # incremental parser and predicate bodies decode into arena slots
+        # (see _parse_native); None = the Python parser above.
+        self.ingest_codec = ingest_codec
         self._name = name
         self._ssl_ctx = build_server_ssl_context(
             cert_file, key_file, client_ca_files

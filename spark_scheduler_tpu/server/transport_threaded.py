@@ -11,6 +11,12 @@ This transport remains the DEFAULT (`server.transport: threaded`) until a
 benched A/B proves the async event loop's ceiling on the target box
 (bench.py `transport_rig_ceiling`); its thread-per-connection model is also
 the simplest one to reason about under debuggers and profilers.
+
+Ingest lanes: this transport keeps its stdlib socket framing on BOTH
+`server.ingest` lanes — the native lane plugs in downstream, where
+routing._parse_predicate hands predicate bodies to the C++ decoder
+(server/ingest.py) instead of json.loads. The async transport is the one
+that additionally swaps its framing for the native IngestConn.
 """
 
 from __future__ import annotations

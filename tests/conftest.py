@@ -18,6 +18,23 @@ import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# SPARK_SCHEDULER_TEST_INGEST=native runs every server-constructing suite on
+# the native ingest lane (the CI `ingest-native` job leg): tests that do not
+# pass an explicit `ingest=` inherit the override, so the whole parametrized
+# server matrix re-runs against the C++ framer/decoder without duplicating
+# the suites. Tests pinning a specific lane still win (explicit kwarg).
+_TEST_INGEST = os.environ.get("SPARK_SCHEDULER_TEST_INGEST")
+if _TEST_INGEST:
+    import spark_scheduler_tpu.server.http as _http_mod
+
+    _orig_server_init = _http_mod.SchedulerHTTPServer.__init__
+
+    def _ingest_forcing_init(self, *args, **kwargs):
+        kwargs.setdefault("ingest", _TEST_INGEST)
+        _orig_server_init(self, *args, **kwargs)
+
+    _http_mod.SchedulerHTTPServer.__init__ = _ingest_forcing_init
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():

@@ -1,0 +1,675 @@
+"""Cross-lane ingest parity + native framer conformance.
+
+The native ingest lane (`server.ingest: native`) must be INVISIBLE on the
+wire: decisions and response bodies byte-identical to the python lane
+across {python, native} x {threaded, async}, the binary predicate protocol
+equivalent to the JSON schema, the native framer matching the Python
+framer's RFC 7230 edges (malformed frames, oversize-body 413 with
+keep-alive intact, pipelined in-order responses), and a toolchain-less
+host degrading to the python lane with a RuntimeWarning instead of dying.
+
+The native-runtime-dependent tests skip cleanly when g++ is absent; the
+pure-Python pieces (binary codec, response-encoder byte-identity,
+degrade-on-unavailable) always run.
+"""
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+from spark_scheduler_tpu import native
+from spark_scheduler_tpu.core.extender import ExtenderFilterResult
+from spark_scheduler_tpu.metrics import MetricRegistry, SchedulerMetrics
+from spark_scheduler_tpu.server import ingest
+from spark_scheduler_tpu.server.app import build_scheduler_app
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+from spark_scheduler_tpu.server.kube_io import filter_result_to_k8s
+from spark_scheduler_tpu.server.routing import encode_filter_result
+from spark_scheduler_tpu.store.backend import InMemoryBackend
+
+INSTANCE_GROUP_LABEL = "resource_channel"
+GROUP = "batch-medium-priority"
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native runtime not built (g++ absent)"
+)
+
+
+def _k8s_node(name, zone="zone1"):
+    return {
+        "metadata": {
+            "name": name,
+            "labels": {
+                "failure-domain.beta.kubernetes.io/zone": zone,
+                INSTANCE_GROUP_LABEL: GROUP,
+            },
+        },
+        "status": {
+            "allocatable": {"cpu": "8", "memory": "8Gi", "nvidia.com/gpu": "1"},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+
+
+def _k8s_spark_pod(app_id, name, executors=2, cpu="1"):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "ns",
+            "uid": f"uid-{name}",
+            "labels": {"spark-role": "driver", "spark-app-id": app_id},
+            "annotations": {
+                "spark-driver-cpu": cpu,
+                "spark-driver-mem": "1Gi",
+                "spark-executor-cpu": cpu,
+                "spark-executor-mem": "1Gi",
+                "spark-executor-count": str(executors),
+            },
+            "creationTimestamp": "2026-07-29T12:00:00Z",
+        },
+        "spec": {
+            "schedulerName": "spark-scheduler",
+            "nodeSelector": {INSTANCE_GROUP_LABEL: GROUP},
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {"requests": {"cpu": cpu, "memory": "1Gi"}},
+                }
+            ],
+        },
+        "status": {"phase": "Pending"},
+    }
+
+
+def _make_server(transport, ingest_lane, **kw):
+    backend = InMemoryBackend()
+    registry = MetricRegistry()
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=True, sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+        ),
+        metrics=SchedulerMetrics(registry, INSTANCE_GROUP_LABEL),
+    )
+    srv = SchedulerHTTPServer(
+        app, registry, port=0, transport=transport, ingest=ingest_lane, **kw
+    )
+    srv.start()
+    return srv
+
+
+def _request(port, method, path, payload=None, raw=None,
+             content_type="application/json"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=raw if raw is not None else (
+            json.dumps(payload).encode() if payload is not None else None
+        ),
+        method=method,
+        headers={"Content-Type": content_type},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+def _drive_scenario(transport, ingest_lane):
+    """One full serving scenario; returns the raw response bytes of every
+    step (what the parity assertion compares across lanes)."""
+    srv = _make_server(transport, ingest_lane)
+    port = srv.port
+    out = {}
+    try:
+        for i in range(4):
+            _request(port, "PUT", "/state/nodes", _k8s_node(f"n{i}"))
+        names = [f"n{i}" for i in range(4)]
+        # Success: JSON schema.
+        pod = _k8s_spark_pod("app-json", "drv-json")
+        _request(port, "PUT", "/state/pods", pod)
+        out["ok_json"] = _request(
+            port, "POST", "/predicates", {"Pod": pod, "NodeNames": names}
+        )
+        # Success: binary protocol.
+        pod_b = _k8s_spark_pod("app-bin", "drv-bin")
+        _request(port, "PUT", "/state/pods", pod_b)
+        out["ok_binary"] = _request(
+            port, "POST", "/predicates",
+            raw=ingest.encode_predicate_binary(pod_b, names),
+            content_type=ingest.BINARY_CONTENT_TYPE,
+        )
+        # Failure-fit: a driver that can never fit -> uniform failure map
+        # over every candidate (the fragment-cached encoding), twice so
+        # the second hit serves from the cache.
+        big = _k8s_spark_pod("app-big", "drv-big", executors=90, cpu="4")
+        _request(port, "PUT", "/state/pods", big)
+        out["fail_1"] = _request(
+            port, "POST", "/predicates", {"Pod": big, "NodeNames": names}
+        )
+        out["fail_2"] = _request(
+            port, "POST", "/predicates", {"Pod": big, "NodeNames": names}
+        )
+        # Fast-path deviations that must FALL BACK, not diverge: an escaped
+        # node name and the lowercase "nodeNames" key.
+        pod_e = _k8s_spark_pod("app-esc", "drv-esc")
+        _request(port, "PUT", "/state/pods", pod_e)
+        out["escaped"] = _request(
+            port, "POST", "/predicates",
+            raw=b'{"Pod": ' + json.dumps(pod_e).encode()
+            + b', "NodeNames": ["n0", "n\\u0031", "n2", "n3"]}',
+        )
+        # Malformed JSON body: identical error mapping.
+        out["garbage"] = _request(
+            port, "POST", "/predicates", raw=b"{not json"
+        )
+        # Malformed binary body: identical error mapping.
+        out["bad_binary"] = _request(
+            port, "POST", "/predicates", raw=b"SPRDxxxx",
+            content_type=ingest.BINARY_CONTENT_TYPE,
+        )
+        # Canned surfaces.
+        out["liveness"] = _request(port, "GET", "/status/liveness")
+        out["missing"] = _request(port, "GET", "/no/such/route")
+        if ingest_lane == "native":
+            stats = srv.ingest_stats()
+            # JSON + binary successes and the two failure posts hit the
+            # fast path; the escaped-name body must be a counted fallback.
+            assert stats["decode_hits"] >= 4, stats
+            assert stats["decode_fallbacks"] >= 1, stats
+            assert stats["binary_requests"] >= 1, stats
+    finally:
+        srv.stop()
+    return out
+
+
+@pytest.mark.parametrize("transport", ["threaded", "async"])
+@needs_native
+def test_cross_lane_byte_parity(transport):
+    """Same scenario, both ingest lanes, one transport: every response —
+    decisions, failure maps, error mappings, canned bodies — must be
+    byte-identical."""
+    py = _drive_scenario(transport, "python")
+    nat = _drive_scenario(transport, "native")
+    assert py.keys() == nat.keys()
+    for step in py:
+        assert py[step] == nat[step], f"{transport}/{step} diverged"
+    assert json.loads(py["ok_json"][1])["NodeNames"], py["ok_json"]
+    assert not json.loads(py["fail_1"][1])["NodeNames"]
+    assert json.loads(py["fail_1"][1])["FailedNodes"]
+
+
+@needs_native
+def test_cross_transport_byte_parity_native_lane():
+    """The native lane itself is transport-agnostic: threaded (native body
+    decode) and async (native framing + decode) serve identical bytes."""
+    a = _drive_scenario("threaded", "native")
+    b = _drive_scenario("async", "native")
+    for step in a:
+        assert a[step] == b[step], f"native/{step} diverged across transports"
+
+
+# ------------------------------------------------ response-encoder parity
+
+
+def _result(node_names, failed, outcome):
+    return ExtenderFilterResult(
+        node_names=node_names, failed_nodes=failed, outcome=outcome
+    )
+
+
+def test_encode_filter_result_matches_json_dumps():
+    """The template-spliced/cached encoder must be byte-identical to the
+    json.dumps(filter_result_to_k8s(...)) it replaced — including the
+    fragment-cache hit on a repeated uniform failure map."""
+    names = [f"node-{i}" for i in range(40)]
+    cases = [
+        (_result(["n1"], {}, "success"), None),
+        (_result(["zone-a/n é"], {}, "success"), None),  # escaping
+        (_result([], {n: "does not fit" for n in names}, "failure-fit"),
+         names),
+        (_result([], {n: "does not fit" for n in names}, "failure-fit"),
+         names),  # second encode serves the cached fragment
+        (_result([], {"n1": "a", "n2": "b"}, "failure-fit"), ["n1", "n2"]),
+        (_result([], {n: "boom" for n in names}, "failure-internal"), names),
+        (_result([], {}, "failure-internal"), None),
+    ]
+    for result, hint in cases:
+        expect = json.dumps(filter_result_to_k8s(result)).encode()
+        assert encode_filter_result(result, hint) == expect
+
+
+def test_canned_bodies_match_json_dumps():
+    from spark_scheduler_tpu.server import routing
+
+    assert routing._NOT_FOUND_BODY == json.dumps({"error": "not found"}).encode()
+    assert routing._LIVENESS_BODY == json.dumps({"status": "up"}).encode()
+    assert routing._READY_BODY == json.dumps({"ready": True}).encode()
+    assert routing._NOT_READY_BODY == json.dumps({"ready": False}).encode()
+    assert (
+        routing._SHED_PRE + b"7}"
+        == json.dumps(
+            {"error": "scheduler overloaded", "queue_depth": 7}
+        ).encode()
+    )
+
+
+# ------------------------------------------------------- binary protocol
+
+
+def test_binary_codec_roundtrip_pure_python():
+    pod = _k8s_spark_pod("app", "drv")
+    names = [f"n{i}" for i in range(100)] + ["zone-é/n"]
+    body = ingest.encode_predicate_binary(pod, names)
+    decoded_pod, decoded_names = ingest.decode_predicate_binary_py(body)
+    assert decoded_names == names
+    assert decoded_pod.name == "drv" and decoded_pod.namespace == "ns"
+
+
+@pytest.mark.parametrize(
+    "body",
+    [
+        b"",
+        b"SPRD",
+        b"XXXX\x01\x00\x00\x00\x00\x00\x00\x00\x00",
+        b"SPRD\x02" + b"\x00" * 8,  # bad version
+        b"SPRD\x01\xff\xff\xff\xff" + b"\x00" * 8,  # pod frame overrun
+        b"SPRD\x01\x02\x00\x00\x00{}\x01\x00\x00\x00",  # truncated names
+        b"SPRD\x01\x02\x00\x00\x00{}\x00\x00\x00\x00x",  # trailing bytes
+    ],
+)
+def test_binary_codec_rejects_malformed(body):
+    with pytest.raises(ingest.BinaryPredicateError):
+        ingest.decode_predicate_binary_py(body)
+
+
+@needs_native
+def test_native_binary_decoder_hostile_frames():
+    """Adversarial binary bodies must fall back (never crash, never
+    mis-tokenize): a 13-byte body declaring a billion names (the reserve
+    would otherwise bad_alloc across the C ABI and kill the process), and
+    a NUL inside a name (which would alias the blob's separator format —
+    the Python decoder represents 'a\\0b' faithfully as ONE name)."""
+    import struct
+
+    from spark_scheduler_tpu.native import PredicateSlot
+
+    bomb = b"SPRD\x01" + struct.pack("<I", 0) + struct.pack("<I", 10**9)
+    slot = PredicateSlot()
+    assert not slot.decode_binary(bomb)
+    nul = (
+        b"SPRD\x01" + struct.pack("<I", 2) + b"{}"
+        + struct.pack("<I", 1) + struct.pack("<H", 3) + b"a\x00b"
+    )
+    assert not slot.decode_binary(nul)
+    _, names = ingest.decode_predicate_binary_py(nul)
+    assert names == ["a\x00b"]
+
+
+@needs_native
+def test_native_json_fast_path_refuses_escaped_keys():
+    """An escaped key that DECODES to "Pod" compares unequal on raw bytes:
+    the fast path must fall back rather than hit with an empty pod."""
+    from spark_scheduler_tpu.native import PredicateSlot
+
+    body = (
+        b'{"\\u0050od": {"metadata": {"name": "real"}}, "NodeNames": ["n1"]}'
+    )
+    slot = PredicateSlot()
+    assert not slot.decode_json(body)
+    codec = ingest.NativeIngestCodec()
+    assert codec.decode_predicate_body(body, binary=False) is None
+
+
+@needs_native
+def test_native_framer_ignores_empty_transfer_encoding():
+    """`headers.get("Transfer-Encoding")` truthiness parity: an empty TE
+    value (first header wins) is ignored by the Python framer, so the
+    native framer must frame the body normally too."""
+    from spark_scheduler_tpu import native as n
+
+    conn = n.IngestConn(None, 65536)
+    conn.feed(
+        b"POST /predicates HTTP/1.1\r\nTransfer-Encoding:\r\n"
+        b"Content-Length: 2\r\n\r\n{}"
+    )
+    ev = conn.next()
+    assert ev.kind == n.EV_REQUEST and ev.body_error == 0
+    assert ev.body_len == 2
+
+
+@needs_native
+def test_async_native_miss_decodes_once():
+    """A deviating JSON body on the async native lane is ONE counted
+    fallback: the transport's attempt is flagged on the Request so the
+    routing layer goes straight to the Python parser."""
+    srv = _make_server("async", "native")
+    try:
+        port = srv.port
+        _request(port, "PUT", "/state/nodes", _k8s_node("n0"))
+        pod = _k8s_spark_pod("app-esc", "drv-esc")
+        _request(port, "PUT", "/state/pods", pod)
+        status, body = _request(
+            port, "POST", "/predicates",
+            raw=b'{"Pod": ' + json.dumps(pod).encode()
+            + b', "NodeNames": ["n\\u0030"]}',
+        )
+        assert status == 200 and json.loads(body)["NodeNames"] == ["n0"]
+        stats = srv.ingest_stats()
+        assert stats["decode_fallbacks"] == 1, stats
+        assert stats["decode_hits"] == 0, stats
+    finally:
+        srv.stop()
+
+
+@needs_native
+def test_native_binary_decode_matches_python():
+    pod = _k8s_spark_pod("app", "drv")
+    names = [f"n{i}" for i in range(50)]
+    body = ingest.encode_predicate_binary(pod, names)
+    codec = ingest.NativeIngestCodec()
+    decoded = codec.decode_predicate_body(body, binary=True)
+    assert decoded is not None
+    npod, nnames = decoded
+    ppod, pnames = ingest.decode_predicate_binary_py(body)
+    assert list(nnames) == pnames
+    assert npod == ppod
+
+
+# ------------------------------------------------- NativeNodeNames ticket
+
+
+@needs_native
+def test_native_node_names_ticket_semantics():
+    body = json.dumps(
+        {"Pod": {"metadata": {"name": "p"}},
+         "NodeNames": [f"n{i}" for i in range(100)]}
+    ).encode()
+    codec = ingest.NativeIngestCodec()
+    _, names1 = codec.decode_predicate_body(body, binary=False)
+    _, names2 = codec.decode_predicate_body(body, binary=False)
+    assert isinstance(names1, ingest.NativeNodeNames)
+    # Content-hashable BEFORE materialization: hash/eq ride the digest +
+    # native memcmp, the lazy list stays unbuilt.
+    assert hash(names1) == hash(names2)
+    assert names1 == names2
+    assert names1._list is None and names2._list is None
+    # Sequence protocol.
+    assert len(names1) == 100
+    assert names1[3] == "n3" and names1[-1] == "n99"
+    assert "n42" in names1 and "nope" not in names1
+    assert list(names1) == [f"n{i}" for i in range(100)]
+    assert names1[:3] == ["n0", "n1", "n2"]
+    assert names1 == [f"n{i}" for i in range(100)]
+    # Different content: same everything but the last name.
+    _, other = codec.decode_predicate_body(
+        body.replace(b'"n99"', b'"nXX"'), binary=False
+    )
+    assert names1 != other
+
+
+@needs_native
+def test_candidate_mask_cache_keys_on_ticket_digest():
+    from spark_scheduler_tpu.core.solver import PlacementSolver
+    from spark_scheduler_tpu.models.kube import Node
+    from spark_scheduler_tpu.models.resources import Resources
+
+    solver = PlacementSolver()
+    nodes = [
+        Node(name=f"n{i}", allocatable=Resources.from_quantities("8", "8Gi", "0"))
+        for i in range(16)
+    ]
+    tensors = solver.build_tensors(nodes, {}, {})
+    body = json.dumps(
+        {"Pod": {}, "NodeNames": [f"n{i}" for i in range(0, 16, 2)]}
+    ).encode()
+    codec = ingest.NativeIngestCodec()
+    _, t1 = codec.decode_predicate_body(body, binary=False)
+    _, t2 = codec.decode_predicate_body(body, binary=False)
+    m1 = solver.candidate_mask(tensors, t1)
+    assert t1._list is not None  # cold miss materialized to build the mask
+    m2 = solver.candidate_mask(tensors, t2)
+    assert m2 is m1  # digest-keyed cache hit
+    assert t2._list is None  # ...without materializing the second ticket
+    import numpy as np
+
+    mask_from_list = solver.candidate_mask(
+        tensors, [f"n{i}" for i in range(0, 16, 2)]
+    )
+    assert np.array_equal(m1, mask_from_list)
+
+
+# --------------------------------------------- native framer conformance
+
+
+def _read_response(sock, timeout=5.0):
+    sock.settimeout(timeout)
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            return buf
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1].strip())
+    while len(rest) < length:
+        chunk = sock.recv(4096)
+        if not chunk:
+            break
+        rest += chunk
+    return head + b"\r\n\r\n" + rest[:length], rest[length:]
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "payload",
+    [
+        b"GARBAGE\r\n\r\n",
+        b"GET /status/liveness HTTP-WRONG\r\n\r\n",
+        b"GET /x HTTP/1.1\r\nno-colon-header\r\n\r\n",
+    ],
+)
+def test_native_framer_rejects_malformed_frames(payload):
+    srv = _make_server("async", "native")
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(payload)
+        resp, _ = _read_response(s)
+        assert resp.startswith(b"HTTP/1.1 400"), resp
+        assert b"Connection: close" in resp
+        s.settimeout(5.0)
+        # The framer stops parsing; the transport closes after the write.
+        tail = b""
+        try:
+            while True:
+                chunk = s.recv(4096)
+                if not chunk:
+                    break
+                tail += chunk
+        except socket.timeout:
+            pytest.fail("connection left open after malformed frame")
+        s.close()
+    finally:
+        srv.stop()
+
+
+@needs_native
+def test_native_framer_header_block_too_large():
+    srv = _make_server("async", "native")
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        # Past the 64 KiB header cap with NO terminator in sight: the
+        # framer must 431 rather than buffer without bound.
+        s.sendall(b"GET / HTTP/1.1\r\nX-Junk: " + b"j" * 70000)
+        resp, _ = _read_response(s)
+        assert resp.startswith(b"HTTP/1.1 431"), resp
+        s.close()
+    finally:
+        srv.stop()
+
+
+@needs_native
+def test_native_framer_oversize_body_413_keepalive_survives():
+    srv = _make_server("async", "native", max_body_bytes=64)
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        body = b"x" * 200
+        s.sendall(
+            b"POST /predicates HTTP/1.1\r\nHost: x\r\nContent-Length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        resp, rest = _read_response(s)
+        assert resp.startswith(b"HTTP/1.1 413"), resp
+        assert b"max-body-bytes=64" in resp
+        # The 200-byte body was drained: the next request on the SAME
+        # socket frames cleanly.
+        s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+        resp2, _ = _read_response(s)
+        assert resp2.startswith(b"HTTP/1.1 200"), resp2
+        assert resp2.endswith(b'{"status": "up"}')
+        s.close()
+    finally:
+        srv.stop()
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "te_headers",
+    [
+        b"Transfer-Encoding: chunked\r\n",
+        b"Content-Length: 5\r\nContent-Length: 6\r\n",
+        b"Content-Length: -5\r\n",
+        b"Content-Length: 1_6\r\n",
+    ],
+)
+def test_native_framer_unframeable_bodies_400_and_close(te_headers):
+    srv = _make_server("async", "native")
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(
+            b"POST /predicates HTTP/1.1\r\nHost: x\r\n" + te_headers + b"\r\n"
+        )
+        resp, _ = _read_response(s)
+        assert resp.startswith(b"HTTP/1.1 400"), resp
+        assert b"Connection: close" in resp
+        s.close()
+    finally:
+        srv.stop()
+
+
+@needs_native
+def test_native_framer_pipelined_keepalive_in_order():
+    """Three pipelined requests in ONE write — distinct routes so the
+    in-order flush is observable — then a second burst on the same socket
+    (keep-alive reuse across bursts)."""
+    srv = _make_server("async", "native")
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.sendall(
+            b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n"
+            b"GET /no/such/route HTTP/1.1\r\nHost: x\r\n\r\n"
+            b"GET /status/readiness HTTP/1.1\r\nHost: x\r\n\r\n"
+        )
+        r1, rest = _read_response(s)
+        assert r1.startswith(b"HTTP/1.1 200") and b'"status": "up"' in r1
+        r2, rest = _read_response_with(rest, s)
+        assert r2.startswith(b"HTTP/1.1 404"), r2
+        r3, _ = _read_response_with(rest, s)
+        # No cluster state synced yet: readiness is an honest 503.
+        assert r3.startswith(b"HTTP/1.1 503"), r3
+        assert r3.endswith(b'{"ready": false}')
+        s.sendall(b"GET /status/liveness HTTP/1.1\r\nHost: x\r\n\r\n")
+        r4, _ = _read_response(s)
+        assert r4.startswith(b"HTTP/1.1 200"), r4
+        s.close()
+        stats = srv.ingest_stats()
+        assert stats["native_parse_ns_total"] > 0
+    finally:
+        srv.stop()
+
+
+def _read_response_with(buffered, sock, timeout=5.0):
+    """_read_response, but consuming already-buffered bytes first."""
+    sock.settimeout(timeout)
+    buf = buffered
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(4096)
+        if not chunk:
+            return buf, b""
+        buf += chunk
+    head, _, rest = buf.partition(b"\r\n\r\n")
+    length = 0
+    for line in head.split(b"\r\n"):
+        if line.lower().startswith(b"content-length:"):
+            length = int(line.split(b":", 1)[1].strip())
+    while len(rest) < length:
+        chunk = sock.recv(4096)
+        if not chunk:
+            break
+        rest += chunk
+    return head + b"\r\n\r\n" + rest[:length], rest[length:]
+
+
+# ---------------------------------------------------- fail-soft degrade
+
+
+def test_native_unavailable_degrades_with_warning(monkeypatch):
+    """server.ingest: native on a toolchain-less host: RuntimeWarning at
+    construction, python lane serves, telemetry says degraded."""
+    import spark_scheduler_tpu.server.ingest as ingest_mod
+
+    monkeypatch.setattr(ingest_mod, "try_native_codec", lambda: None)
+    backend = InMemoryBackend()
+    registry = MetricRegistry()
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=True, sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+        ),
+        metrics=SchedulerMetrics(registry, INSTANCE_GROUP_LABEL),
+    )
+    with pytest.warns(RuntimeWarning, match="degrading to the python"):
+        srv = SchedulerHTTPServer(
+            app, registry, port=0, transport="async", ingest="native"
+        )
+    srv.start()
+    try:
+        assert srv.ingest_name == "python"
+        assert srv.ingest_codec is None
+        stats = srv.ingest_stats()
+        assert stats["degraded"] == 1
+        _request(srv.port, "PUT", "/state/nodes", _k8s_node("n0"))
+        pod = _k8s_spark_pod("app", "drv")
+        _request(srv.port, "PUT", "/state/pods", pod)
+        status, body = _request(
+            srv.port, "POST", "/predicates",
+            {"Pod": pod, "NodeNames": ["n0"]},
+        )
+        assert status == 200 and json.loads(body)["NodeNames"] == ["n0"]
+    finally:
+        srv.stop()
+
+
+def test_unknown_ingest_rejected():
+    backend = InMemoryBackend()
+    app = build_scheduler_app(
+        backend, InstallConfig(sync_writes=True)
+    )
+    with pytest.raises(ValueError, match="unknown server ingest"):
+        SchedulerHTTPServer(app, port=0, ingest="rust")
+    app.stop()
+
+
+def test_install_config_parses_server_ingest():
+    cfg = InstallConfig.from_dict({"server": {"ingest": "native"}})
+    assert cfg.server_ingest == "native"
+    assert InstallConfig.from_dict({}).server_ingest == "python"

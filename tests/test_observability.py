@@ -121,6 +121,7 @@ def test_debug_decisions_metrics_and_state_smoke(server):
     snap = json.loads(body)
     snap.pop("predicate_batcher", None)
     snap.pop("server_transport", None)  # stats surface, not a registry series
+    snap.pop("server_ingest", None)  # ditto (ingest-lane stats surface)
     assert any(
         name.startswith("foundry.spark.scheduler.solver.") for name in snap
     ), sorted(snap)
