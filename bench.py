@@ -1845,6 +1845,208 @@ def bench_recorder_overhead(rng):
     )
 
 
+def _ha_build_state(backend, n_nodes, gangs=96, seed_nodes=64):
+    """Shared HA bench fixture: a promoted leader over `backend`, `gangs`
+    placed gangs (admitted at a SMALL node count so setup stays cheap —
+    reconcile/promotion cost is dominated by the node walks, not apps),
+    then the fleet grown to `n_nodes`. Returns (leader, node_names)."""
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+    from spark_scheduler_tpu.ha.replica import build_replica
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.store.backend import DEMAND_CRD
+    from spark_scheduler_tpu.testing.harness import (
+        INSTANCE_GROUP_LABEL,
+        new_node,
+        static_allocation_spark_pods,
+    )
+
+    backend.register_crd(DEMAND_CRD)
+    config = InstallConfig(
+        fifo=True,
+        binpack_algo="tightly-pack",
+        instance_group_label=INSTANCE_GROUP_LABEL,
+        sync_writes=True,
+        ha_enabled=True,
+    )
+    leader = build_replica(backend, "bench-leader", config=config)
+    assert leader.lease.try_acquire()
+    leader.promote()
+    names = []
+    for i in range(seed_nodes):
+        node = new_node(f"ha-n{i}", zone=f"zone{i % 3}")
+        backend.add_node(node)
+        names.append(node.name)
+    for g in range(gangs):
+        pods = static_allocation_spark_pods(f"ha-app-{g}", 2)
+        backend.add_pod(pods[0])
+        res = leader.app.extender.predicate(
+            ExtenderArgs(pod=pods[0], node_names=names)
+        )
+        assert res.ok, res.outcome
+        backend.bind_pod(pods[0], res.node_names[0])
+    for i in range(seed_nodes, n_nodes):
+        node = new_node(f"ha-n{i}", zone=f"zone{i % 3}")
+        backend.add_node(node)
+        names.append(node.name)
+    return leader, names
+
+
+def bench_ha_failover(rng):
+    """ISSUE 8 acceptance metrics.
+
+    Promotion arms (10k durable-WAL / 100k in-memory): COLD start = what a
+    replacement process pays before it can serve (WAL replay where
+    applicable + app build + cache fill + failover reconcile + first
+    feature snapshot) vs WARM standby promotion = a replica whose caches
+    tailed backend events promoting in place (lease takeover + reconcile +
+    snapshot). Bar: warm >= 5x faster than cold at 10k nodes.
+
+    Sharded arm: 2 active replicas serving disjoint instance-group shards
+    concurrently vs 1 replica serving everything, same workload, on the
+    in-process pipeline. Bars: >= 1.5x decisions/s, decisions
+    byte-identical per group (asserted, not just reported).
+
+    Chaos arm: the HAChaosSoak engine (leader killed mid-burst, >= 3
+    cycles) — zero double placements / reservation violations asserted
+    inside, spike + fencing counters reported here."""
+    from spark_scheduler_tpu.ha.lease import BackendLeaseStore, LeaseManager
+    from spark_scheduler_tpu.ha.replica import build_replica
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.store.backend import InMemoryBackend
+    from spark_scheduler_tpu.store.durable import DurableBackend
+    from spark_scheduler_tpu.testing.harness import INSTANCE_GROUP_LABEL
+
+    # ---------------------------------------------- promotion: cold vs warm
+    import tempfile
+
+    for n_nodes, durable in ((10_000, True), (100_000, False)):
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "ha.jsonl")
+            backend = (
+                DurableBackend(path) if durable else InMemoryBackend()
+            )
+            leader, _names = _ha_build_state(backend, n_nodes)
+            config = InstallConfig(
+                fifo=True,
+                binpack_algo="tightly-pack",
+                instance_group_label=INSTANCE_GROUP_LABEL,
+                sync_writes=True,
+                ha_enabled=True,
+            )
+            # Warm standby built BEFORE the measurement: its caches filled
+            # from the backend and its tailer keeps them hot. One election
+            # tick = one heartbeat of standby life (lease still held by
+            # the leader, feature arrays warmed) — heartbeats run
+            # continuously in a real deployment.
+            standby = build_replica(backend, "bench-standby", config=config)
+            assert standby.run_election_once() == "standby"
+            # COLD first (state is stable): a replacement process's full
+            # path to serving.
+            t0 = time.perf_counter()
+            if durable:
+                cold_backend = DurableBackend(
+                    path, compact_on_load=False, follow=True
+                )
+            else:
+                cold_backend = backend
+            cold = build_replica(
+                cold_backend,
+                "bench-cold",
+                config=config,
+                lease=LeaseManager(
+                    BackendLeaseStore(InMemoryBackend()), "bench-cold"
+                ),
+            )
+            assert cold.lease.try_acquire()
+            cold.promote()
+            cold_ms = (time.perf_counter() - t0) * 1e3
+            if durable:
+                cold_backend.close()
+            # WARM: clean handoff -> the standby's next election tick
+            # takes over and promotes in place.
+            leader.stop()
+            assert standby.run_election_once() == "leader"
+            warm_ms = standby.last_promotion_ms
+            speedup = cold_ms / warm_ms if warm_ms else 0.0
+            detail = {
+                "nodes": n_nodes,
+                "cold_ms": round(cold_ms, 1),
+                "warm_ms": round(warm_ms, 2),
+                "warm_reconcile_ms": round(standby.last_reconcile_ms, 2),
+                "speedup": round(speedup, 1),
+                "cold_includes_wal_replay": durable,
+                "gangs": 96,
+            }
+            label = f"ha_promotion_{n_nodes // 1000}k"
+            # Bar (at 10k): warm >= 5x cold -> vs_baseline >= 1.
+            _record(
+                label, round(warm_ms, 2), "ms", round(speedup / 5.0, 2),
+                detail=detail,
+            )
+            print(json.dumps(_RESULTS[-1]), flush=True)
+            standby.stop()
+            if durable:
+                backend.close()
+
+    # ------------------------------------- sharded 2-replica vs 1-replica
+    # + leader-kill chaos, in a SUBPROCESS (hack/ha_shard_bench.py) with
+    # the persistent XLA compile cache NOT enabled: concurrently-serving
+    # solvers in a cache-enabled process intermittently mis-solve reloaded
+    # executables (spurious failure-fit / shifted placements; never
+    # reproduced cache-off), and the arm's byte-identity assertions must
+    # not inherit that flake. Two arms: pure CPU (informational — one XLA
+    # CPU solve already saturates every core) and 50 ms simulated device
+    # RTT (the tunneled-TPU regime; carries the >= 1.5x bar).
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "hack", "ha_shard_bench.py"
+    )
+    env = {k: v for k, v in os.environ.items()}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True, env=env,
+        timeout=1800,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"ha_shard_bench subprocess failed:\n{out.stderr[-2000:]}"
+        )
+    arms = {}
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            rec = json.loads(line)
+            arms[rec.pop("arm")] = rec
+    rtt, pure, chaos = arms["rtt50"], arms["pure_cpu"], arms["chaos"]
+    _record(
+        "ha_sharded_serving",
+        rtt["sharded_2replica_dps"],
+        "decisions/s",
+        round(rtt["speedup"] / 1.5, 2),  # bar: >= 1.5x single-replica
+        detail={"rtt50": rtt, "pure_cpu": pure},
+    )
+    print(json.dumps(_RESULTS[-1]), flush=True)
+
+    # ------------------------------------------------------------- chaos
+    spikes = chaos["failover_spike_ms"]
+    _record(
+        "ha_chaos_soak",
+        max(spikes) if spikes else 0,
+        "ms",
+        1.0
+        if chaos["promotions"] == 3 and chaos["fenced_drops"] >= 3
+        else 0.0,
+        detail={
+            **chaos,
+            "double_placements": 0,  # asserted inside the soak engine
+            "reservation_violations": 0,
+        },
+    )
+    print(json.dumps(_RESULTS[-1]), flush=True)
+
+
 def bench_tpu_parity():
     """Golden-parity smoke on the REAL backend, folded into every bench run
     (VERDICT r2 #5): the same oracle assertions as the CPU golden suite,
@@ -2098,6 +2300,11 @@ def main() -> None:
     # runs with the cheap kernel configs before the serving benches heat
     # the box.
     guarded("host_featurize", bench_host_featurize, rng)
+    # HA failover (ISSUE 8): cold vs warm promotion at 10k/100k nodes,
+    # sharded 2-replica vs 1-replica decisions/s (byte-identical per
+    # group), leader-kill chaos cycle stats. Mostly host work; runs before
+    # the serving benches heat the box.
+    guarded("ha_failover", bench_ha_failover, rng)
     # North-star MEASUREMENT here — after the small kernel configs (whose
     # short chains are the jitter-sensitive ones: config1 measured 1.5 ms
     # quiet vs 4.7 ms after a config5 measurement) but BEFORE the serving

@@ -85,6 +85,29 @@ def main(argv=None) -> int:
         "= unfused)",
     )
     srv.add_argument(
+        "--ha-replica",
+        default=None,
+        metavar="REPLICA_ID",
+        help="run as one replica of a lease-elected HA group (enables the "
+        "ha: install block with this replica id): boot as a warm standby "
+        "tailing backend state, serve only after winning the leader lease "
+        "and running the failover reconcile; reservation writes carry the "
+        "lease's fencing epoch. With --durable-store the WAL is opened in "
+        "follower mode and the lease lives in an flock-guarded "
+        "<wal>.lease sidecar (the supported multi-process arbiter); "
+        "combining with --kube-api-url is refused — the apiserver backend "
+        "does not persist a lease kind yet, so each replica would elect "
+        "itself (split-brain)",
+    )
+    srv.add_argument(
+        "--ha-lease-ttl",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="leader lease TTL (default 3s; heartbeat renews at TTL/3); "
+        "overrides the install config's ha.lease-ttl",
+    )
+    srv.add_argument(
         "--autoscaler",
         action="store_true",
         help="enable the in-process elastic autoscaler: consume pending "
@@ -185,6 +208,11 @@ def main(argv=None) -> int:
         config.kube_api_url = args.kube_api_url
     if args.autoscaler:
         config.autoscaler_enabled = True
+    if args.ha_replica is not None:
+        config.ha_enabled = True
+        config.ha_replica_id = args.ha_replica
+    if args.ha_lease_ttl is not None:
+        config.ha_lease_ttl_s = args.ha_lease_ttl
     if args.transport is not None:
         config.server_transport = args.transport
     if args.ingest is not None:
@@ -219,7 +247,13 @@ def main(argv=None) -> int:
     if config.durable_store_path:
         from spark_scheduler_tpu.store.durable import DurableBackend
 
-        backend = DurableBackend(config.durable_store_path)
+        # HA replicas open the shared WAL in FOLLOWER mode: read-only
+        # tailing until this replica wins the lease and promotes (the
+        # promotion flips it to the writer). A standalone (non-HA) server
+        # is the sole writer from the start.
+        backend = DurableBackend(
+            config.durable_store_path, follow=config.ha_enabled
+        )
     elif config.kube_api_url:
         # Reservations/demands persist as CRs in the apiserver — the
         # reference's actual deployment mode (CRDs ARE the durable store,
@@ -251,9 +285,53 @@ def main(argv=None) -> int:
         # autoscaler (demand_informer.go); locally we provide it so demand
         # features are exercisable.
         backend.register_crd(DEMAND_CRD)
-    app = build_scheduler_app(
-        backend, config, metrics=metrics, events=events, waste=waste
-    )
+    ha_runtime = None
+    if config.ha_enabled:
+        from spark_scheduler_tpu.ha import (
+            BackendLeaseStore,
+            FileLeaseStore,
+            LeaseManager,
+        )
+        from spark_scheduler_tpu.ha.replica import build_replica
+
+        # The lease arbiter must be shared across replicas: the WAL
+        # deployment uses the flock-guarded sidecar (the log itself has no
+        # cross-process CAS); kube/in-memory backends CAS through the
+        # backend's optimistic concurrency.
+        if config.durable_store_path:
+            lease_store = FileLeaseStore(config.durable_store_path + ".lease")
+        elif kube_backend:
+            # KubeBackend round-trips only reservations/demands to the
+            # apiserver; a "leases" object would land in each process's
+            # PRIVATE local store — every replica would elect itself at
+            # epoch 1 and no write would ever be fenced. Refusing beats
+            # silent split-brain; a coordination.k8s.io Lease codec is the
+            # future fix.
+            raise SystemExit(
+                "--ha-replica with --kube-api-url is not supported: the "
+                "lease would be process-local (each replica elects itself "
+                "— split-brain). Use --durable-store for multi-process HA."
+            )
+        else:
+            lease_store = BackendLeaseStore(backend)
+        lease = LeaseManager(
+            lease_store, config.ha_replica_id, ttl_s=config.ha_lease_ttl_s
+        )
+        ha_runtime = build_replica(
+            backend,
+            config.ha_replica_id,
+            config=config,
+            lease=lease,
+            metrics=metrics,
+            events=events,
+            waste=waste,
+            registry=registry,
+        )
+        app = ha_runtime.app
+    else:
+        app = build_scheduler_app(
+            backend, config, metrics=metrics, events=events, waste=waste
+        )
 
     class _Cleanups:  # periodic state eviction + metric flush on the tick
         def report_once(self):
@@ -287,6 +365,7 @@ def main(argv=None) -> int:
         request_timeout_s=config.request_timeout_s,
         debug_routes=config.debug_routes,
         request_log=config.request_log,
+        ha=ha_runtime,
     )
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)
@@ -314,7 +393,16 @@ def main(argv=None) -> int:
                         "waiting for reservation/demand cache sync...",
                         file=sys.stderr,
                     )
-            app.reconciler.sync_resource_reservations_and_demands()
+            if ha_runtime is None:
+                app.reconciler.sync_resource_reservations_and_demands()
+            else:
+                # Election decides who reconciles: one immediate tick so a
+                # sole/first replica serves without waiting a heartbeat;
+                # losers stay warm standbys (readiness reports the role)
+                # until the heartbeat loop promotes them.
+                ha_runtime.run_election_once()
+        elif ha_runtime is not None:
+            ha_runtime.run_election_once()
         server.start()
         server.join()
     except KeyboardInterrupt:

@@ -107,6 +107,13 @@ class ExtenderConfig:
     # each earlier driver's hypothetical placement, so the two paths can
     # pick different (both valid) nodes when FIFO subtractions reorder ties.
     batched_admission: bool = True
+    # Request-gap resync threshold (`extender.resync-gap-seconds`): a gap
+    # longer than this means the leader probably changed, so durable state
+    # is resynced from observed pods before serving (resource.go:191-202).
+    # Redundant — and skipped — while a real HA lease is held (see
+    # SparkSchedulerExtender.ha_lease); float("inf") disables it outright
+    # (sharded-group members, where the lease holder owns reconciliation).
+    resync_gap_seconds: float = LEADER_ELECTION_INTERVAL_S
 
 
 class WindowTicket:
@@ -180,6 +187,11 @@ class SparkSchedulerExtender:
         self._recorder = recorder
         self._clock = clock
         self._last_request: float = 0.0
+        # HA lease handle (ha/lease.LeaseManager), set by the replica
+        # runtime: while the lease is HELD, the >gap "leader probably
+        # changed" heuristic below is redundant (no silent leader change
+        # can have happened — a takeover revokes the lease) and skipped.
+        self.ha_lease = None
         # Apps whose gang admission is DISPATCHED but not yet applied (a
         # pipelined window in flight). A later window must not re-admit
         # them; their requests fall through to the solo loop of their own
@@ -1023,10 +1035,18 @@ class SparkSchedulerExtender:
         )
 
     def _reconcile_if_needed(self) -> None:
-        """>15s request gap => leader probably changed => resync durable
-        state from observed pods (resource.go:191-202)."""
+        """Request gap > `extender.resync-gap-seconds` => leader probably
+        changed => resync durable state from observed pods
+        (resource.go:191-202). Under a HELD HA lease the gap can prove
+        nothing (leadership is affirmed every heartbeat, and losing it
+        already forces a promotion-time reconcile on the successor), so
+        the heuristic is skipped entirely."""
         now = self._clock()
-        if now > self._last_request + LEADER_ELECTION_INTERVAL_S:
+        lease = self.ha_lease
+        if lease is not None and lease.is_held():
+            self._last_request = now
+            return
+        if now > self._last_request + self._config.resync_gap_seconds:
             if self._reconciler is not None:
                 from spark_scheduler_tpu.tracing import tracer
 

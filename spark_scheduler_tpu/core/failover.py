@@ -60,20 +60,40 @@ class FailoverReconciler:
         self._demands = demand_manager
         self._overhead = overhead_computer
         self._instance_group_label = instance_group_label
+        # Mutation counters of the pass in flight (see the sync method).
+        self._summary: dict[str, int] = {}
 
     # ------------------------------------------------------------------ API
 
-    def sync_resource_reservations_and_demands(self) -> None:
+    def sync_resource_reservations_and_demands(self) -> dict:
+        """One reconciliation pass. Returns a mutation summary
+        ({stale_apps, created, patched, soft_added}) — all zeros on a
+        repeat pass over unchanged state: reconciliation is IDEMPOTENT
+        (re-claimed pods leave the stale set, create-or-update converges,
+        soft-shell creation is if-not-exists), which is what lets two
+        racing replicas both run it without duplicating reservations
+        (pinned by tests/test_ha.py)."""
+        self._summary = {
+            "stale_apps": 0, "created": 0, "patched": 0, "soft_added": 0,
+        }
         pods = self._backend.list_pods()
-        nodes = self._backend.list_nodes()
         rrs = self._rr_cache.list()
-        overhead = self._overhead.get_overhead(nodes)
-        soft_usage = self._soft_store.used_soft_reservation_resources()
-        available, ordered_nodes = self._available_per_instance_group(
-            rrs, nodes, overhead, soft_usage
-        )
         stale = self._unreserved_spark_pods(rrs, pods)
+        self._summary["stale_apps"] = len(stale)
 
+        if stale:
+            # The per-group availability map (an O(nodes) walk of Resources
+            # copies) exists only to greedily place stale drivers' missing
+            # executors — build it lazily. The common pass (HA promotion
+            # over tailed-warm state, the gap-heuristic resync on a healthy
+            # leader) has ZERO stale apps and stays O(pods + reservations),
+            # which is what makes warm promotion fast at 100k nodes.
+            nodes = self._backend.list_nodes()
+            overhead = self._overhead.get_overhead(nodes)
+            soft_usage = self._soft_store.used_soft_reservation_resources()
+            available, ordered_nodes = self._available_per_instance_group(
+                rrs, nodes, overhead, soft_usage
+            )
         extra_executors_by_app: dict[str, list[Pod]] = {}
         for sp in stale.values():
             extras = self._sync_resource_reservations(sp, available, ordered_nodes)
@@ -81,6 +101,7 @@ class FailoverReconciler:
                 extra_executors_by_app[sp.app_id] = extras
             self._sync_demands(sp)
         self._sync_soft_reservations(extra_executors_by_app)
+        return dict(self._summary)
 
     # ----------------------------------------------------------- inventory
 
@@ -163,6 +184,7 @@ class FailoverReconciler:
             new_rr = self._patch_resource_reservation(sp.inconsistent_executors, rr.copy())
             if new_rr is None:
                 return []
+            self._summary["patched"] = self._summary.get("patched", 0) + 1
             claimed = set(new_rr.status.pods.values())
             return [e for e in sp.inconsistent_executors if e.name not in claimed]
 
@@ -209,6 +231,7 @@ class FailoverReconciler:
                     rr.resource_version = existing.resource_version
                 if not self._rr_cache.update(rr):
                     return []
+            self._summary["created"] = self._summary.get("created", 0) + 1
             for node_name, res in reserved_usage.items():
                 if node_name in group_avail:
                     group_avail[node_name].sub(res)
@@ -283,6 +306,9 @@ class FailoverReconciler:
                         Reservation(
                             extra.node_name, app_resources.executor_resources.copy()
                         ),
+                    )
+                    self._summary["soft_added"] = (
+                        self._summary.get("soft_added", 0) + 1
                     )
                 except KeyError:
                     pass  # app shell missing (not dynamic-allocation) — skip

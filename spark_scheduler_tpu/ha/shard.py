@@ -1,0 +1,57 @@
+"""ShardMap — instance group -> owning replica.
+
+The active-active traffic partition: live predicate traffic is sharded by
+the pod's instance group, the same boundary PR 4's domain partitioning
+proved commutes (a group's gangs only ever place on that group's nodes,
+so per-group solves are independent and order-free across groups). The
+map is a pure function of (group, replica count) — stable CRC32 — so
+every replica computes the same ownership with no coordination, and
+kube-scheduler can hit any replica: non-owners forward to the owner
+(in-process delegation or an HTTP redirect) instead of failing.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+
+class ShardMap:
+    def __init__(self, n_replicas: int):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.n_replicas = n_replicas
+        # Live membership: removing a member remaps its groups onto the
+        # survivors (modulo over the live list — every replica computes
+        # the same map from the same membership, no coordination beyond
+        # agreeing on who is live).
+        self._live = list(range(n_replicas))
+
+    def remove(self, index: int) -> None:
+        if len(self._live) <= 1:
+            raise ValueError("cannot remove the last live replica")
+        if index in self._live:
+            self._live.remove(index)
+
+    def owner(self, instance_group: str) -> int:
+        """Owning replica index for a group — stable across processes and
+        runs (CRC32, not Python's salted hash). Assignment is over the
+        ORIGINAL slot space: removing a member moves only ITS groups onto
+        survivors — a surviving member's groups never change owner, so an
+        in-flight window on a survivor cannot silently lose ownership
+        mid-commit (only the removed member moves, and it is fenced)."""
+        h = zlib.crc32(instance_group.encode("utf-8"))
+        idx = h % self.n_replicas
+        live = self._live  # never empty: remove() refuses the last member
+        if idx in live:
+            return idx
+        return live[h % len(live)]
+
+    def owned_by(self, index: int, groups) -> list[str]:
+        return [g for g in groups if self.owner(g) == index]
+
+    def describe(self, groups=()) -> dict:
+        return {
+            "replicas": self.n_replicas,
+            "live": list(self._live),
+            "assignments": {g: self.owner(g) for g in groups},
+        }

@@ -23,6 +23,7 @@ from spark_scheduler_tpu.observability.recorder import (  # noqa: F401
     FlightRecorder,
 )
 from spark_scheduler_tpu.observability.telemetry import (  # noqa: F401
+    HATelemetry,
     SolverTelemetry,
     TransportTelemetry,
     compile_stats,
@@ -38,6 +39,7 @@ from spark_scheduler_tpu.observability.state import (  # noqa: F401
 __all__ = [
     "DecisionRecord",
     "FlightRecorder",
+    "HATelemetry",
     "SolverTelemetry",
     "TransportTelemetry",
     "compile_stats",

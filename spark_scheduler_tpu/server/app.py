@@ -247,6 +247,7 @@ def build_scheduler_app(
                 config.should_schedule_dynamically_allocated_executors_in_same_az
             ),
             batched_admission=config.batched_admission,
+            resync_gap_seconds=config.resync_gap_seconds,
         ),
         reconciler=reconciler,
         metrics=metrics,

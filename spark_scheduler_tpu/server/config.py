@@ -182,6 +182,22 @@ class InstallConfig:
     # cost measured; False strips both for the control measurement.
     flight_recorder: bool = True
     flight_recorder_capacity: int = 2048
+    # Active-active HA (spark_scheduler_tpu/ha/): run this process as one
+    # replica of a lease-elected group. The replica starts as a warm
+    # standby (caches tailed hot from backend events / the shared WAL) and
+    # serves only after winning the lease and running the failover
+    # reconcile; reservation/demand writes carry the lease's fencing epoch
+    # so a deposed leader's in-flight commits are rejected. YAML block:
+    #   ha: {enabled, replica-id, lease-ttl, heartbeat-interval}
+    ha_enabled: bool = False
+    ha_replica_id: str = "replica-0"
+    ha_lease_ttl_s: float = 3.0
+    # None = lease-ttl / 3 (three renew chances before takeover).
+    ha_heartbeat_s: Optional[float] = None
+    # Request-gap resync threshold (`extender.resync-gap-seconds`,
+    # resource.go:191-202): a gap longer than this resyncs durable state
+    # from observed pods. Skipped entirely while the HA lease is held.
+    resync_gap_seconds: float = 15.0
 
     @staticmethod
     def enable_jax_compile_cache(cache_dir: str) -> None:
@@ -246,6 +262,8 @@ class InstallConfig:
         autoscaler_block = raw.get("autoscaler") or {}
         solver_block = raw.get("solver") or {}
         mesh_block = solver_block.get("mesh") or {}
+        ha_block = raw.get("ha") or {}
+        extender_block = raw.get("extender") or {}
 
         def block_key(block, key, default):
             # Present-but-null keys (`device-pool:` with no value) must
@@ -348,6 +366,26 @@ class InstallConfig:
             flight_recorder=bool(raw.get("flight-recorder", True)),
             flight_recorder_capacity=int(
                 raw.get("flight-recorder-capacity", 2048)
+            ),
+            ha_enabled=bool(block_key(ha_block, "enabled", False)),
+            ha_replica_id=str(
+                block_key(ha_block, "replica-id", "replica-0")
+            ),
+            ha_lease_ttl_s=_parse_duration(
+                block_key(ha_block, "lease-ttl", 3.0)
+            ),
+            ha_heartbeat_s=(
+                _parse_duration(v)
+                if (v := block_key(ha_block, "heartbeat-interval", None))
+                is not None
+                else None
+            ),
+            resync_gap_seconds=_parse_duration(
+                block_key(
+                    extender_block,
+                    "resync-gap-seconds",
+                    raw.get("resync-gap-seconds", 15.0),
+                )
             ),
         )
 

@@ -689,6 +689,7 @@ class SchedulerHTTPServer:
         max_body_bytes: int | None = None,
         max_connections: int | None = None,
         shed_queue_depth: int | None = None,
+        ha=None,
     ):
         from spark_scheduler_tpu.observability import TransportTelemetry
 
@@ -701,6 +702,11 @@ class SchedulerHTTPServer:
         # on the cluster-exposed extender port it would let any peer start
         # profiler writes to server-side paths.
         self.debug_routes = debug_routes
+        # HA replica runtime (ha/replica.ReplicaRuntime) when this server
+        # is one replica of an elected group: readiness then ALSO requires
+        # a serving role (leader/active), GET /debug/ha exposes the role /
+        # lease / tailer state, and start()/stop() run the heartbeat.
+        self.ha = ha
         self.ready = threading.Event()
         self._shutdown = threading.Event()
         cfg = getattr(app, "config", None)
@@ -829,6 +835,8 @@ class SchedulerHTTPServer:
 
     def start(self) -> None:
         self.app.start_background()
+        if self.ha is not None:
+            self.ha.start()
         self._transport.start()
         # Ready only once cluster state exists; pre-seeded backends (tests,
         # embedded use) are ready at once, otherwise the first successful
@@ -855,6 +863,10 @@ class SchedulerHTTPServer:
     def stop(self) -> None:
         self._shutdown.set()
         self.ready.clear()
+        if self.ha is not None:
+            # Release the lease FIRST: a clean shutdown lets the standby
+            # promote immediately instead of waiting out the TTL.
+            self.ha.stop()
         # Batcher first: pending entries fail fast (and their event-loop
         # callbacks flush) while the transport is still able to write the
         # error responses.

@@ -274,10 +274,32 @@ class SchedulerRoutes(SyncRoutes):
         if path == "/status/liveness":
             return Response(200, _LIVENESS_BODY)
         if path == "/status/readiness":
+            ha = getattr(s, "ha", None)
+            if ha is not None and not s.ready.is_set() and s.app.backend.list_nodes():
+                # HA replicas receive cluster state by TAILING the shared
+                # backend (WAL poll / event bus), never through the
+                # PUT /state/nodes that flips `ready` on a standalone
+                # server — without this re-check a promoted standby would
+                # answer 503 forever and kube would never route to it.
+                s.ready.set()
+            if ha is not None:
+                # HA replica: ready = state synced AND a serving role
+                # (leader / active shard member). Standbys answer 503 with
+                # the role so kube routes traffic to the leader while the
+                # warm replica stays probeable.
+                up = s.ready.is_set() and ha.is_serving()
+                return json_response(
+                    200 if up else 503, {"ready": up, "role": ha.role}
+                )
             up = s.ready.is_set()
             return Response(
                 200 if up else 503, _READY_BODY if up else _NOT_READY_BODY
             )
+        if path == "/debug/ha" and getattr(s, "ha", None) is not None:
+            # Operational surface (role, lease epoch/age, tailer counters):
+            # served whenever HA is wired — failover forensics must not
+            # depend on the debug-routes opt-in.
+            return json_response(200, s.ha.state())
         if path == "/metrics":
             return self._metrics(req)
         if path == "/debug/traces" and s.debug_routes:

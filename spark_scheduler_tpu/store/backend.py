@@ -54,7 +54,7 @@ class _Handlers:
         self.delete: list[Callable[[Any], None]] = []
 
 
-KINDS = ("pods", "nodes", "resourcereservations", "demands")
+KINDS = ("pods", "nodes", "resourcereservations", "demands", "leases")
 
 DEMAND_CRD = "demands.scaler.palantir.com"
 RESERVATION_CRD = "resourcereservations.sparkscheduler.palantir.com"

@@ -116,6 +116,18 @@ class WriteThroughCache:
             if old is not None:
                 self._notify(old, None)
 
+    def apply_external_upsert(self, obj: Any) -> None:
+        """Absorb another writer's committed object (HA standby tailing):
+        store it and notify listeners with the LOCAL previous version as
+        `old` so delta consumers (usage tracker) apply the correct diff.
+        No write-back is enqueued — the object came FROM the backend.
+        Callers must dedup self-originated events (the owner's own writes
+        already notified through create/update)."""
+        with self._write_mutex:
+            old = self._store.get(obj.namespace, obj.name)
+            self._store.put(obj)
+            self._notify(old, obj)
+
     def start(self) -> None:
         if not self._sync:
             self.client.start()

@@ -492,3 +492,265 @@ class Soak:
         self.drain()
         self.check_invariants()
         self.check_drained_mirror()
+
+
+# ---------------------------------------------------------------- HA chaos
+
+
+class HAChaosSoak:
+    """Leader-kill chaos engine (ISSUE 8): N replicas (ha/replica.py) over
+    ONE shared backend; driver bursts hit the current leader; mid-burst
+    the leader is KILLED with a window in flight; after the lease TTL a
+    warm standby promotes (reconcile-before-serve) and the burst
+    continues; the dead leader's in-flight commit is then completed and
+    must be FENCED (epoch moved at takeover) instead of double-placing.
+
+    Asserted per cycle:
+      - zero double placements: every admitted app has exactly ONE
+        reservation whose driver slot names the node the SURVIVING
+        leader answered (the dead leader's conflicting commit was
+        rejected at the durability layer);
+      - zero reservation-invariant violations (the shared
+        overcommit_violations definition);
+      - bounded placement-latency spike: the first post-failover decision
+        completes within `spike_budget_s` wall seconds of the kill
+        (promotion + retry, the TTL itself is crossed on the virtual
+        clock).
+
+    Driven fast by tests/test_ha_chaos_soak.py and on real clusters by
+    bench.py's ha_failover section.
+    """
+
+    def __init__(
+        self,
+        strategy: str = "tightly-pack",
+        n_nodes: int = 16,
+        ttl_s: float = 3.0,
+        spike_budget_s: float = 30.0,
+        backend=None,
+        max_live_apps: int = 18,
+    ):
+        from spark_scheduler_tpu.ha.replica import build_replica
+        from spark_scheduler_tpu.server.config import InstallConfig
+        from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
+        from spark_scheduler_tpu.testing.harness import (
+            INSTANCE_GROUP_LABEL,
+            new_node,
+        )
+
+        self.backend = backend if backend is not None else InMemoryBackend()
+        self.backend.register_crd(DEMAND_CRD)
+        self.clock = SoakClock()
+        self.ttl_s = ttl_s
+        self.spike_budget_s = spike_budget_s
+        self._config = lambda: InstallConfig(
+            fifo=True,
+            binpack_algo=strategy,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+            sync_writes=True,
+            ha_enabled=True,
+            ha_lease_ttl_s=ttl_s,
+        )
+        self._build = lambda rid: build_replica(
+            self.backend, rid, config=self._config(), clock=self.clock
+        )
+        for i in range(n_nodes):
+            self.backend.add_node(new_node(f"hn{i}", zone=f"zone{i % 3}"))
+        self.node_names = [f"hn{i}" for i in range(n_nodes)]
+        self._replica_seq = 2
+        self.replicas = [self._build("replica-0"), self._build("replica-1")]
+        assert self.replicas[0].lease.try_acquire()
+        self.replicas[0].promote()
+        self.app_seq = 0
+        # app_id -> node the SURVIVING leader answered (live apps only —
+        # completed apps retire so an arbitrary-cycle soak runs at bounded
+        # state instead of exhausting the fixed fleet)
+        self.placed: dict[str, str] = {}
+        self.max_live_apps = max_live_apps
+        self.total_placed = 0
+        self.retired = 0
+        self.driver_pods: dict[str, object] = {}
+        self.steady_latencies: list[float] = []
+        self.failover_spikes: list[float] = []
+        self.fenced_drops = 0
+        self.promotions = 0
+
+    # -- plumbing ----------------------------------------------------------
+
+    @property
+    def leader(self):
+        for r in self.replicas:
+            if r.is_serving():
+                return r
+        raise AssertionError("no serving replica")
+
+    @property
+    def standby(self):
+        for r in self.replicas:
+            if not r._dead and not r.is_serving():
+                return r
+        raise AssertionError("no standby replica")
+
+    def _new_app(self, execs: int = 2):
+        from spark_scheduler_tpu.testing.harness import (
+            static_allocation_spark_pods,
+        )
+
+        app_id = f"chaos-{self.app_seq}"
+        self.app_seq += 1
+        pods = static_allocation_spark_pods(app_id, execs)
+        self.backend.add_pod(pods[0])
+        self.driver_pods[app_id] = pods[0]
+        return app_id, pods[0]
+
+    def _serve_driver(self, runtime, pod, record=None) -> str:
+        from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+        t0 = time.perf_counter()
+        res = runtime.app.extender.predicate(
+            ExtenderArgs(pod=pod, node_names=list(self.node_names))
+        )
+        if record is not None:
+            record.append(time.perf_counter() - t0)
+        assert res.ok, (pod.name, res.outcome, res.failed_nodes and next(iter(res.failed_nodes.values())))
+        node = res.node_names[0]
+        self.backend.bind_pod(pod, node)
+        return node
+
+    # -- one chaos cycle ---------------------------------------------------
+
+    def run_cycle(self, burst: int = 4, inflight: int = 2) -> None:
+        from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+        leader = self.leader
+        # Steady phase: admit a burst on the live leader.
+        for _ in range(burst):
+            app_id, driver = self._new_app()
+            self.placed[app_id] = self._serve_driver(
+                leader, driver, self.steady_latencies
+            )
+            self.total_placed += 1
+        # Stage the kill: dispatch (but do not complete) a window of fresh
+        # gangs on the soon-dead leader — the async fire-and-forget commit
+        # the fencing epoch exists for. Half are RETRIED by their client on
+        # the new leader (the tailer makes the dead commit an idempotent
+        # no-op); the rest are ORPHANS only the dead leader ever saw —
+        # their commit is a brand-new reservation write and MUST be fenced
+        # at the durability layer.
+        staged = [self._new_app() for _ in range(inflight)]
+        orphans = [self._new_app() for _ in range(max(1, inflight // 2))]
+        ticket = leader.app.extender.predicate_window_dispatch(
+            [
+                ExtenderArgs(pod=p, node_names=list(self.node_names))
+                for _aid, p in staged + orphans
+            ]
+        )
+        kill_t0 = time.perf_counter()
+        leader.kill()
+        drops_before = leader.app.rr_cache.client.metrics.dropped
+        # The lease must EXPIRE (no clean release on a crash).
+        self.clock.advance(self.ttl_s * 1.5)
+        survivor = self.standby
+        assert survivor.run_election_once() == "leader", survivor.state()
+        self.promotions += 1
+        # Clients retry the in-flight gangs against the new leader; the
+        # first retried decision's wall time since the kill is the spike.
+        for i, (app_id, driver) in enumerate(staged):
+            node = self._serve_driver(survivor, driver)
+            self.placed[app_id] = node
+            self.total_placed += 1
+            if i == 0:
+                self.failover_spikes.append(time.perf_counter() - kill_t0)
+        # The dead leader's window now lands. Retried apps: the tailer
+        # already delivered the new leader's reservation, so the commit is
+        # an idempotent no-op. Orphans: a fresh reservation write carrying
+        # the stale epoch — rejected by the fence, counted dropped.
+        try:
+            leader.app.extender.predicate_window_complete(ticket)
+        except Exception:
+            pass  # a fenced demand/reservation write surfacing is fine
+        drops = leader.app.rr_cache.client.metrics.dropped - drops_before
+        self.fenced_drops += drops
+        assert leader.lease.fenced_rejects > 0 and drops >= len(orphans), (
+            "the dead leader's orphan commit was never fenced",
+            leader.lease.fenced_rejects, drops,
+        )
+        for app_id, driver in orphans:
+            assert (
+                self.backend.get(
+                    "resourcereservations", driver.namespace, app_id
+                )
+                is None
+            ), ("fenced orphan reservation reached the durable store", app_id)
+            # The orphan's client went away with its leader: remove the
+            # pending driver pod so FIFO doesn't track a ghost forever.
+            self.backend.delete_pod(driver)
+            del self.driver_pods[app_id]
+        # Fresh standby replaces the corpse (built AFTER the new state
+        # exists: its caches fill warm, the tailer keeps them warm).
+        self.replicas = [r for r in self.replicas if not r._dead]
+        self.replicas.append(self._build(f"replica-{self._replica_seq}"))
+        self._replica_seq += 1
+        self._retire_oldest()
+        self.check_invariants()
+
+    def _retire_oldest(self) -> None:
+        """Completed apps leave the cluster: delete the driver pod and its
+        reservation through the NEW leader's fenced write path (tailers
+        propagate the deletes to every replica's cache and usage tracker),
+        so an arbitrary-cycle soak recycles capacity instead of hitting
+        legitimate does-not-fit on the fixed fleet — which would starve the
+        orphan-fencing assertion of its reservation write."""
+        leader = self.leader
+        while len(self.placed) > self.max_live_apps:
+            app_id = next(iter(self.placed))
+            driver = self.driver_pods.pop(app_id)
+            # Pod first: a bound driver with no reservation is exactly what
+            # reconcile calls stale and would re-place.
+            self.backend.delete_pod(driver)
+            leader.app.rr_cache.delete(driver.namespace, app_id)
+            del self.placed[app_id]
+            self.retired += 1
+
+    # -- invariants --------------------------------------------------------
+
+    def check_invariants(self) -> None:
+        from spark_scheduler_tpu.testing.harness import overcommit_violations
+
+        leader = self.leader
+        # Reservation invariant over DURABLE truth.
+        violations = overcommit_violations(leader.app, self.backend)
+        assert not violations, ("over-commit", violations)
+        # Zero double placements: one RR per admitted app, driver slot on
+        # the surviving answer's node.
+        rrs = {rr.name: rr for rr in self.backend.list("resourcereservations")}
+        for app_id, node in self.placed.items():
+            rr = rrs.get(app_id)
+            assert rr is not None, ("admitted app lost its reservation", app_id)
+            assert rr.spec.reservations["driver"].node == node, (
+                "double placement: durable driver slot diverges from the "
+                "surviving leader's answer",
+                app_id, rr.spec.reservations["driver"].node, node,
+            )
+        # Latency spike bounded.
+        for spike in self.failover_spikes:
+            assert spike < self.spike_budget_s, (
+                "failover spike exceeds budget", spike, self.spike_budget_s
+            )
+
+    def run(self, cycles: int = 3, burst: int = 4) -> dict:
+        for _ in range(cycles):
+            self.run_cycle(burst=burst)
+        mid = sorted(self.steady_latencies)
+        return {
+            "cycles": cycles,
+            "apps_placed": self.total_placed,
+            "live_apps": len(self.placed),
+            "retired": self.retired,
+            "steady_p50_ms": round(mid[len(mid) // 2] * 1e3, 3) if mid else None,
+            "failover_spike_ms": [
+                round(s * 1e3, 1) for s in self.failover_spikes
+            ],
+            "fenced_drops": self.fenced_drops,
+            "promotions": self.promotions,
+        }

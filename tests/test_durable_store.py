@@ -212,16 +212,155 @@ class TestDurableBackend:
         re_backend.close()
         backend.close()
 
-    def test_torn_tail_write_is_skipped(self, tmp_path):
+    def test_torn_tail_write_truncated_with_warning(self, tmp_path):
+        """Crash mid-append: replay must warn AND truncate the torn bytes
+        — leaving them would corrupt the NEXT appended record (it lands on
+        the same line)."""
+        import os
+
+        import pytest
+
         path = str(tmp_path / "state.jsonl")
         backend = DurableBackend(path, compact_on_load=False)
         backend.add_node(new_node("n0"))
         backend.close()
+        good_size = os.path.getsize(path)
         with open(path, "a") as f:
             f.write('{"verb": "create", "kind": "nodes", "na')  # crash mid-write
-        re_backend = DurableBackend(path)
+        with pytest.warns(RuntimeWarning, match="torn trailing record"):
+            re_backend = DurableBackend(path, compact_on_load=False)
         assert re_backend.get_node("n0") is not None
+        # The file was repaired to the last complete record, so a new
+        # append starts on a fresh line and survives the NEXT replay.
+        assert os.path.getsize(path) == good_size
+        re_backend.add_node(new_node("n1"))
         re_backend.close()
+        third = DurableBackend(path, compact_on_load=False)
+        assert third.get_node("n0") is not None
+        assert third.get_node("n1") is not None
+        third.close()
+
+    def test_promotion_truncates_dead_writers_torn_tail(self, tmp_path):
+        """Leader crashes mid-append; the promoted follower must truncate
+        the partial line BEFORE its first append — welding its record
+        onto the torn bytes would make ONE undecodable line, losing both
+        on the next replay."""
+        import os
+
+        import pytest
+
+        path = str(tmp_path / "state.jsonl")
+        leader = DurableBackend(path, compact_on_load=False)
+        leader.add_node(new_node("n0"))
+        follower = DurableBackend(path, follow=True)
+        assert follower.get_node("n0") is not None
+        leader.close()
+        with open(path, "a") as f:
+            f.write('{"verb": "create", "kind": "nodes", "na')  # SIGKILL
+        with pytest.warns(RuntimeWarning, match="torn mid-append tail"):
+            follower.promote_to_writer()
+        follower.add_node(new_node("n1"))
+        follower.close()
+        replayed = DurableBackend(path, compact_on_load=False)
+        assert replayed.get_node("n0") is not None
+        assert replayed.get_node("n1") is not None
+        replayed.close()
+
+    def test_promotion_keeps_complete_unterminated_tail(self, tmp_path):
+        """The crash can land AFTER the record's bytes flushed but BEFORE
+        its newline: cold-restart replay keeps that record (`for raw in
+        f` parses an unterminated last line), so promotion must too —
+        apply it, terminate the line, and append after it."""
+        import warnings
+
+        path = str(tmp_path / "state.jsonl")
+        leader = DurableBackend(path, compact_on_load=False)
+        leader.add_node(new_node("n0"))
+        follower = DurableBackend(path, follow=True)
+        leader.close()
+        # Flush a COMPLETE node-create record with no trailing newline.
+        with open(path) as f:
+            template = f.readline().rstrip("\n")
+        with open(path, "a") as f:
+            f.write(template.replace('"n0"', '"n1"'))  # crash before \n
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            follower.promote_to_writer()  # no torn-tail warning
+        assert follower.get_node("n1") is not None
+        follower.add_node(new_node("n2"))
+        follower.close()
+        replayed = DurableBackend(path, compact_on_load=False)
+        for n in ("n0", "n1", "n2"):
+            assert replayed.get_node(n) is not None, n
+        replayed.close()
+
+    def test_follower_boot_silent_on_in_progress_append(self, tmp_path):
+        """A standby booting while the LIVE writer is mid-append sees a
+        healthy log, not damage: no corruption warning, no truncation —
+        poll_log picks the record up once the writer completes it."""
+        import os
+        import warnings
+
+        path = str(tmp_path / "state.jsonl")
+        leader = DurableBackend(path, compact_on_load=False)
+        leader.add_node(new_node("n0"))
+        with open(path, "a") as f:
+            f.write('{"verb": "create", "kind": "nodes", "na')  # mid-flush
+        size = os.path.getsize(path)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            follower = DurableBackend(path, follow=True)
+        assert follower.get_node("n0") is not None
+        assert os.path.getsize(path) == size  # follower never truncates
+        leader.close()
+
+    def test_writer_killed_mid_record(self, tmp_path):
+        """A real writer PROCESS killed mid-append: the child flushes half
+        a record and parks; SIGKILL tears it exactly there. Replay warns,
+        truncates, and keeps every complete record."""
+        import os
+        import signal
+        import subprocess
+        import sys
+
+        import pytest
+
+        path = str(tmp_path / "killed.jsonl")
+        child = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                f"""
+import json, sys
+from spark_scheduler_tpu.store.durable import DurableBackend
+from spark_scheduler_tpu.testing.harness import new_node
+b = DurableBackend({path!r}, compact_on_load=False)
+b.add_node(new_node("k0"))
+b.add_node(new_node("k1"))
+# Crash mid-append: half a record, flushed, no newline.
+b._file.write(json.dumps({{"verb": "create", "kind": "nodes"}})[:21])
+b._file.flush()
+print("TORN", flush=True)
+import time; time.sleep(60)
+""",
+            ],
+            stdout=subprocess.PIPE,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            assert child.stdout.readline().strip() == b"TORN"
+            child.send_signal(signal.SIGKILL)
+            child.wait(timeout=30)
+        finally:
+            if child.poll() is None:
+                child.kill()
+        with pytest.warns(RuntimeWarning, match="torn trailing record"):
+            backend = DurableBackend(path, compact_on_load=False)
+        assert backend.get_node("k0") is not None
+        assert backend.get_node("k1") is not None
+        with open(path, "rb") as f:
+            assert f.read().endswith(b"}\n")  # torn bytes are gone
+        backend.close()
 
 
 class TestRestartRecovery:
