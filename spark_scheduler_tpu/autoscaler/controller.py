@@ -80,6 +80,19 @@ class ElasticAutoscaler:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._attached = False
+        # Failed-pass backoff (ISSUE 9 satellite): the loop used to retry
+        # a failing pass at full poll cadence forever; now consecutive
+        # failures back off exponentially (capped, full jitter) and the
+        # count is a gauge so a wedged controller is visible, not silent.
+        from spark_scheduler_tpu.faults.retry import RetryPolicy
+
+        self.retry_policy = RetryPolicy(
+            max_attempts=None,
+            base_delay_s=poll_interval_s,
+            multiplier=2.0,
+            max_delay_s=max(30.0, poll_interval_s),
+        )
+        self.consecutive_failures = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -103,18 +116,40 @@ class ElasticAutoscaler:
                     return
                 try:
                     self.run_once()
+                    self._note_pass_ok()
                 except Exception as exc:
                     from spark_scheduler_tpu.tracing import svc1log
 
+                    pause = self._note_pass_failed()
                     svc1log().warn(
-                        "autoscaler pass failed; will retry",
+                        "autoscaler pass failed; backing off",
                         error=f"{type(exc).__name__}: {exc}",
+                        consecutiveFailures=self.consecutive_failures,
+                        backoffS=round(pause, 3),
                     )
+                    # On top of the poll wait: a failing backend is
+                    # probed at the ladder's cadence, and the demand-add
+                    # wakeup is cleared below so it cannot bypass it.
+                    self._stop.wait(pause)
+                    self._wakeup.clear()
 
         self._thread = threading.Thread(
             target=loop, daemon=True, name="elastic-autoscaler"
         )
         self._thread.start()
+
+    def _note_pass_ok(self) -> None:
+        if self.consecutive_failures:
+            self.consecutive_failures = 0
+            self.metrics.set_consecutive_failures(0)
+
+    def _note_pass_failed(self) -> float:
+        """Count one failed pass; returns the backoff to wait before the
+        next attempt (exponential in the failure streak)."""
+        delay = self.retry_policy.delay(self.consecutive_failures)
+        self.consecutive_failures += 1
+        self.metrics.set_consecutive_failures(self.consecutive_failures)
+        return delay
 
     def stop(self) -> None:
         self._stop.set()

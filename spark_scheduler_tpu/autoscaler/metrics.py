@@ -19,6 +19,9 @@ NODES_DRAINED = "foundry.spark.scheduler.autoscaler.nodes.drained"
 DEMANDS_FULFILLED = "foundry.spark.scheduler.autoscaler.demands.fulfilled"
 DEMANDS_UNFULFILLABLE = "foundry.spark.scheduler.autoscaler.demands.unfulfillable"
 CLUSTER_SIZE = "foundry.spark.scheduler.autoscaler.cluster.size"
+CONSECUTIVE_FAILURES = (
+    "foundry.spark.scheduler.autoscaler.consecutive.failures"
+)
 
 TAG_INSTANCE_GROUP = "instance-group"
 
@@ -60,6 +63,11 @@ class AutoscalerMetrics:
 
     def set_cluster_size(self, n: int) -> None:
         self.registry.gauge(CLUSTER_SIZE).set(float(n))
+
+    def set_consecutive_failures(self, n: int) -> None:
+        """Failed control-loop passes in a row (0 = healthy); paired with
+        the controller's exponential backoff (ISSUE 9 satellite)."""
+        self.registry.gauge(CONSECUTIVE_FAILURES).set(float(n))
 
     # -- inspection ----------------------------------------------------------
 

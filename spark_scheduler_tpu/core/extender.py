@@ -1019,6 +1019,16 @@ class SparkSchedulerExtender:
                 if isinstance(solve_info, dict)
                 else None
             ),
+            degraded=(
+                solve_info.get("degraded")
+                if isinstance(solve_info, dict)
+                else None
+            ),
+            redispatches=(
+                solve_info.get("redispatches")
+                if isinstance(solve_info, dict)
+                else None
+            ),
         )
 
     # ------------------------------------------------------------- plumbing

@@ -28,6 +28,11 @@ import jax
 import jax.numpy as jnp
 
 from spark_scheduler_tpu import native
+from spark_scheduler_tpu.faults.errors import (
+    AllSlotsQuarantinedError,
+    DegradedUnavailableError,
+    classify_slot_failure,
+)
 from spark_scheduler_tpu.models.cluster import (
     ClusterTensors,
     NodeRegistry,
@@ -190,6 +195,15 @@ class _DaemonFetchPool:
                 fut.set_result(fn())
             except BaseException as exc:  # delivered via future.result()
                 fut.set_exception(exc)
+                if isinstance(exc, KeyboardInterrupt):
+                    # Interpreter-exit signal: deliver to the waiter AND
+                    # re-raise it in the main thread — a bare raise here
+                    # would only kill this worker (the process-wide pool
+                    # never replenishes, so fetches would hang forever)
+                    # without interrupting anything (ISSUE 9 satellite).
+                    import _thread
+
+                    _thread.interrupt_main()
 
     def submit(self, fn, *args):
         from concurrent.futures import Future
@@ -360,6 +374,7 @@ class _PoolSlot:
     __slots__ = (
         "placement", "label", "is_mesh", "statics", "statics_epoch",
         "sub_statics", "uploads", "last_full_upload", "inflight",
+        "quarantined", "quarantined_at", "last_probe", "failure_count",
     )
 
     def __init__(self, placement):
@@ -383,6 +398,12 @@ class _PoolSlot:
         self.uploads = {"full": 0, "reuse": 0}
         self.last_full_upload = 0.0
         self.inflight = 0
+        # Slot-failure quarantine (ISSUE 9): a quarantined slot takes no
+        # new dispatches until a periodic probe program succeeds on it.
+        self.quarantined = False
+        self.quarantined_at = 0.0
+        self.last_probe = 0.0
+        self.failure_count = 0
 
     def _put(self, arr):
         if self.is_mesh:
@@ -475,17 +496,47 @@ class _DevicePool:
         self._next = 0
 
     def next_slot(self) -> _PoolSlot:
+        """Least-loaded HEALTHY slot (round-robin tiebreak); quarantined
+        slots take no new work. Raises AllSlotsQuarantinedError when the
+        pool has no healthy slot left — the degraded-mode trigger."""
         n = len(self.slots)
         best, best_i = None, 0
         for off in range(n):
             i = (self._next + off) % n
             s = self.slots[i]
+            if s.quarantined:
+                continue
             if best is None or s.inflight < best.inflight:
                 best, best_i = s, i
                 if s.inflight == 0:
                     break
+        if best is None:
+            raise AllSlotsQuarantinedError(
+                f"all {n} device slot(s) quarantined"
+            )
         self._next = (best_i + 1) % n
         return best
+
+    def healthy_slots(self) -> "list[_PoolSlot]":
+        return [s for s in self.slots if not s.quarantined]
+
+    def quarantined_slots(self) -> "list[_PoolSlot]":
+        return [s for s in self.slots if s.quarantined]
+
+    def quarantine(self, slot: _PoolSlot, now: float) -> None:
+        """Take the slot out of rotation and drop its resident buffers —
+        the device (or its tunnel) is suspect, so the replicas on it are
+        unreachable state, not a cache."""
+        slot.quarantined = True
+        slot.quarantined_at = now
+        slot.last_probe = now
+        slot.failure_count += 1
+        slot.release()
+
+    def reinstate(self, slot: _PoolSlot) -> None:
+        """Probe succeeded: back into rotation. Resident state was
+        released at quarantine, so the next dispatch re-uploads statics."""
+        slot.quarantined = False
 
     def occupancy(self) -> float:
         """Fraction of slots with at least one in-flight solve — the
@@ -493,13 +544,26 @@ class _DevicePool:
         busy = sum(1 for s in self.slots if s.inflight > 0)
         return busy / max(1, len(self.slots))
 
+    def health(self) -> dict:
+        q = [s.label for s in self.slots if s.quarantined]
+        return {
+            "slots": len(self.slots),
+            "healthy": len(self.slots) - len(q),
+            "quarantined": q,
+        }
+
     def release(self):
         for s in self.slots:
             s.release()
 
     def stats(self) -> dict:
         return {
-            s.label: {**s.uploads, "inflight": s.inflight}
+            s.label: {
+                **s.uploads,
+                "inflight": s.inflight,
+                "quarantined": s.quarantined,
+                "failures": s.failure_count,
+            }
             for s in self.slots
         }
 
@@ -522,9 +586,12 @@ class _PendingBase:
 
     def result(self):
         if not self._done:
+            # Exception (not BaseException): KeyboardInterrupt/SystemExit
+            # propagate to the build thread instead of being parked as the
+            # combine's "result" (ISSUE 9 satellite).
             try:
                 self._val = self._fn()
-            except BaseException as exc:  # surfaced by _resolve_base
+            except Exception as exc:  # surfaced by _resolve_base
                 self._exc = exc
             self._done = True
             self._fn = None
@@ -543,11 +610,12 @@ class _WindowPart:
 
     __slots__ = (
         "future", "after_future", "req_ids", "requests", "row_drv",
-        "row_exc", "row_skip", "idx", "slot", "rows",
+        "row_exc", "row_skip", "idx", "slot", "rows", "idx_key", "apps",
     )
 
     def __init__(self, *, future, after_future, req_ids, requests, row_drv,
-                 row_exc, row_skip, idx, slot, rows):
+                 row_exc, row_skip, idx, slot, rows, idx_key=None,
+                 apps=None):
         self.future = future
         self.after_future = after_future
         self.req_ids = req_ids  # original positions in the window
@@ -558,6 +626,11 @@ class _WindowPart:
         self.idx = idx  # np int32 global node indices, None = full cluster
         self.slot = slot
         self.rows = rows
+        # Re-dispatch inputs (slot-failure recovery): the HOST-side app
+        # batch and the sub-replica cache key — enough to re-run this
+        # part's solve on a surviving slot byte-identically.
+        self.idx_key = idx_key
+        self.apps = apps
 
 
 @_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
@@ -625,7 +698,8 @@ class WindowHandle:
         "host_avail", "host_schedulable", "priors", "placements", "n",
         "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
         "info", "parts", "request_device", "dispatch_id", "dispatched_at",
-        "fused_decisions", "released", "__weakref__",
+        "fused_decisions", "released", "host_tensors", "use_fallback",
+        "__weakref__",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
@@ -673,6 +747,14 @@ class WindowHandle:
         # of the one real fetch, shared by every view's pack_window_fetch.
         self.fused_decisions = None
         self.released = False
+        # Host ClusterTensors view at dispatch (static fields + masks):
+        # what slot-failure re-dispatch and the greedy degraded fallback
+        # re-solve from. A reference, not a copy — the host arrays are
+        # immutable between builds.
+        self.host_tensors = None
+        # True: no device solved this window (every slot quarantined at
+        # dispatch); pack_window_fetch serves it via the greedy fallback.
+        self.use_fallback = False
 
     def release_buffers(self) -> None:
         """Drop the dispatch's staging buffers: the device decision blob
@@ -772,6 +854,7 @@ class PlacementSolver:
         use_native: bool = True,
         device_pool: int = 1,
         mesh: tuple[int, int] | None = None,
+        quarantine_probe_s: float = 5.0,
     ):
         self.registry = NodeRegistry()
         # Multi-device window-solve engine (`solver.device-pool` /
@@ -879,6 +962,110 @@ class PlacementSolver:
         # "emax", "compile_cache_hit"}) for the flight recorder.
         # Single-threaded by the same contract as the pipeline state.
         self.last_solve_info: dict | None = None
+        # Device-slot fault recovery (ISSUE 9): how often a quarantined
+        # slot is probed for reinstatement, the degraded-mode controller
+        # (faults/degraded.py, wired by build_scheduler_app; None =
+        # device failures propagate as before), and the lazy host-side
+        # greedy fallback the degraded "greedy" policy serves through.
+        self.quarantine_probe_s = quarantine_probe_s
+        self.degraded = None
+        self._fallback = None
+        self.redispatch_count = 0
+
+    @property
+    def fallback(self):
+        if self._fallback is None:
+            from spark_scheduler_tpu.core.fallback import (
+                GreedyFallbackSolver,
+            )
+
+            self._fallback = GreedyFallbackSolver(self)
+        return self._fallback
+
+    def device_health(self) -> dict:
+        """{slots, healthy, quarantined: [labels]} — /debug/state and the
+        readiness probe's degraded view."""
+        if self._pool is None:
+            return {"slots": 1, "healthy": 1, "quarantined": []}
+        return self._pool.health()
+
+    def _on_slot_event(self, event: str, label: str) -> None:
+        if self.telemetry is not None:
+            self.telemetry.on_slot_event(event, label)
+            if self._pool is not None:
+                self.telemetry.on_quarantine_count(
+                    len(self._pool.quarantined_slots())
+                )
+
+    def _quarantine_slot(self, slot, exc) -> None:
+        self._pool.quarantine(slot, self._clock())
+        self._on_slot_event("quarantine", slot.label)
+        from spark_scheduler_tpu.tracing import svc1log
+
+        svc1log().warn(
+            "device slot quarantined",
+            device=slot.label,
+            error=f"{type(exc).__name__}: {exc}",
+            failures=slot.failure_count,
+        )
+
+    def probe_quarantined(self, force: bool = False) -> int:
+        """Run a tiny device program on each quarantined slot whose probe
+        interval elapsed; success reinstates the slot (statics re-upload
+        on its next dispatch). Returns the number reinstated. Called at
+        every pooled dispatch — cheap when nothing is quarantined."""
+        pool = self._pool
+        if pool is None:
+            return 0
+        reinstated = 0
+        now = self._clock()
+        for s in pool.quarantined_slots():
+            if not force and now - s.last_probe < self.quarantine_probe_s:
+                continue
+            s.last_probe = now
+            try:
+                # The probe pays the same boundaries a real dispatch
+                # would (shim'd, so injected device partitions keep the
+                # slot down until the plan's window ends).
+                _shim("dispatch")
+                arr = s._put(np.arange(8, dtype=np.int32))
+                np.asarray(jax.device_get(arr + 1))
+            except Exception as exc:
+                if classify_slot_failure(exc):
+                    self._on_slot_event("probe-failed", s.label)
+                    continue
+                raise
+            pool.reinstate(s)
+            reinstated += 1
+            self._on_slot_event("reinstate", s.label)
+        if reinstated and self.degraded is not None and pool.healthy_slots():
+            self.degraded.clear()
+        return reinstated
+
+    def _degraded_or_raise(self, exc):
+        """A device failure with no healthy slot to retry on: consult the
+        degraded policy. Returns True when the caller should serve via
+        the greedy fallback; raises DegradedUnavailableError (shed) or
+        re-raises `exc` (no controller wired)."""
+        d = self.degraded
+        if d is None:
+            raise exc
+        d.engage(f"{type(exc).__name__}: {exc}")
+        if d.sheds:
+            d.on_shed()
+            raise DegradedUnavailableError(
+                f"no device slot available: {exc}", d.retry_after_s
+            ) from exc
+        return True
+
+    def _device_recovered(self) -> None:
+        """A device solve completed: if degraded mode was engaged by a
+        transient single-device failure, serving recovered — clear it.
+        (Pool-quarantine degradation clears via probe reinstatement.)"""
+        d = self.degraded
+        if d is not None and d.active:
+            if self._pool is None or self._pool.healthy_slots():
+                d.clear()
 
     @property
     def uses_native_arena(self) -> bool:
@@ -1215,7 +1402,7 @@ class PlacementSolver:
         try:
             p["avail"] = avail.result()
             return True
-        except BaseException:
+        except Exception:
             self._pipe = None
             if self.telemetry is not None:
                 self.telemetry.on_pipeline_event("fetch-failure")
@@ -1402,28 +1589,50 @@ class PlacementSolver:
         compiles_before = tel.compile_count() if tel is not None else None
         # The span covers dispatch AND the device->host transfer — the
         # transfer is where the device work is actually awaited.
-        with tracer().span(
-            "solve", strategy=strategy, nodes=n, executors=executor_count
-        ):
-            # ONE device->host transfer (one flat int32 blob) for the whole
-            # decision: on a tunneled TPU every fetched array is a full RPC
-            # round-trip (SURVEY.md §7 latency budget). Efficiency reporting
-            # runs as pure numpy on the host-resident cluster arrays — zero
-            # extra pulls.
-            _shim("h2d")
-            blob = _shimmed_device_get(
-                _pack_blob(
-                    tensors,
-                    jnp.asarray(driver_resources.as_array()),
-                    jnp.asarray(executor_resources.as_array()),
-                    jnp.int32(executor_count),
-                    jnp.asarray(driver_mask),
-                    jnp.asarray(domain_mask),
-                    fill=strategy,
-                    emax=emax,
-                    num_zones=self._num_zones_bucket(),
+        try:
+            with tracer().span(
+                "solve", strategy=strategy, nodes=n, executors=executor_count
+            ):
+                # ONE device->host transfer (one flat int32 blob) for the whole
+                # decision: on a tunneled TPU every fetched array is a full RPC
+                # round-trip (SURVEY.md §7 latency budget). Efficiency reporting
+                # runs as pure numpy on the host-resident cluster arrays — zero
+                # extra pulls.
+                _shim("h2d")
+                blob = _shimmed_device_get(
+                    _pack_blob(
+                        tensors,
+                        jnp.asarray(driver_resources.as_array()),
+                        jnp.asarray(executor_resources.as_array()),
+                        jnp.int32(executor_count),
+                        jnp.asarray(driver_mask),
+                        jnp.asarray(domain_mask),
+                        fill=strategy,
+                        emax=emax,
+                        num_zones=self._num_zones_bucket(),
+                    )
                 )
+        except Exception as exc:
+            if not (
+                classify_slot_failure(exc) and self.degraded is not None
+            ):
+                raise
+            # Solo pack does not thread the pipelined base, so the
+            # pipeline survives; just this decision serves degraded.
+            self._degraded_or_raise(exc)
+            self.last_solve_info = {
+                "path": "greedy-fallback",
+                "nodes": n,
+                "emax": emax,
+                "compile_cache_hit": None,
+                "degraded": True,
+            }
+            packing = self.fallback.pack(
+                strategy, host, driver_resources, executor_resources,
+                executor_count, driver_mask, domain_mask,
             )
+            self.degraded.on_fallback_decision()
+            return packing
         self.last_solve_info = {
             "path": "xla",
             "nodes": n,
@@ -1449,6 +1658,7 @@ class PlacementSolver:
             executor_resources.as_array(),
         )
         exec_idx = [int(x) for x in executor_nodes if int(x) >= 0]
+        self._device_recovered()
         return HostPacking(
             driver_node=self.registry.name_of(driver_idx) if driver_idx >= 0 else None,
             executor_nodes=[self.registry.name_of(i) for i in exec_idx],
@@ -1620,60 +1830,78 @@ class PlacementSolver:
         tel = self.telemetry
         compiles_before = tel.compile_count() if tel is not None else None
         seg_bucket = 1
-        with tracer().span(
-            "solve-dispatch", strategy=strategy, nodes=n,
-            window_requests=len(requests), window_rows=b, batched=True,
-            path=path,
-        ):
-            # One simulated h2d/dispatch boundary per DISPATCH, on the
-            # dispatcher thread — a fused K-window batch pays this once
-            # where K sequential dispatches pay it K times.
-            _shim("h2d")
-            if use_pallas:
-                win, seg_idx, row_idx, s_pad, r_pad = (
-                    _build_segmented_window(
-                        requests, drv_arr, exc_arr, counts, skip_arr,
-                        cand_per_req, dom_per_req,
+        try:
+            with tracer().span(
+                "solve-dispatch", strategy=strategy, nodes=n,
+                window_requests=len(requests), window_rows=b, batched=True,
+                path=path,
+            ):
+                # One simulated h2d/dispatch boundary per DISPATCH, on the
+                # dispatcher thread — a fused K-window batch pays this once
+                # where K sequential dispatches pay it K times.
+                _shim("h2d")
+                if use_pallas:
+                    win, seg_idx, row_idx, s_pad, r_pad = (
+                        _build_segmented_window(
+                            requests, drv_arr, exc_arr, counts, skip_arr,
+                            cand_per_req, dom_per_req,
+                        )
                     )
-                )
-                seg_map = (seg_idx, row_idx)
-                row_bucket, seg_bucket = r_pad, s_pad
-                blob, avail_after = _window_blob_pallas(
-                    tensors, win, fill=strategy,
-                    emax=emax, num_zones=self._num_zones_bucket(),
-                )
-            else:
-                row_bucket = _bucket(b, 32)
-                apps = make_app_batch(
-                    drv_arr,
-                    exc_arr,
-                    counts,
-                    skippable=skip_arr,
-                    # Coarse row bucket (32): window row counts jitter with
-                    # load and FIFO depth; each distinct bucket is a fresh
-                    # XLA compile, which on a remote TPU stalls live
-                    # serving for seconds.
-                    pad_to=row_bucket,
-                    driver_cand=np.stack(cand_rows),
-                    domain=np.stack(dom_rows),
-                    commit=commit,
-                    reset=reset,
-                )
-                if pipelined:
-                    # Double-buffered committed base: the pipeline owns the
-                    # availability buffer exclusively (nothing reads it
-                    # after this dispatch), so DONATE it — available_after
-                    # updates it in place instead of copy-on-write.
-                    blob, avail_after = _window_blob_donated(
-                        tensors.available, cluster_statics(tensors), apps,
-                        fill=strategy, emax=emax,
-                        num_zones=self._num_zones_bucket(),
+                    seg_map = (seg_idx, row_idx)
+                    row_bucket, seg_bucket = r_pad, s_pad
+                    blob, avail_after = _window_blob_pallas(
+                        tensors, win, fill=strategy,
+                        emax=emax, num_zones=self._num_zones_bucket(),
                     )
                 else:
-                    blob, avail_after = _window_blob(
-                        tensors, apps, fill=strategy, emax=emax,
-                        num_zones=self._num_zones_bucket(),
+                    row_bucket = _bucket(b, 32)
+                    apps = make_app_batch(
+                        drv_arr,
+                        exc_arr,
+                        counts,
+                        skippable=skip_arr,
+                        # Coarse row bucket (32): window row counts jitter with
+                        # load and FIFO depth; each distinct bucket is a fresh
+                        # XLA compile, which on a remote TPU stalls live
+                        # serving for seconds.
+                        pad_to=row_bucket,
+                        driver_cand=np.stack(cand_rows),
+                        domain=np.stack(dom_rows),
+                        commit=commit,
+                        reset=reset,
                     )
+                    if pipelined:
+                        # Double-buffered committed base: the pipeline owns the
+                        # availability buffer exclusively (nothing reads it
+                        # after this dispatch), so DONATE it — available_after
+                        # updates it in place instead of copy-on-write.
+                        blob, avail_after = _window_blob_donated(
+                            tensors.available, cluster_statics(tensors), apps,
+                            fill=strategy, emax=emax,
+                            num_zones=self._num_zones_bucket(),
+                        )
+                    else:
+                        blob, avail_after = _window_blob(
+                            tensors, apps, fill=strategy, emax=emax,
+                            num_zones=self._num_zones_bucket(),
+                        )
+        except Exception as exc:
+            if not classify_slot_failure(exc):
+                raise
+            # The single device (or its tunnel) failed AT DISPATCH. The
+            # pipelined base may be half-mutated (donation): drop it —
+            # the next build full-uploads host truth. Per the degraded
+            # policy: serve this window via the host greedy fallback, or
+            # shed (DegradedUnavailableError), or propagate (no
+            # controller wired).
+            priors = tuple(p["unfetched"]) if pipelined else ()
+            self._pipe = None
+            if tel is not None:
+                tel.on_pipeline_event("device-failure")
+            self._degraded_or_raise(exc)
+            return self._make_fallback_handle(
+                strategy, requests, host, n, priors
+            )
 
         info = {
             "path": path,
@@ -1725,6 +1953,7 @@ class PlacementSolver:
         handle.row_exec_req = exc_arr.astype(np.int64)
         handle.row_skippable = skip_arr
         handle.seg_map = seg_map  # pallas path: [S,R] blob -> flat rows
+        handle.host_tensors = host  # degraded-fallback re-solve inputs
         handle.info = info
         handle.dispatch_id = info["dispatch_id"]
         handle.dispatched_at = self._clock()
@@ -1738,6 +1967,70 @@ class PlacementSolver:
             )
             self._track(handle.blob_future)
         return handle
+
+    def _make_fallback_handle(
+        self, strategy, requests, host, n, priors
+    ) -> "WindowHandle":
+        """A dispatch-less window handle: no device touched it (degraded
+        'greedy' policy with no serving device); pack_window_fetch routes
+        it through the host greedy fallback. Keeps the two-phase
+        dispatch/fetch API intact so the serving loop and extender need
+        no special case."""
+        handle = WindowHandle(
+            strategy=strategy,
+            blob=None,
+            requests=tuple(requests),
+            flat_rows=[],
+            host_avail=np.array(np.asarray(host.available), dtype=np.int64),
+            host_schedulable=np.asarray(host.schedulable),
+            priors=priors,
+            n=n,
+        )
+        handle.use_fallback = True
+        handle.host_tensors = host
+        handle.info = {
+            "path": "greedy-fallback",
+            "nodes": n,
+            "rows": sum(len(r.rows) for r in requests),
+            "row_bucket": 0,
+            "emax": 0,
+            "state_upload": None,
+            "compile_cache_hit": None,
+            "dispatch_id": next(self._dispatch_seq),
+            "fused_k": 1,
+            "degraded": True,
+        }
+        handle.dispatch_id = handle.info["dispatch_id"]
+        handle.dispatched_at = self._clock()
+        self.last_solve_info = handle.info
+        self.window_path_counts["greedy-fallback"] = (
+            self.window_path_counts.get("greedy-fallback", 0) + 1
+        )
+        return handle
+
+    def _fetch_fallback(self, handle: "WindowHandle") -> "list[WindowDecision]":
+        """Serve a window on the host greedy fallback: the base is the
+        same reconstruction every fetch path uses (host view at dispatch
+        minus the placements of windows that were still in flight then),
+        so degraded decisions see exactly the availability a device solve
+        would have."""
+        base = handle.host_avail.copy()
+        for prior in handle.priors:
+            if prior.placements is not None:
+                base -= prior.placements
+        decisions, placements = self.fallback.window_decisions(
+            handle.strategy, handle.host_tensors, base, handle.requests
+        )
+        handle.placements = placements
+        d = self.degraded
+        if d is not None:
+            d.on_fallback_decision(len(decisions))
+        p = self._pipe
+        if p is not None and handle in p["unfetched"]:
+            p["unfetched"].remove(handle)
+            p["mirror"] -= placements
+        self._note_dispatch_complete(handle)
+        return decisions
 
     def _track(self, fut) -> None:
         """Register an in-flight pool future for cancel-on-close()."""
@@ -1839,6 +2132,24 @@ class PlacementSolver:
         solve_pool = _shared_solve_pool()
         now = self._clock()
 
+        # Quarantine gate: probe any quarantined slot whose interval
+        # elapsed; with NO healthy slot left, serve per the degraded
+        # policy instead of dispatching into a dead pool.
+        if pool.quarantined_slots():
+            self.probe_quarantined()
+        if not pool.healthy_slots():
+            exc = AllSlotsQuarantinedError(
+                f"all {len(pool.slots)} device slot(s) quarantined"
+            )
+            priors = tuple(p["unfetched"])
+            self._pipe = None
+            if tel is not None:
+                tel.on_pipeline_event("device-failure")
+            self._degraded_or_raise(exc)
+            return self._make_fallback_handle(
+                strategy, requests, host, n, priors
+            )
+
         # ---- partition plan: ≥2 distinct domain keys, all keyed, masks
         # pairwise disjoint and non-empty. Plain-device slots only — a
         # sharded (mesh) slot solves the whole window over the node axis.
@@ -1891,6 +2202,9 @@ class PlacementSolver:
                 driver_cand=np.stack(cand_g), domain=np.stack(dom_g),
                 commit=commit_g, reset=reset_g,
             )
+            # Host-side copy kept on the part for slot-failure re-dispatch
+            # (place_apps may shard `apps` onto the dying slot's mesh).
+            apps_host = apps
             epoch = self._static_epoch
             # Simulated h2d boundary on the DISPATCHER thread: the pooled
             # engine still ships one window-batch upload per partition
@@ -1966,51 +2280,73 @@ class PlacementSolver:
                 row_drv=drv_g.astype(np.int64),
                 row_exc=exc_g.astype(np.int64),
                 row_skip=skip_g, idx=idx, slot=slot, rows=b_g,
+                idx_key=idx_key, apps=apps_host,
             )
 
-        with tracer().span(
-            "solve-dispatch", strategy=strategy, nodes=n,
-            window_requests=len(requests), window_rows=len(drv_arr),
-            batched=True, path="pool",
-            partitions=len(plan) if plan else 1,
-        ):
-            if plan is None:
-                parts.append(
-                    submit_part(
-                        pool.next_slot(), list(range(len(requests))),
-                        None, None,
-                    )
-                )
-                head = parts[0]
-                p["avail"] = _PendingBase(
-                    lambda: head.after_future.result()
-                )
-            else:
-                for key, req_ids in plan:
-                    idx = np.flatnonzero(
-                        dom_per_req[req_ids[0]]
-                    ).astype(np.int32)
+        try:
+            with tracer().span(
+                "solve-dispatch", strategy=strategy, nodes=n,
+                window_requests=len(requests), window_rows=len(drv_arr),
+                batched=True, path="pool",
+                partitions=len(plan) if plan else 1,
+            ):
+                if plan is None:
                     parts.append(
-                        submit_part(pool.next_slot(), req_ids, key, idx)
+                        submit_part(
+                            pool.next_slot(), list(range(len(requests))),
+                            None, None,
+                        )
                     )
-
-                def combine(parts=parts, base=base):
-                    # Scatter every partition's committed sub-base back
-                    # into the global base (disjoint rows; the base is
-                    # DONATED through the chain — in-place double-buffer).
-                    # Waits only on the solves (after_future), never on
-                    # the decision-blob transfers.
-                    out = base
-                    for part in parts:
-                        rows = jax.device_put(
-                            part.after_future.result(), base_device
+                    head = parts[0]
+                    p["avail"] = _PendingBase(
+                        lambda: head.after_future.result()
+                    )
+                else:
+                    for key, req_ids in plan:
+                        idx = np.flatnonzero(
+                            dom_per_req[req_ids[0]]
+                        ).astype(np.int32)
+                        parts.append(
+                            submit_part(pool.next_slot(), req_ids, key, idx)
                         )
-                        out = _scatter_rows_exact_donated(
-                            out, jnp.asarray(part.idx), rows
-                        )
-                    return out
 
-                p["avail"] = _PendingBase(combine)
+                    def combine(parts=parts, base=base):
+                        # Scatter every partition's committed sub-base back
+                        # into the global base (disjoint rows; the base is
+                        # DONATED through the chain — in-place double-buffer).
+                        # Waits only on the solves (after_future), never on
+                        # the decision-blob transfers.
+                        out = base
+                        for part in parts:
+                            rows = jax.device_put(
+                                part.after_future.result(), base_device
+                            )
+                            out = _scatter_rows_exact_donated(
+                                out, jnp.asarray(part.idx), rows
+                            )
+                        return out
+
+                    p["avail"] = _PendingBase(combine)
+        except Exception as exc:
+            if not classify_slot_failure(exc):
+                raise
+            # A device boundary failed ON THE DISPATCHER THREAD (window
+            # upload): already-submitted partitions are cancelled, the
+            # threaded base is suspect, and the window serves per the
+            # degraded policy.
+            for part in parts:
+                part.future.cancel()
+                part.slot.inflight = max(0, part.slot.inflight - 1)
+                if tel is not None:
+                    tel.on_device_inflight(part.slot.label, part.slot.inflight)
+            priors = tuple(p["unfetched"])
+            self._pipe = None
+            if tel is not None:
+                tel.on_pipeline_event("device-failure")
+            self._degraded_or_raise(exc)
+            return self._make_fallback_handle(
+                strategy, requests, host, n, priors
+            )
 
         self.window_path_counts["pool"] = (
             self.window_path_counts.get("pool", 0) + 1
@@ -2055,6 +2391,7 @@ class PlacementSolver:
         )
         handle.parts = parts
         handle.request_device = request_device
+        handle.host_tensors = host  # slot-failure re-dispatch inputs
         handle.info = info
         handle.dispatch_id = info["dispatch_id"]
         handle.dispatched_at = self._clock()
@@ -2073,7 +2410,7 @@ class PlacementSolver:
             if res is None:
                 try:
                     res = ("ok", self.pack_window_fetch(owner))
-                except BaseException as exc:
+                except Exception as exc:
                     res = ("err", exc)
                 owner.fused_decisions = res
             kind, val = res
@@ -2087,6 +2424,8 @@ class PlacementSolver:
             raise RuntimeError("window dispatch was discarded")
         if not handle.requests:
             return []
+        if handle.use_fallback:
+            return self._fetch_fallback(handle)
         if handle.parts is not None:
             return self._fetch_pooled(handle)
         from spark_scheduler_tpu.tracing import tracer
@@ -2101,7 +2440,7 @@ class PlacementSolver:
                     blob = handle.blob_future.result()
                 else:
                     blob = _shimmed_device_get(handle.blob)
-            except Exception:
+            except Exception as exc:
                 # The device base embodies this window's (now unknowable)
                 # placements while no reservation was created for them.
                 # Drop the whole pipeline: the next build does a full upload
@@ -2112,6 +2451,17 @@ class PlacementSolver:
                 self._pipe = None
                 if self.telemetry is not None:
                     self.telemetry.on_pipeline_event("fetch-failure")
+                if (
+                    classify_slot_failure(exc)
+                    and handle.host_tensors is not None
+                    and self.degraded is not None
+                ):
+                    # Single device, no survivor: the degraded policy
+                    # answers — host greedy re-solve of THIS window (its
+                    # decisions are not yet applied anywhere, so the
+                    # re-solve is exact), or shed.
+                    self._degraded_or_raise(exc)
+                    return self._fetch_fallback(handle)
                 raise
         if self.telemetry is not None:
             self.telemetry.on_transfer("d2h", getattr(blob, "nbytes", 0))
@@ -2147,6 +2497,7 @@ class PlacementSolver:
             p["unfetched"].remove(handle)
             p["mirror"] -= placements
         self._note_dispatch_complete(handle)
+        self._device_recovered()
         return decisions
 
     def _note_dispatch_complete(self, handle) -> None:
@@ -2184,32 +2535,79 @@ class PlacementSolver:
             path="pool", partitions=len(handle.parts),
         ):
             for part_i, part in enumerate(handle.parts):
+                redispatched = False
                 try:
                     out = part.future.result()
-                except Exception:
-                    # Same contract as a single-device fetch failure: the
-                    # device base embodies unknowable placements, so the
-                    # whole pipeline drops and the next build re-uploads
-                    # host truth (the dead combine is skipped by
-                    # _resolve_base the same way). Only the parts not yet
-                    # processed release their in-flight slots here —
-                    # earlier parts already did.
-                    self._pipe = None
-                    for pt in handle.parts[part_i:]:
-                        pt.slot.inflight = max(0, pt.slot.inflight - 1)
+                except Exception as exc:
+                    part.slot.inflight = max(0, part.slot.inflight - 1)
+                    if tel is not None:
+                        tel.on_device_inflight(
+                            part.slot.label, part.slot.inflight
+                        )
+                    recoverable = (
+                        classify_slot_failure(exc)
+                        and part.apps is not None
+                        and handle.host_tensors is not None
+                    )
+                    if not recoverable:
+                        # Same contract as a single-device fetch failure:
+                        # the device base embodies unknowable placements,
+                        # so the whole pipeline drops and the next build
+                        # re-uploads host truth (the dead combine is
+                        # skipped by _resolve_base the same way). Only
+                        # the parts not yet processed release their
+                        # in-flight slots here — earlier parts already
+                        # did.
+                        self._pipe = None
+                        for pt in handle.parts[part_i + 1:]:
+                            pt.slot.inflight = max(0, pt.slot.inflight - 1)
+                            if tel is not None:
+                                tel.on_device_inflight(
+                                    pt.slot.label, pt.slot.inflight
+                                )
                         if tel is not None:
-                            tel.on_device_inflight(
-                                pt.slot.label, pt.slot.inflight
-                            )
+                            tel.on_pipeline_event("fetch-failure")
+                        raise
+                    # SLOT FAILURE RECOVERY: quarantine the slot (its
+                    # resident state is unreachable; the threaded base it
+                    # fed is poisoned — pipeline rebuilds from host
+                    # truth), then re-dispatch this partition on a
+                    # surviving slot with byte-identical inputs. With no
+                    # survivor, the degraded policy answers (greedy
+                    # fallback decisions, or shed).
+                    self._pipe = None
                     if tel is not None:
                         tel.on_pipeline_event("fetch-failure")
-                    raise
+                    self._quarantine_slot(part.slot, exc)
+                    try:
+                        recovered = self._redispatch_part(handle, part, base)
+                    except Exception:
+                        for pt in handle.parts[part_i + 1:]:
+                            pt.slot.inflight = max(0, pt.slot.inflight - 1)
+                            if tel is not None:
+                                tel.on_device_inflight(
+                                    pt.slot.label, pt.slot.inflight
+                                )
+                        raise
+                    if isinstance(recovered, tuple):
+                        # Greedy-fallback decisions for this part: apply
+                        # its placements to the shared base and move on.
+                        decs, ppl = recovered
+                        base -= ppl
+                        placements += ppl
+                        for rid, d in zip(part.req_ids, decs):
+                            results[rid] = d
+                        continue
+                    out = recovered
+                    redispatched = True
                 blob = out["blob"]
-                part.slot.inflight = max(0, part.slot.inflight - 1)
+                if not redispatched:
+                    part.slot.inflight = max(0, part.slot.inflight - 1)
                 if tel is not None:
                     tel.on_transfer("d2h", blob.nbytes)
                     tel.on_device_window(
-                        part.slot.label, out["solve_ms"], out["fetch_ms"],
+                        out.get("device", part.slot.label),
+                        out["solve_ms"], out["fetch_ms"],
                         inflight=part.slot.inflight,
                     )
                 drivers = blob[:, 0].astype(np.int64)
@@ -2239,7 +2637,103 @@ class PlacementSolver:
             p["unfetched"].remove(handle)
             p["mirror"] -= placements
         self._note_dispatch_complete(handle)
+        self._device_recovered()
         return results
+
+    def _redispatch_part(self, handle: "WindowHandle", part: "_WindowPart", base):
+        """Re-run a failed partition's solve on a SURVIVING slot with
+        byte-identical inputs: the availability rows come from the host
+        reconstruction (`base` — host view at dispatch minus in-flight
+        priors' placements, which is exactly what the dead slot's device
+        base embodied; partitions are row-disjoint, so earlier parts'
+        commits cannot touch this part's rows), the statics re-upload to
+        the survivor, and the app batch is the part's stashed host copy.
+        Slot choice never affects decisions (pool invariant), so the
+        retried decisions equal what the dead slot would have returned —
+        pinned by tests/test_slot_recovery.py.
+
+        Returns a worker-style {"blob", "solve_ms", "fetch_ms", "device"}
+        dict, or (decisions, placements) when NO slot survives and the
+        degraded policy is greedy. Raises DegradedUnavailableError (shed)
+        or AllSlotsQuarantinedError (no controller) otherwise."""
+        pool = self._pool
+        host = handle.host_tensors
+        strategy = handle.strategy
+        emax = (handle.info or {}).get("emax")
+        # Safe to recompute: a zone-set change implies a node event, which
+        # forces a pipeline drain BEFORE any new dispatch — no window can
+        # be in flight across it.
+        num_zones = self._num_zones_bucket()
+        while True:
+            self.probe_quarantined()
+            healthy = pool.healthy_slots()
+            if not healthy:
+                exc = AllSlotsQuarantinedError(
+                    "no surviving slot for re-dispatch"
+                )
+                self._degraded_or_raise(exc)
+                decs, ppl = self.fallback.window_decisions(
+                    strategy, host, base, part.requests
+                )
+                if self.degraded is not None:
+                    self.degraded.on_fallback_decision(len(decs))
+                return decs, ppl
+            slot = min(healthy, key=lambda s: s.inflight)
+            t0 = self._clock()
+            try:
+                _shim("h2d")
+                epoch = self._static_epoch
+                if part.idx is None:
+                    statics = slot.resident_statics(
+                        host, epoch, self._clock, self.telemetry
+                    )
+                    avail_rows = base
+                else:
+                    statics = slot.sub_replica(
+                        host, part.idx_key, part.idx, epoch, self._clock,
+                        self.telemetry,
+                    )
+                    avail_rows = base[part.idx]
+                sub_avail = slot._put(
+                    np.asarray(avail_rows, dtype=np.int32)
+                )
+                apps = slot.place_apps(part.apps)
+                fn = (
+                    _window_blob_statics if slot.is_mesh
+                    else _window_blob_donated
+                )
+                _shim("dispatch")
+                blob, _after = fn(
+                    sub_avail, statics, apps,
+                    fill=strategy, emax=emax, num_zones=num_zones,
+                )
+                t1 = self._clock()
+                _shim("d2h")
+                blob_np = np.asarray(jax.device_get(blob))
+                t2 = self._clock()
+            except Exception as exc:
+                if classify_slot_failure(exc):
+                    # The survivor died too (e.g. the fault is the shared
+                    # tunnel, not one device): quarantine it and keep
+                    # walking the pool.
+                    self._quarantine_slot(slot, exc)
+                    continue
+                raise
+            self.redispatch_count += 1
+            self._on_slot_event("redispatch", slot.label)
+            if handle.info is not None:
+                handle.info["redispatches"] = (
+                    handle.info.get("redispatches", 0) + 1
+                )
+            if handle.request_device is not None:
+                for r in part.req_ids:
+                    handle.request_device[r] = slot.label
+            return {
+                "blob": blob_np,
+                "solve_ms": (t1 - t0) * 1e3,
+                "fetch_ms": (t2 - t1) * 1e3,
+                "device": slot.label,
+            }
 
     def _reconstruct_requests(
         self, requests, drivers, admitted, packed, execs,

@@ -1,0 +1,66 @@
+"""Fault-tolerance subsystem (ISSUE 9).
+
+Three pieces, deliberately small and dependency-free so every layer of the
+scheduler can import them:
+
+  errors     the exception taxonomy: InjectedFault / DeviceFaultError
+             (slot-fatal), AllSlotsQuarantinedError, DegradedUnavailable,
+             RetryDeadlineExceeded / AttemptTimeoutError / BreakerOpenError.
+  retry      RetryPolicy (exponential backoff + full jitter + per-attempt
+             timeout + overall deadline) and CircuitBreaker
+             (closed -> open -> half-open -> closed) — the one retry ladder
+             the kube async client, backend write-back, lease renewals,
+             reflector relists, and the autoscaler loop all ride.
+  injector   FaultPlan / FaultSpec / FaultInjector — seeded, deterministic
+             schedules of latency/error/partition faults over NAMED
+             surfaces (backend verbs, kube async-client writes, device
+             h2d/dispatch/d2h, lease store, WAL append/fsync). Subsumes
+             the ad-hoc `backend.fault_injector` lambda and composes with
+             the rtt_shim at the device seam.
+  degraded   DegradedModeController — the `server.degraded-mode` policy
+             (host-side greedy fallback vs 503+Retry-After shedding) the
+             extender consults when every device slot is quarantined.
+"""
+
+from spark_scheduler_tpu.faults.errors import (
+    AllSlotsQuarantinedError,
+    AttemptTimeoutError,
+    BreakerOpenError,
+    DegradedUnavailableError,
+    DeviceFaultError,
+    InjectedFault,
+    RetryDeadlineExceeded,
+    classify_slot_failure,
+)
+from spark_scheduler_tpu.faults.retry import CircuitBreaker, RetryPolicy
+from spark_scheduler_tpu.faults.injector import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    FaultyLeaseStore,
+)
+from spark_scheduler_tpu.faults.degraded import (
+    DEGRADED_GREEDY,
+    DEGRADED_SHED,
+    DegradedModeController,
+)
+
+__all__ = [
+    "AllSlotsQuarantinedError",
+    "AttemptTimeoutError",
+    "BreakerOpenError",
+    "CircuitBreaker",
+    "DEGRADED_GREEDY",
+    "DEGRADED_SHED",
+    "DegradedModeController",
+    "DegradedUnavailableError",
+    "DeviceFaultError",
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "FaultyLeaseStore",
+    "InjectedFault",
+    "RetryDeadlineExceeded",
+    "RetryPolicy",
+    "classify_slot_failure",
+]

@@ -169,11 +169,34 @@ class LeaseManager:
     on. Thread-safe — the heartbeat thread renews while request threads
     check the fence."""
 
-    def __init__(self, store, holder: str, ttl_s: float = 3.0, clock=time.time):
+    def __init__(
+        self,
+        store,
+        holder: str,
+        ttl_s: float = 3.0,
+        clock=time.time,
+        retry_policy=None,
+        breaker=None,
+    ):
         self._store = store
         self.holder = holder
         self.ttl_s = ttl_s
         self._clock = clock
+        # Lease-store IO rides the shared retry ladder (ISSUE 9): a
+        # transient store blip must not read as "deposed" — the renew
+        # retries inside the heartbeat's budget. Defaults keep total
+        # retry time well under the TTL (a renew that outlives the TTL
+        # is worse than one that fails: the next tick re-elects). The
+        # breaker stops a dead store being hammered at heartbeat rate.
+        from spark_scheduler_tpu.faults.retry import RetryPolicy
+
+        self._retry_policy = retry_policy or RetryPolicy(
+            max_attempts=3,
+            base_delay_s=min(0.05, ttl_s / 30.0),
+            multiplier=2.0,
+            max_delay_s=max(0.05, ttl_s / 6.0),
+        )
+        self._breaker = breaker
         self._lock = threading.Lock()
         # The epoch THIS replica acquired (0 = never held). Fenced writes
         # compare it against the live record's epoch.
@@ -187,6 +210,21 @@ class LeaseManager:
         # open+parse of the sidecar on the predicate hot path).
         self._last_affirmed = float("-inf")
 
+    # -- store IO (retry ladder) -------------------------------------------
+
+    def _read(self):
+        return self._retry_policy.call(
+            self._store.read, breaker=self._breaker
+        )
+
+    def _cas(self, expect, record) -> bool:
+        # Only the STORE-level failure retries; a lost CAS returns False
+        # immediately (someone else won — retrying would be a livelock).
+        return self._retry_policy.call(
+            lambda: self._store.compare_and_swap(expect, record),
+            breaker=self._breaker,
+        )
+
     # -- election ----------------------------------------------------------
 
     def try_acquire(self) -> bool:
@@ -194,10 +232,9 @@ class LeaseManager:
         lease bumps the epoch (the fencing token); holding it already just
         renews. False when another holder's lease is live or the CAS lost."""
         now = self._clock()
-        cur = self._store.read()
+        cur = self._read()
         if cur is None:
-            ok = self._store.compare_and_swap(
-                None,
+            ok = self._cas(None,
                 LeaseRecord(self.holder, 1, now, self.ttl_s),
             )
             if ok:
@@ -209,8 +246,7 @@ class LeaseManager:
             return self.renew()
         if not cur.expired(now):
             return False
-        ok = self._store.compare_and_swap(
-            cur,
+        ok = self._cas(cur,
             LeaseRecord(self.holder, cur.epoch + 1, now, self.ttl_s),
         )
         if ok:
@@ -226,12 +262,11 @@ class LeaseManager:
             epoch = self.acquired_epoch
         if not epoch:
             return False
-        cur = self._store.read()
+        cur = self._read()
         if cur is None or cur.holder != self.holder or cur.epoch != epoch:
             return False
         now = self._clock()
-        ok = self._store.compare_and_swap(
-            cur,
+        ok = self._cas(cur,
             LeaseRecord(self.holder, epoch, now, self.ttl_s),
         )
         if ok:
@@ -248,10 +283,9 @@ class LeaseManager:
             self._last_affirmed = float("-inf")
         if not epoch:
             return
-        cur = self._store.read()
+        cur = self._read()
         if cur is not None and cur.holder == self.holder and cur.epoch == epoch:
-            self._store.compare_and_swap(
-                cur, LeaseRecord("", epoch, 0.0, self.ttl_s)
+            self._cas(cur, LeaseRecord("", epoch, 0.0, self.ttl_s)
             )
 
     # -- fencing -----------------------------------------------------------
@@ -272,7 +306,7 @@ class LeaseManager:
             return False
         if self._clock() - last < self.ttl_s:
             return True
-        cur = self._store.read()
+        cur = self._read()
         return (
             cur is not None
             and cur.holder == self.holder
@@ -287,7 +321,7 @@ class LeaseManager:
         store) or one small file read (WAL sidecar)."""
         with self._lock:
             epoch = self.acquired_epoch
-        cur = self._store.read()
+        cur = self._read()
         if (
             not epoch
             or cur is None
@@ -305,7 +339,7 @@ class LeaseManager:
     # -- introspection -----------------------------------------------------
 
     def state(self) -> dict:
-        cur = self._store.read()
+        cur = self._read()
         now = self._clock()
         return {
             "holder": self.holder,

@@ -30,11 +30,13 @@ import time
 from typing import Any, Callable, Optional
 from urllib.parse import urlparse
 
+from spark_scheduler_tpu.faults.retry import RetryPolicy
 from spark_scheduler_tpu.server.kube_io import node_from_k8s, pod_from_k8s
 
 LIST_TIMEOUT_S = 10.0
 WATCH_TIMEOUT_S = 30.0  # per-request watch window; the loop re-arms
 RELIST_BACKOFF_S = 0.2
+RELIST_BACKOFF_CAP_S = 30.0  # a down apiserver is probed, not hammered
 INFORMER_DELAY_METRIC = "foundry.spark.scheduler.informer.delay"
 
 
@@ -114,6 +116,7 @@ class Reflector:
         name: str = "",
         watch_timeout_s: float = WATCH_TIMEOUT_S,
         relist_backoff_s: float = RELIST_BACKOFF_S,
+        retry_policy: Optional[RetryPolicy] = None,
         ca_file: Optional[str] = None,
         token_file: Optional[str] = None,
         insecure_skip_tls_verify: bool = False,
@@ -143,6 +146,20 @@ class Reflector:
         self.name = name or collection_path
         self._watch_timeout_s = watch_timeout_s
         self._relist_backoff_s = relist_backoff_s
+        # Relist/rewatch backoff (ISSUE 9 satellite): the old fixed
+        # `relist_backoff_s` sleep hammered a down apiserver at 5 Hz
+        # forever; now it is only the policy's BASE — consecutive
+        # failures back off exponentially (full jitter, capped), and any
+        # successful list or watch window resets the ladder.
+        # max_attempts=None: a reflector retries forever by contract.
+        self._retry_policy = retry_policy or RetryPolicy(
+            max_attempts=None,
+            base_delay_s=relist_backoff_s,
+            multiplier=2.0,
+            max_delay_s=RELIST_BACKOFF_CAP_S,
+        )
+        self._consecutive_failures = 0
+        self.backoff_total_s = 0.0  # observable: cumulative backoff slept
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -183,6 +200,24 @@ class Reflector:
     def has_synced(self) -> bool:
         return self._synced.is_set()
 
+    # -- backoff ------------------------------------------------------------
+
+    def _note_success(self) -> None:
+        self._consecutive_failures = 0
+
+    def _failure_backoff(self) -> float:
+        """Delay before the next attempt: exponential in the consecutive-
+        failure count, full-jittered, capped. Split from the wait so
+        tests pin the ladder without a live socket."""
+        delay = self._retry_policy.delay(self._consecutive_failures)
+        self._consecutive_failures += 1
+        return delay
+
+    def _backoff_wait(self) -> None:
+        delay = self._failure_backoff()
+        self.backoff_total_s += delay
+        self._stop.wait(delay)
+
     def wait_synced(self, timeout: Optional[float] = None) -> bool:
         return self._synced.wait(timeout)
 
@@ -202,23 +237,28 @@ class Reflector:
             except Exception:
                 if self._stop.is_set():
                     return
-                self._stop.wait(self._relist_backoff_s)
+                self._backoff_wait()
 
     def _list_and_watch(self) -> None:
         rv = self._list()
         self.last_resource_version = rv
         self._synced.set()
+        self._note_success()
         while not self._stop.is_set():
             try:
                 self._watch_once()
+                # A watch window that ended cleanly (server closed it, or
+                # events flowed) means the apiserver is healthy again.
+                self._note_success()
             except (GoneError, CollectionAbsentError):
                 raise
             except (OSError, http.client.HTTPException):
                 if self._stop.is_set():
                     return
                 # Transient stream loss: resume from the last seen rv
-                # without relisting (reflector resume semantics).
-                self._stop.wait(self._relist_backoff_s)
+                # without relisting (reflector resume semantics), backing
+                # off on consecutive failures.
+                self._backoff_wait()
 
     def _connect(self, timeout: float) -> http.client.HTTPConnection:
         if not self._tls:

@@ -24,6 +24,7 @@ from spark_scheduler_tpu.observability.recorder import (  # noqa: F401
 )
 from spark_scheduler_tpu.observability.telemetry import (  # noqa: F401
     HATelemetry,
+    RetryTelemetry,
     SolverTelemetry,
     TransportTelemetry,
     compile_stats,
@@ -40,6 +41,7 @@ __all__ = [
     "DecisionRecord",
     "FlightRecorder",
     "HATelemetry",
+    "RetryTelemetry",
     "SolverTelemetry",
     "TransportTelemetry",
     "compile_stats",

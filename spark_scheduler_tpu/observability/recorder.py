@@ -81,6 +81,12 @@ class DecisionRecord:
     # Set by the autoscaler when the demand this denial created is
     # fulfilled: {"fulfilled_at", "latency_s"}.
     demand: Optional[dict[str, float]] = None
+    # Fault-tolerance provenance (ISSUE 9): True when NO device solved
+    # this decision (the host greedy fallback served it under the
+    # degraded-mode policy), and how many device-slot re-dispatches the
+    # decision's window survived (None/0 = clean dispatch).
+    degraded: Optional[bool] = None
+    redispatches: Optional[int] = None
 
     def to_dict(self) -> dict:
         out = dataclasses.asdict(self)
@@ -125,6 +131,8 @@ class FlightRecorder:
         state_upload: Optional[str] = None,
         fused_k: Optional[int] = None,
         dispatch_id: Optional[int] = None,
+        degraded: Optional[bool] = None,
+        redispatches: Optional[int] = None,
     ) -> DecisionRecord:
         if (
             failed_nodes
@@ -162,6 +170,8 @@ class FlightRecorder:
             state_upload=state_upload,
             fused_k=fused_k,
             dispatch_id=dispatch_id,
+            degraded=degraded,
+            redispatches=redispatches,
         )
         with self._lock:
             self._ring.append(rec)

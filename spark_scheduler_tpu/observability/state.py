@@ -116,4 +116,19 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
         # re-walked state vs served the resident snapshot (the O(changed)
         # evidence, live).
         out["feature_store"] = features.stats()
+    solver = getattr(app, "solver", None)
+    if solver is not None:
+        # Fault tolerance (ISSUE 9): device-slot quarantine state, the
+        # degraded-mode controller, and how many partitions were ever
+        # re-dispatched onto a survivor — the operator's first stop when
+        # readiness reports degraded.
+        health = solver.device_health()
+        faults = {
+            "device": health,
+            "redispatches": solver.redispatch_count,
+        }
+        degraded = getattr(solver, "degraded", None)
+        if degraded is not None:
+            faults["degraded"] = degraded.snapshot()
+        out["faults"] = faults
     return out

@@ -36,6 +36,27 @@ DEVICE_FETCH_MS = "foundry.spark.scheduler.solver.device.fetch.ms"
 DEVICE_RESIDENT_AGE = (
     "foundry.spark.scheduler.solver.device.resident.age.seconds"
 )
+# Device-slot quarantine/recovery (ISSUE 9, core/solver.py _DevicePool):
+# events tagged event=quarantine|reinstate|redispatch|probe-failed and a
+# live count of quarantined slots.
+DEVICE_QUARANTINE_EVENTS = (
+    "foundry.spark.scheduler.solver.device.quarantine.events"
+)
+DEVICE_QUARANTINE_ACTIVE = (
+    "foundry.spark.scheduler.solver.device.quarantine.active"
+)
+# Fault-tolerance subsystem (spark_scheduler_tpu/faults/): injected-fault
+# counts per surface, retry-ladder activity, breaker state, and the
+# degraded-mode gauge readiness keys on.
+FAULTS_INJECTED = "foundry.spark.scheduler.faults.injected"
+FAULTS_DEGRADED_ACTIVE = "foundry.spark.scheduler.faults.degraded.active"
+RETRY_ATTEMPTS = "foundry.spark.scheduler.retry.attempts"
+RETRY_BACKOFF_MS = "foundry.spark.scheduler.retry.backoff.ms"
+RETRY_BREAKER_STATE = "foundry.spark.scheduler.retry.breaker.state"
+RETRY_BREAKER_OPENS = "foundry.spark.scheduler.retry.breaker.opens"
+
+# Breaker-state gauge encoding (a label would fragment the series).
+BREAKER_STATE_VALUES = {"closed": 0, "half-open": 1, "open": 2}
 # Fused multi-window dispatch engine (core/solver.py
 # pack_windows_dispatch): how many windows each device dispatch carried,
 # the per-window share of the dispatch->decisions round trip, and how
@@ -239,6 +260,21 @@ class SolverTelemetry:
         if inflight is not None:
             self.on_device_inflight(device, inflight)
 
+    # -- quarantine / degraded (ISSUE 9) -------------------------------------
+
+    def on_slot_event(self, event: str, device: str) -> None:
+        """quarantine | reinstate | redispatch | probe-failed — the
+        slot-failure recovery machinery's countable transitions."""
+        self.registry.counter(
+            DEVICE_QUARANTINE_EVENTS, event=event, device=device
+        ).inc()
+
+    def on_quarantine_count(self, count: int) -> None:
+        self.registry.gauge(DEVICE_QUARANTINE_ACTIVE).set(int(count))
+
+    def on_degraded(self, active: bool) -> None:
+        self.registry.gauge(FAULTS_DEGRADED_ACTIVE).set(1 if active else 0)
+
     # -- pipeline ------------------------------------------------------------
 
     def on_pipeline_event(self, event: str) -> None:
@@ -255,6 +291,55 @@ class SolverTelemetry:
             self.registry.counter(TRANSFER_BYTES, direction=direction).inc(
                 int(nbytes)
             )
+
+
+class RetryTelemetry:
+    """`foundry.spark.scheduler.retry.*` — the shared retry ladder's
+    activity, tagged by consumer (kube-write-back, lease, reflector,
+    autoscaler) so one hammering consumer is attributable."""
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        self.registry = registry or MetricRegistry()
+
+    def on_retry(self, consumer: str, attempt: int, backoff_s: float) -> None:
+        self.registry.counter(RETRY_ATTEMPTS, consumer=consumer).inc()
+        self.registry.histogram(RETRY_BACKOFF_MS, consumer=consumer).update(
+            round(backoff_s * 1e3, 3)
+        )
+
+    def retry_hook(self, consumer: str):
+        """fn(attempt, exc, pause) for RetryPolicy.call's on_retry."""
+
+        def hook(attempt, exc, pause) -> None:
+            self.on_retry(consumer, attempt, pause)
+
+        return hook
+
+    def breaker_hook(self, consumer: str):
+        """fn(old, new) for CircuitBreaker's on_transition."""
+
+        def hook(old: str, new: str) -> None:
+            self.registry.gauge(
+                RETRY_BREAKER_STATE, consumer=consumer
+            ).set(BREAKER_STATE_VALUES.get(new, -1))
+            if new == "open":
+                self.registry.counter(
+                    RETRY_BREAKER_OPENS, consumer=consumer
+                ).inc()
+
+        return hook
+
+    def fault_hook(self):
+        """fn(surface, action) for FaultInjector.on_fire: per-surface
+        injected-fault counts, so a chaos run's blast radius reads
+        straight off /metrics."""
+
+        def hook(surface: str, action: str) -> None:
+            self.registry.counter(
+                FAULTS_INJECTED, surface=surface, action=action
+            ).inc()
+
+        return hook
 
 
 class TransportTelemetry:

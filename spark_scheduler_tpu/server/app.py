@@ -113,15 +113,52 @@ def build_scheduler_app(
         backend, webhook_url=config.conversion_webhook_url
     )
 
+    # Shared retry ladder (ISSUE 9): ONE policy shape for every kube
+    # write-back consumer, with a per-kind circuit breaker so a down
+    # backend is probed instead of hammered. `async_client_retry_count`
+    # remains the attempt budget exactly as before.
+    from spark_scheduler_tpu.faults.retry import CircuitBreaker, RetryPolicy
+    from spark_scheduler_tpu.observability.telemetry import RetryTelemetry
+
+    retry_policy = RetryPolicy(
+        max_attempts=config.async_client_retry_count + 1,
+        base_delay_s=config.retry_base_delay_s,
+        multiplier=config.retry_multiplier,
+        max_delay_s=config.retry_max_delay_s,
+    )
+    retry_telemetry = RetryTelemetry(
+        metrics.registry if metrics is not None else None
+    )
+
+    def _breaker(consumer: str):
+        if config.breaker_failure_threshold <= 0:
+            return None
+        return CircuitBreaker(
+            failure_threshold=config.breaker_failure_threshold,
+            reset_timeout_s=config.breaker_reset_timeout_s,
+            on_transition=retry_telemetry.breaker_hook(consumer),
+            name=consumer,
+        )
+
     rr_cache = ResourceReservationCache(
         backend,
         max_retries=config.async_client_retry_count,
         sync_writes=config.sync_writes,
+        retry_policy=retry_policy,
+        breaker=_breaker("rr-write-back"),
+        on_retry=lambda n, pause: retry_telemetry.on_retry(
+            "rr-write-back", n, pause
+        ),
     )
     demand_cache = SafeDemandCache(
         backend,
         max_retries=config.async_client_retry_count,
         sync_writes=config.sync_writes,
+        retry_policy=retry_policy,
+        breaker=_breaker("demand-write-back"),
+        on_retry=lambda n, pause: retry_telemetry.on_retry(
+            "demand-write-back", n, pause
+        ),
     )
     soft_store = SoftReservationStore(backend)
     pod_lister = SparkPodLister(backend, config.instance_group_label)
@@ -197,6 +234,7 @@ def build_scheduler_app(
         ),
         device_pool=config.solver_device_pool,
         mesh=mesh,
+        quarantine_probe_s=config.quarantine_probe_s,
     )
     recorder = None
     if config.flight_recorder:
@@ -216,6 +254,21 @@ def build_scheduler_app(
         solver.telemetry = SolverTelemetry(
             metrics.registry if metrics is not None else None
         )
+    # Degraded-mode controller (ISSUE 9): when no device slot can serve,
+    # the solver consults this policy — host greedy fallback or
+    # 503+Retry-After shedding. Readiness and /debug/state reflect it.
+    from spark_scheduler_tpu.faults.degraded import DegradedModeController
+
+    solver.degraded = DegradedModeController(
+        policy=config.degraded_mode,
+        retry_after_s=config.degraded_retry_after_s,
+        clock=clock,
+        on_change=(
+            solver.telemetry.on_degraded
+            if solver.telemetry is not None
+            else None
+        ),
+    )
     # Delta-maintained reserved-usage aggregate over the solver's node-index
     # space: the hot path reads a dense array instead of walking every
     # reservation slot per request (SURVEY.md §7 latency budget).

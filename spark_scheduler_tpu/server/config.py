@@ -198,6 +198,34 @@ class InstallConfig:
     # resource.go:191-202): a gap longer than this resyncs durable state
     # from observed pods. Skipped entirely while the HA lease is held.
     resync_gap_seconds: float = 15.0
+    # Degraded-mode policy (`server.degraded-mode`, ISSUE 9): what the
+    # scheduler does when NO device slot can serve (every pool slot
+    # quarantined, or the single device died).
+    #   greedy  keep serving decisions via the host-side greedy fallback
+    #           (core/fallback.py — byte-identical packing semantics,
+    #           O(nodes) Python per row); readiness stays 200 but reports
+    #           degraded.
+    #   shed    answer /predicates 503 with Retry-After
+    #           (`server.degraded-retry-after`); readiness flips 503 so
+    #           load balancers drain the replica.
+    degraded_mode: str = "greedy"
+    degraded_retry_after_s: float = 5.0
+    # How often a quarantined device slot is probed for reinstatement
+    # (`solver.quarantine-probe`): a tiny device program runs on the slot;
+    # success puts it back into rotation (statics re-upload lazily).
+    quarantine_probe_s: float = 5.0
+    # Shared retry-ladder shape (`retry:` block): base/multiplier/cap for
+    # the exponential-backoff-with-full-jitter policy the kube write-back
+    # clients ride. `async-client-retry-count` remains the attempt budget
+    # (back-compat alias).
+    retry_base_delay_s: float = 0.02
+    retry_multiplier: float = 2.0
+    retry_max_delay_s: float = 2.0
+    # Circuit breaker over backend write-back: consecutive failures
+    # before opening, and how long an open breaker waits before admitting
+    # a half-open probe. 0 failures disables the breaker.
+    breaker_failure_threshold: int = 8
+    breaker_reset_timeout_s: float = 5.0
 
     @staticmethod
     def enable_jax_compile_cache(cache_dir: str) -> None:
@@ -264,6 +292,7 @@ class InstallConfig:
         mesh_block = solver_block.get("mesh") or {}
         ha_block = raw.get("ha") or {}
         extender_block = raw.get("extender") or {}
+        retry_block = raw.get("retry") or {}
 
         def block_key(block, key, default):
             # Present-but-null keys (`device-pool:` with no value) must
@@ -386,6 +415,30 @@ class InstallConfig:
                     "resync-gap-seconds",
                     raw.get("resync-gap-seconds", 15.0),
                 )
+            ),
+            degraded_mode=str(
+                block_key(server_block, "degraded-mode", "greedy")
+            ),
+            degraded_retry_after_s=_parse_duration(
+                block_key(server_block, "degraded-retry-after", 5.0)
+            ),
+            quarantine_probe_s=_parse_duration(
+                block_key(solver_block, "quarantine-probe", 5.0)
+            ),
+            retry_base_delay_s=_parse_duration(
+                block_key(retry_block, "base-delay", 0.02)
+            ),
+            retry_multiplier=float(
+                block_key(retry_block, "multiplier", 2.0)
+            ),
+            retry_max_delay_s=_parse_duration(
+                block_key(retry_block, "max-delay", 2.0)
+            ),
+            breaker_failure_threshold=int(
+                block_key(retry_block, "breaker-failure-threshold", 8)
+            ),
+            breaker_reset_timeout_s=_parse_duration(
+                block_key(retry_block, "breaker-reset-timeout", 5.0)
             ),
         )
 

@@ -93,10 +93,17 @@ class Response:
     body: bytes
     content_type: str = "application/json"
     close: bool = False  # transport must close the connection after writing
+    # Extra response headers (e.g. Retry-After on degraded-mode sheds).
+    # None on the hot path — transports only walk it when set.
+    headers: dict | None = None
 
 
-def json_response(status: int, payload, close: bool = False) -> Response:
-    return Response(status, json.dumps(payload).encode(), close=close)
+def json_response(
+    status: int, payload, close: bool = False, headers: dict | None = None
+) -> Response:
+    return Response(
+        status, json.dumps(payload).encode(), close=close, headers=headers
+    )
 
 
 def text_response(status: int, text: str, content_type: str) -> Response:
@@ -282,14 +289,45 @@ class SchedulerRoutes(SyncRoutes):
                 # server — without this re-check a promoted standby would
                 # answer 503 forever and kube would never route to it.
                 s.ready.set()
+            degraded = getattr(s.app.solver, "degraded", None)
+            deg_active = degraded is not None and degraded.active
             if ha is not None:
                 # HA replica: ready = state synced AND a serving role
                 # (leader / active shard member). Standbys answer 503 with
                 # the role so kube routes traffic to the leader while the
-                # warm replica stays probeable.
-                up = s.ready.is_set() and ha.is_serving()
+                # warm replica stays probeable. Degraded mode composes:
+                # a shedding leader must flip 503 too, or the load
+                # balancer never drains the replica that answers every
+                # predicate 503 — exactly the multi-replica topology
+                # where draining elsewhere is the point of shed.
+                up = (
+                    s.ready.is_set()
+                    and ha.is_serving()
+                    and not (deg_active and degraded.sheds)
+                )
+                body = {"ready": up, "role": ha.role}
+                if deg_active:
+                    body.update(
+                        degraded=True,
+                        policy=degraded.policy,
+                        reason=degraded.reason,
+                    )
+                return json_response(200 if up else 503, body)
+            if deg_active:
+                # Degraded mode (ISSUE 9): with the greedy policy the
+                # replica still serves (host fallback) — stay ready but
+                # say so; with shed it answers predicates 503, so flip
+                # readiness too and let load balancers drain it while
+                # probes keep watching.
+                up = s.ready.is_set() and not degraded.sheds
                 return json_response(
-                    200 if up else 503, {"ready": up, "role": ha.role}
+                    200 if up else 503,
+                    {
+                        "ready": up,
+                        "degraded": True,
+                        "policy": degraded.policy,
+                        "reason": degraded.reason,
+                    },
                 )
             up = s.ready.is_set()
             return Response(
@@ -536,8 +574,25 @@ class SchedulerRoutes(SyncRoutes):
         # Internal errors ride the protocol's Error channel
         # (ExtenderFilterResult.Error) so kube-scheduler gets a well-formed
         # response instead of a dropped connection.
+        from spark_scheduler_tpu.faults.errors import DegradedUnavailableError
         from spark_scheduler_tpu.tracing import pod_safe_params, svc1log
 
+        if isinstance(exc, DegradedUnavailableError):
+            # Degraded-mode shed (ISSUE 9): no device can serve and the
+            # policy is "shed" — a 503 with Retry-After, NOT a protocol
+            # Error (the kube-scheduler extender client retries 5xx; an
+            # Error would fail the pod's scheduling cycle outright).
+            svc1log().warn(
+                "predicate shed: degraded mode",
+                error=str(exc),
+                retryAfterS=exc.retry_after_s,
+                **pod_safe_params(pod),
+            )
+            return json_response(
+                503,
+                {"error": str(exc), "degraded": True},
+                headers={"Retry-After": str(int(max(1, exc.retry_after_s)))},
+            )
         svc1log().error(
             "predicate failed", error=repr(exc), **pod_safe_params(pod)
         )

@@ -594,9 +594,16 @@ class _HTTPProtocol(asyncio.Protocol):
             t0 = time.perf_counter()
             close = slot.close_after or resp.close
             prefix = _BLOCKS.get(resp.status, resp.content_type)
+            extra = b""
+            if resp.headers:
+                extra = b"".join(
+                    f"\r\n{name}: {value}".encode()
+                    for name, value in resp.headers.items()
+                )
             data = (
                 prefix
                 + str(len(resp.body)).encode()
+                + extra
                 + (b"\r\nConnection: close\r\n\r\n" if close else b"\r\n\r\n")
                 + resp.body
             )

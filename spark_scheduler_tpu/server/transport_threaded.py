@@ -187,6 +187,9 @@ class _RoutedHandler(BaseHTTPRequestHandler):
         self.send_response(resp.status)
         self.send_header("Content-Type", resp.content_type)
         self.send_header("Content-Length", str(len(resp.body)))
+        if resp.headers:
+            for name, value in resp.headers.items():
+                self.send_header(name, str(value))
         if self.close_connection:
             # Advertise the close so a pipelining client doesn't race its
             # next request onto a socket we're about to shut.

@@ -55,6 +55,9 @@ class WriteThroughCache:
         num_clients: int = NUM_WRITE_CLIENTS,
         max_retries: int = DEFAULT_MAX_RETRIES,
         sync_writes: bool = False,
+        retry_policy=None,
+        breaker=None,
+        on_retry=None,
     ):
         """sync_writes=True drains the queue inline after every mutation —
         deterministic mode for tests and single-threaded deployments."""
@@ -78,6 +81,7 @@ class WriteThroughCache:
         self.client = AsyncClient(
             backend, kind, self._store, self._queue,
             max_retries=max_retries, metrics=AsyncClientMetrics(),
+            retry_policy=retry_policy, breaker=breaker, on_retry=on_retry,
         )
         # Initial fill from the backend (cache/resourcereservations.go:53-60).
         for obj in backend.list(kind):

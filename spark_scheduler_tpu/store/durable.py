@@ -144,6 +144,20 @@ class DurableBackend(InMemoryBackend):
         self._follow = follow
         # End offset of the last complete record consumed (replay/poll).
         self._log_offset = 0
+        # FaultInjector seam (wal.<op>.<kind>): fn(op, record) fired
+        # inside _append — raising makes the commit fail exactly where a
+        # full disk or torn fsync would.
+        self.wal_fault_hook = None
+        # Records whose append FAILED after their in-memory commit. The
+        # base backend commits, then _on_committed appends — so by the
+        # time an append can fail, the state change is already visible
+        # and a caller's retry is an AlreadyExists no-op that never
+        # re-appends. Parking the record and draining the buffer ahead of
+        # the next successful append (commit order preserved: the lock is
+        # held across both) keeps the log complete — a faulted append
+        # delays durability, it never silently drops a committed record.
+        self._wal_pending: list = []
+        self.wal_append_failures = 0
         if os.path.exists(path):
             self._replay()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -162,12 +176,46 @@ class DurableBackend(InMemoryBackend):
         if self._replaying or self._follow:
             return
         with self._log_lock:
+            hook = self.wal_fault_hook
+            try:
+                if hook is not None:
+                    hook("append", record)
+                if self._file is None:
+                    self._file = open(self.path, "a", encoding="utf-8")
+                while self._wal_pending:
+                    self._file.write(json.dumps(self._wal_pending[0]) + "\n")
+                    del self._wal_pending[0]
+                self._file.write(json.dumps(record) + "\n")
+                self._file.flush()
+            except Exception:
+                self.wal_append_failures += 1
+                self._wal_pending.append(record)
+                raise
+            # Past this point the record is written and flushed: an fsync
+            # fault below must NOT park it — it is already on disk.
+            if hook is not None:
+                hook("fsync", record)
+            if self._fsync:
+                os.fsync(self._file.fileno())
+
+    def wal_flush(self) -> int:
+        """Drain any parked (append-faulted) records to the log; returns
+        how many were flushed. Called by close() and by chaos soaks before
+        comparing the log against live state."""
+        with self._log_lock:
+            if not self._wal_pending or self._follow:
+                return 0
             if self._file is None:
                 self._file = open(self.path, "a", encoding="utf-8")
-            self._file.write(json.dumps(record) + "\n")
+            n = 0
+            while self._wal_pending:
+                self._file.write(json.dumps(self._wal_pending[0]) + "\n")
+                del self._wal_pending[0]
+                n += 1
             self._file.flush()
             if self._fsync:
                 os.fsync(self._file.fileno())
+            return n
 
     def _replay(self) -> None:
         """Replay the log, tracking the byte offset of the last COMPLETE
@@ -457,11 +505,15 @@ class DurableBackend(InMemoryBackend):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            # The snapshot subsumes any append-faulted parked records —
+            # draining them after it would replay stale mutations.
+            self._wal_pending.clear()
             if self._file is not None:
                 self._file.close()
             self._file = open(self.path, "a", encoding="utf-8")
 
     def close(self) -> None:
+        self.wal_flush()
         with self._log_lock:
             if self._file is not None:
                 self._file.close()

@@ -58,7 +58,10 @@ class SoakClock:
 
 
 class Soak:
-    def __init__(self, rng, strategy, n_nodes: int = 12, elastic: bool = False):
+    def __init__(
+        self, rng, strategy, n_nodes: int = 12, elastic: bool = False,
+        backend=None,
+    ):
         self.rng = rng
         self.elastic = elastic
         self.clock = SoakClock() if elastic else None
@@ -85,6 +88,9 @@ class Soak:
         self.h = Harness(
             binpack_algo=strategy, fifo=True,
             same_az_dynamic_allocation="single-az" in strategy,
+            # Injected backend (e.g. a DurableBackend so the chaos matrix
+            # can fault the WAL surface); default in-memory.
+            backend=backend,
             **elastic_kw,
         )
         self.node_seq = 0
@@ -282,21 +288,28 @@ class Soak:
 
     def op_write_fault(self):
         """One faulted reservation write: the request fails internal and
-        nothing may double-book afterwards."""
-        fired = {"n": 0}
+        nothing may double-book afterwards. Runs through the unified
+        FaultInjector (ISSUE 9) — a one-shot error spec on the
+        reservation-write surface, the exact schedule the ad-hoc lambda
+        used to hand-roll."""
+        from spark_scheduler_tpu.faults import FaultInjector, FaultPlan, FaultSpec
 
-        def inject(kind, verb, obj):
-            if kind == "resourcereservations" and fired["n"] == 0:
-                fired["n"] = 1
-                return RuntimeError("soak-injected write fault")
-            return None
-
-        self.h.backend.fault_injector = inject
-        try:
+        plan = FaultPlan(
+            seed=int(self.rng.integers(0, 2**31)),
+            name="soak-write-fault",
+            specs=[
+                FaultSpec(
+                    surface="backend.resourcereservations.*",
+                    mode="error",
+                    limit=1,
+                    error=lambda: RuntimeError("soak-injected write fault"),
+                )
+            ],
+        )
+        with FaultInjector(plan) as inj:
+            inj.install_backend(self.h.backend)
             self.op_submit_drivers()
             self.drain()
-        finally:
-            self.h.backend.fault_injector = None
         # The faulted app (if any) got failure-internal; forget our intent
         # for apps that have no reservation so invariant #2 stays exact.
         for app_id in list(self.admitted):
@@ -494,6 +507,272 @@ class Soak:
         self.check_drained_mirror()
 
 
+# ------------------------------------------------------------ chaos matrix
+
+
+class ChaosMatrixSoak:
+    """ISSUE 9 chaos matrix: the randomized Soak workload run under ONE
+    seeded FaultPlan per surface family — {backend, kube, wal, device,
+    lease} — through the unified FaultInjector. Per run it asserts the
+    engine's scheduling invariants (zero double placements, zero
+    reservation over-commits), that faulted work was RETRIED or FENCED
+    rather than silently dropped (write-back `dropped == 0`; the WAL leg
+    additionally replays the log into a fresh backend and requires it to
+    equal live reservation truth), and that per-step latency stays under
+    `step_budget_s` (bounded spikes, not stalls). The verdict dict holds
+    only DETERMINISTIC fields — tests/test_chaos_matrix.py pins that the
+    same seed yields the same fault schedule and the same verdict.
+
+    Surface families:
+      backend  reservation/demand mutations error under the apiserver's
+               lock (the write-back retry ladder absorbs them)
+      kube     the async write-back client's drained requests error
+               (p-faults AND a contiguous partition window shorter than
+               the retry budget)
+      wal      DurableBackend appends/fsyncs fail; parked records must
+               reach the log anyway (durable._wal_pending)
+      device   a device h2d dies mid-soak; the window is served by the
+               degraded greedy fallback and the device path recovers
+      lease    a LeaseManager's store blips under the soak; the retry
+               ladder must absorb the faults without a spurious deposition
+    """
+
+    SURFACES = ("backend", "kube", "wal", "device", "lease")
+
+    @staticmethod
+    def plan_for(surface: str, seed: int):
+        """The shipped chaos-matrix plan for one surface family. Bounded
+        (`limit`) so every plan also tests RECOVERY: the workload must
+        return to steady state after the last scheduled fault."""
+        from spark_scheduler_tpu.faults import FaultPlan, FaultSpec
+
+        specs = {
+            "backend": [
+                FaultSpec(surface="backend.resourcereservations.*",
+                          mode="error", p=0.15, limit=10),
+                FaultSpec(surface="backend.demands.*",
+                          mode="error", p=0.2, limit=6),
+            ],
+            "kube": [
+                FaultSpec(surface="kube.write.*", mode="error",
+                          p=0.1, limit=8),
+                # A dead-apiserver window: 3 consecutive drained writes
+                # fail — shorter than the retry budget, so every one is
+                # absorbed by requeues, never dropped.
+                FaultSpec(surface="kube.write.*", mode="partition",
+                          start=20, length=3, limit=3),
+            ],
+            "wal": [
+                # Reservation/demand appends only: the soak's DIRECT pod
+                # and node fixture writes are scaffolding with no retry
+                # ladder in front of them — the serving paths are what
+                # the leg probes.
+                FaultSpec(surface="wal.append.resourcereservations",
+                          mode="error", every=7, limit=5),
+                FaultSpec(surface="wal.append.demands",
+                          mode="error", p=0.3, limit=3),
+                FaultSpec(surface="wal.fsync.resourcereservations",
+                          mode="error", at=[3], limit=1),
+            ],
+            "device": [
+                # The 3rd h2d dies (tunnel drop mid-soak): that window is
+                # served by the host greedy fallback; the next dispatch
+                # recovers the device path.
+                FaultSpec(surface="device.h2d", mode="error",
+                          at=[2], limit=1),
+            ],
+            "lease": [
+                FaultSpec(surface="lease.read", mode="error",
+                          p=0.2, limit=8),
+                FaultSpec(surface="lease.write", mode="error",
+                          p=0.2, limit=6),
+            ],
+        }[surface]
+        return FaultPlan(seed=seed, name=f"matrix-{surface}", specs=specs)
+
+    def __init__(
+        self,
+        surface: str,
+        seed: int = 0,
+        strategy: str = "tightly-pack",
+        n_nodes: int = 12,
+        wal_path: str | None = None,
+        step_budget_s: float = 60.0,
+        plan=None,
+    ):
+        import numpy as _np
+
+        from spark_scheduler_tpu.faults import FaultInjector
+
+        assert surface in self.SURFACES, surface
+        self.surface = surface
+        self.seed = seed
+        self.plan = plan if plan is not None else self.plan_for(surface, seed)
+        self.injector = FaultInjector(self.plan)
+        self.step_budget_s = step_budget_s
+        self.wal_path = wal_path
+        backend = None
+        if surface == "wal":
+            assert wal_path, "the wal leg needs a log path"
+            from spark_scheduler_tpu.store.durable import DurableBackend
+
+            backend = DurableBackend(wal_path)
+        self.soak = Soak(
+            _np.random.default_rng(seed), strategy, n_nodes=n_nodes,
+            backend=backend,
+        )
+        self.step_times: list[float] = []
+        self.lease_mgr = None
+        self.lease_io_errors = 0
+        self.lease_renews_ok = 0
+
+    # -- per-surface wiring -------------------------------------------------
+
+    def _install(self) -> None:
+        inj, h = self.injector, self.soak.h
+        if self.surface == "backend":
+            inj.install_backend(h.backend)
+        elif self.surface == "kube":
+            inj.install_async_client(h.app.rr_cache.client)
+        elif self.surface == "wal":
+            inj.install_wal(h.backend)
+        elif self.surface == "device":
+            inj.install_device()
+        elif self.surface == "lease":
+            from spark_scheduler_tpu.ha.lease import (
+                BackendLeaseStore,
+                LeaseManager,
+            )
+
+            self.lease_mgr = LeaseManager(
+                inj.lease_store(BackendLeaseStore(h.backend)),
+                "matrix-holder",
+                ttl_s=3600.0,  # nothing may depose it but a real failure
+            )
+            assert self.lease_mgr.try_acquire()
+
+    def _lease_tick(self) -> None:
+        try:
+            if self.lease_mgr.renew():
+                self.lease_renews_ok += 1
+        except Exception:
+            # Retry-exhausted store IO. The lease itself is NOT lost — the
+            # epoch is only moved by a successful takeover.
+            self.lease_io_errors += 1
+
+    # -- drive --------------------------------------------------------------
+
+    def run(self, steps: int) -> dict:
+        s = self.soak
+        names = [name for name, w, _ in s.OPS for _ in range(w)]
+        fns = {name: fn for name, _, fn in s.OPS}
+        with self.injector:
+            self._install()
+            while s.steps < steps:
+                s.steps += 1
+                name = names[int(s.rng.integers(0, len(names)))]
+                s.op_counts[name] = s.op_counts.get(name, 0) + 1
+                t0 = time.perf_counter()
+                fns[name](s)
+                if self.lease_mgr is not None:
+                    self._lease_tick()
+                self.step_times.append(time.perf_counter() - t0)
+                if s.steps % CHECK_EVERY == 0:
+                    s.drain()
+                    s.check_invariants()
+            s.drain()
+            s.check_invariants()
+            s.check_drained_mirror()
+        return self._verdict(steps)
+
+    # -- verdict ------------------------------------------------------------
+
+    def _verdict(self, steps: int) -> dict:
+        s = self.soak
+        client = s.h.app.rr_cache.client
+        # Never silently dropped: every faulted write-back was absorbed by
+        # its bounded requeue (the plans stay under the retry budget by
+        # construction — a plan that can exhaust it must pair with an
+        # on_error consumer, not silence).
+        assert client.metrics.dropped == 0, (
+            "chaos matrix dropped write-back work",
+            self.surface, client.metrics.dropped,
+        )
+        # Bounded spikes: no single step may stall the serving loop.
+        worst = max(self.step_times) if self.step_times else 0.0
+        assert worst < self.step_budget_s, (
+            "chaos-matrix step exceeded the latency budget",
+            self.surface, worst, self.step_budget_s,
+        )
+        verdict = {
+            "surface": self.surface,
+            "seed": self.seed,
+            "plan": self.plan.name,
+            "steps": steps,
+            "op_counts": dict(s.op_counts),
+            "apps": s.app_seq,
+            "fired": dict(self.injector.fired),
+            "schedule": self.injector.schedule(),
+            "write_back": {
+                "retries": client.metrics.retries,
+                "dropped": client.metrics.dropped,
+            },
+        }
+        if self.surface == "device":
+            solver = s.h.app.solver
+            deg = solver.degraded
+            snap = deg.snapshot() if deg is not None else {}
+            # The faulted window was served (fallback), and the device
+            # path recovered once the plan's faults exhausted.
+            assert snap.get("fallback_decisions", 0) > 0, snap
+            assert not (deg is not None and deg.active), (
+                "device path never recovered", snap
+            )
+            verdict["device"] = {
+                "fallback_decisions": snap.get("fallback_decisions"),
+                "engagements": snap.get("engagements"),
+            }
+        if self.surface == "wal":
+            verdict["wal"] = self._check_wal_durability()
+        if self.surface == "lease":
+            mgr = self.lease_mgr
+            # Transient store blips never depose a healthy holder: the
+            # epoch this manager acquired is still the live record's.
+            assert mgr.acquired_epoch == 1, mgr.state()
+            assert self.lease_renews_ok > 0
+            verdict["lease"] = {
+                "renews_ok": self.lease_renews_ok,
+                "io_errors": self.lease_io_errors,
+            }
+        return verdict
+
+    def _check_wal_durability(self) -> dict:
+        """Append-faulted records must still reach the log: flush parked
+        records, replay the log into a FRESH backend, and require its
+        reservation truth to equal the live backend's."""
+        from spark_scheduler_tpu.store.durable import DurableBackend
+
+        live = self.soak.h.backend
+        flushed = live.wal_flush()
+        assert not live._wal_pending
+        replayed = DurableBackend(self.wal_path, compact_on_load=False)
+        def rr_truth(b):
+            return {
+                (rr.namespace, rr.name): {
+                    k: v.node for k, v in rr.spec.reservations.items()
+                }
+                for rr in b.list("resourcereservations")
+            }
+        assert rr_truth(replayed) == rr_truth(live), (
+            "WAL replay diverges from live truth after append faults"
+        )
+        replayed.close()
+        return {
+            "append_failures": live.wal_append_failures,
+            "flushed_at_end": flushed,
+        }
+
+
 # ---------------------------------------------------------------- HA chaos
 
 
@@ -519,6 +798,16 @@ class HAChaosSoak:
 
     Driven fast by tests/test_ha_chaos_soak.py and on real clusters by
     bench.py's ha_failover section.
+
+    The kill itself rides the unified FaultInjector (ISSUE 9): the
+    `replica.kill` surface is fired once per cycle and the PLAN decides
+    whether the leader dies — the default plan kills every cycle (the
+    original hardcoded behavior); a seeded plan with `p`/`at` makes the
+    kill schedule stochastic-but-replayable, and cycles the plan spares
+    run the same staged windows to completion on the live leader (steady
+    control arm). Plans carrying `lease.*` specs additionally wrap every
+    replica's lease store in FaultyLeaseStore, so store blips ride the
+    takeover itself.
     """
 
     def __init__(
@@ -529,7 +818,9 @@ class HAChaosSoak:
         spike_budget_s: float = 30.0,
         backend=None,
         max_live_apps: int = 18,
+        fault_plan=None,
     ):
+        from spark_scheduler_tpu.faults import FaultInjector, FaultPlan, FaultSpec
         from spark_scheduler_tpu.ha.replica import build_replica
         from spark_scheduler_tpu.server.config import InstallConfig
         from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
@@ -538,6 +829,18 @@ class HAChaosSoak:
             new_node,
         )
 
+        if fault_plan is None:
+            # The legacy contract: every cycle kills its leader.
+            fault_plan = FaultPlan(
+                seed=0, name="ha-kill-every-cycle",
+                specs=[FaultSpec(surface="replica.kill", mode="error")],
+            )
+        self.injector = FaultInjector(fault_plan)
+        self._fault_leases = any(
+            s.surface.startswith("lease") for s in fault_plan.specs
+        )
+        self.kills = 0
+        self.spared_cycles = 0
         self.backend = backend if backend is not None else InMemoryBackend()
         self.backend.register_crd(DEMAND_CRD)
         self.clock = SoakClock()
@@ -551,9 +854,15 @@ class HAChaosSoak:
             ha_enabled=True,
             ha_lease_ttl_s=ttl_s,
         )
-        self._build = lambda rid: build_replica(
-            self.backend, rid, config=self._config(), clock=self.clock
-        )
+        def _build(rid):
+            r = build_replica(
+                self.backend, rid, config=self._config(), clock=self.clock
+            )
+            if self._fault_leases and r.lease is not None:
+                r.lease._store = self.injector.lease_store(r.lease._store)
+            return r
+
+        self._build = _build
         for i in range(n_nodes):
             self.backend.add_node(new_node(f"hn{i}", zone=f"zone{i % 3}"))
         self.node_names = [f"hn{i}" for i in range(n_nodes)]
@@ -645,6 +954,29 @@ class HAChaosSoak:
                 for _aid, p in staged + orphans
             ]
         )
+        # The kill decision is the fault plan's (replica.kill surface):
+        # an InjectedFault IS the crash; a spared cycle completes the
+        # same staged window on the live leader (steady control arm).
+        from spark_scheduler_tpu.faults import InjectedFault
+
+        try:
+            self.injector.fire("replica.kill")
+            kill = False
+        except InjectedFault:
+            kill = True
+        if not kill:
+            self.spared_cycles += 1
+            results = leader.app.extender.predicate_window_complete(ticket)
+            for (app_id, driver), res in zip(staged + orphans, results):
+                assert res.ok, (app_id, res.outcome)
+                node = res.node_names[0]
+                self.backend.bind_pod(driver, node)
+                self.placed[app_id] = node
+                self.total_placed += 1
+            self._retire_oldest()
+            self.check_invariants()
+            return
+        self.kills += 1
         kill_t0 = time.perf_counter()
         leader.kill()
         drops_before = leader.app.rr_cache.client.metrics.dropped
@@ -744,6 +1076,9 @@ class HAChaosSoak:
         mid = sorted(self.steady_latencies)
         return {
             "cycles": cycles,
+            "kills": self.kills,
+            "spared_cycles": self.spared_cycles,
+            "fault_stats": self.injector.stats(),
             "apps_placed": self.total_placed,
             "live_apps": len(self.placed),
             "retired": self.retired,

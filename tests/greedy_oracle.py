@@ -1,182 +1,23 @@
-"""Greedy test oracle: literal per-slot reimplementation of the reference's
-packing semantics (binpack/pack_tightly.go, distribute_evenly.go,
-minimal_fragmentation.go, binpack.go, single_az.go, sort/nodesorting.go) in
-plain Python over numpy arrays. The vectorized XLA kernels in
-spark_scheduler_tpu/ops must reproduce these placements slot-for-slot
-(SURVEY.md §4 "numerical parity tests").
+"""Greedy test oracle — promoted into the package (ISSUE 9).
 
-Nodes are integer indices; resources are [3] int arrays (same fixed-point
-units as the framework).
+The implementation now lives in spark_scheduler_tpu/core/greedy.py so
+degraded-mode serving (core/fallback.py) can reuse the reference-literal
+packing semantics; this module keeps the historical import path for the
+golden parity suites.
 """
 
-from __future__ import annotations
-
-import numpy as np
-
-INF = 10**9
-
-
-def greedy_fits(avail, req) -> bool:
-    return bool(np.all(req <= avail))
-
-
-def greedy_capacity(avail, reserved, req) -> int:
-    cap = INF
-    for d in range(3):
-        if reserved[d] > avail[d]:
-            return 0
-        if req[d] == 0:
-            continue
-        cap = min(cap, (avail[d] - reserved[d]) // req[d])
-    return max(int(cap), 0)
-
-
-def greedy_tightly(avail, exec_req, count, order, reserved):
-    out = []
-    if count == 0:
-        return out, True
-    for n in order:
-        while True:
-            reserved[n] = reserved[n] + exec_req
-            if np.any(reserved[n] > avail[n]):
-                reserved[n] = reserved[n] - exec_req
-                break
-            out.append(n)
-            if len(out) == count:
-                return out, True
-    return None, False
-
-
-def greedy_distribute(avail, exec_req, count, order, reserved):
-    open_nodes = set(order)
-    out = []
-    if count == 0:
-        return out, True
-    while open_nodes:
-        for n in order:
-            if n not in open_nodes:
-                continue
-            reserved[n] = reserved[n] + exec_req
-            if np.any(reserved[n] > avail[n]):
-                open_nodes.discard(n)
-                reserved[n] = reserved[n] - exec_req
-            else:
-                out.append(n)
-                if len(out) == count:
-                    return out, True
-    return None, False
-
-
-def greedy_minimal_fragmentation(avail, exec_req, count, order, reserved):
-    out = []
-    if count == 0:
-        return out, True
-    caps = [
-        (n, greedy_capacity(avail[n], reserved.get(n, np.zeros(3, np.int64)), exec_req))
-        for n in order
-    ]
-    caps = [(n, c) for (n, c) in caps if c > 0]
-    caps.sort(key=lambda t: t[1])  # stable ascending by capacity
-    remaining = count
-    while caps:
-        fit_all = next((i for i, (_, c) in enumerate(caps) if c >= remaining), None)
-        if fit_all is not None:
-            out.extend([caps[fit_all][0]] * remaining)
-            return out, True
-        max_cap = caps[-1][1]
-        first_max = next(i for i, (_, c) in enumerate(caps) if c >= max_cap)
-        cur = first_max
-        while remaining >= max_cap and cur < len(caps):
-            out.extend([caps[cur][0]] * max_cap)
-            remaining -= max_cap
-            cur += 1
-        if remaining == 0:
-            return out, True
-        caps = caps[:first_max] + caps[cur:]
-    return None, False
-
-
-GREEDY_FILLS = {
-    "tightly-pack": greedy_tightly,
-    "distribute-evenly": greedy_distribute,
-    "minimal-fragmentation": greedy_minimal_fragmentation,
-}
-
-
-class _ReservedMap(dict):
-    """dict defaulting to a zero resource vector (NodeGroupResources)."""
-
-    def __getitem__(self, k):
-        if k not in self:
-            dict.__setitem__(self, k, np.zeros(3, np.int64))
-        return dict.__getitem__(self, k)
-
-
-def greedy_spark_bin_pack(
-    avail, driver_req, exec_req, count, driver_order, exec_order, fill
-):
-    """binpack.go:60-87: first driver candidate whose executors still pack."""
-    fill_fn = GREEDY_FILLS[fill]
-    for d in driver_order:
-        if not greedy_fits(avail[d], driver_req):
-            continue
-        r = _ReservedMap()
-        r[d] = driver_req.astype(np.int64).copy()
-        nodes, ok = fill_fn(avail, exec_req, count, exec_order, r)
-        if ok:
-            return d, nodes, True, r
-    return -1, [], False, {}
-
-
-def greedy_priority_order(avail, zone_of, names, eligible, domain=None, label_rank=None):
-    """sort/nodesorting.go:84-134: (az priority, mem asc, cpu asc, name),
-    then optional stable label-priority re-sort. Zone totals are computed
-    over the full metadata `domain` (PotentialNodes sorts the whole domain,
-    then filters to eligible, preserving order)."""
-    if domain is None:
-        domain = eligible
-    idxs = [i for i in range(len(names)) if eligible[i]]
-    dom = [i for i in range(len(names)) if domain[i]]
-    zones = sorted(
-        {zone_of[i] for i in dom},
-        key=lambda z: (
-            sum(int(avail[i][1]) for i in dom if zone_of[i] == z),
-            sum(int(avail[i][0]) for i in dom if zone_of[i] == z),
-            z,
-        ),
-    )
-    zprio = {z: r for r, z in enumerate(zones)}
-    out = sorted(
-        idxs,
-        key=lambda i: (zprio[zone_of[i]], int(avail[i][1]), int(avail[i][0]), names[i]),
-    )
-    if label_rank is not None:
-        out.sort(key=lambda i: label_rank[i])  # stable
-    return out
-
-
-def greedy_avg_efficiency(
-    avail, schedulable, driver, exec_nodes, driver_req, exec_req,
-    include_executors_in_reserved=True,
-):
-    """efficiency.go:107-156 over the packing's entries (duplicates kept),
-    with exact (unrounded) ratios. `include_executors_in_reserved=False`
-    mirrors minimalFragmentation never mutating reservedResources."""
-    entries = ([driver] if driver >= 0 else []) + list(exec_nodes)
-    if not entries:
-        return 0.0
-    new_res = {}
-    for n in entries:
-        new_res.setdefault(n, np.zeros(3, np.int64))
-    new_res[driver] = new_res[driver] + driver_req
-    if include_executors_in_reserved:
-        for n in exec_nodes:
-            new_res[n] = new_res[n] + exec_req
-    max_sum = 0.0
-    for n in entries:
-        reserved = (schedulable[n] - avail[n]) + new_res[n]
-        denom = np.where(schedulable[n] == 0, 1, schedulable[n]).astype(float)
-        eff = reserved.astype(float) / denom
-        gpu_eff = eff[2] if schedulable[n][2] != 0 else 0.0
-        max_sum += max(eff[0], eff[1], gpu_eff)
-    return max_sum / len(entries)
+from spark_scheduler_tpu.core.greedy import (  # noqa: F401
+    GREEDY_FILLS,
+    INF,
+    _ReservedMap,
+    greedy_avg_efficiency,
+    greedy_capacity,
+    greedy_distribute,
+    greedy_fits,
+    greedy_minimal_fragmentation,
+    greedy_priority_order,
+    greedy_single_az_bin_pack,
+    greedy_spark_bin_pack,
+    greedy_strategy_pack,
+    greedy_tightly,
+)

@@ -1,0 +1,64 @@
+"""Chaos-matrix soak (ISSUE 9): the randomized invariant-soak workload
+run under seeded FaultPlans, one per surface family — {backend, kube,
+wal, device, lease} — through the unified FaultInjector.
+
+Per leg the engine asserts zero double placements, zero reservation
+over-commits, zero silently-dropped write-back work, bounded per-step
+latency, and per-surface recovery (WAL replay equals live truth after
+append faults; the device path recovers after its greedy-fallback
+window; a healthy lease holder is never deposed by store blips).
+
+The replay tests pin the determinism contract the whole subsystem is
+built on: same seed => same fault schedule => same soak verdict.
+
+Step count: CHAOS_MATRIX_STEPS env (default 120 per leg so tier-1 stays
+fast; CI's chaos-matrix job runs every leg at a higher budget).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from spark_scheduler_tpu.testing.soak import ChaosMatrixSoak
+
+MATRIX_STEPS = int(os.environ.get("CHAOS_MATRIX_STEPS", "120"))
+
+
+@pytest.mark.parametrize("surface", ChaosMatrixSoak.SURFACES)
+def test_chaos_matrix_surface(surface, tmp_path):
+    soak = ChaosMatrixSoak(
+        surface, seed=9, wal_path=str(tmp_path / "wal.log")
+    )
+    verdict = soak.run(MATRIX_STEPS)
+    # The run itself asserted the invariants; pin that the plan actually
+    # exercised its surface — a leg whose faults never fired tested
+    # nothing.
+    assert verdict["fired"], (surface, soak.injector.stats())
+    assert verdict["write_back"]["dropped"] == 0
+    assert verdict["apps"] > 0
+
+
+@pytest.mark.parametrize("surface", ("backend", "kube", "wal", "device"))
+def test_chaos_matrix_replay_deterministic(surface, tmp_path):
+    """Same seed => same fault schedule => same verdict, field for field.
+    (The lease leg's verdict is deterministic too but its surface fires
+    on wall-clock-free renew ticks already covered above.)"""
+    runs = []
+    for i in range(2):
+        soak = ChaosMatrixSoak(
+            surface, seed=1234, wal_path=str(tmp_path / f"wal{i}.log")
+        )
+        runs.append(soak.run(80))
+    a, b = runs
+    assert a["schedule"] == b["schedule"]
+    assert a == b
+
+
+def test_chaos_matrix_different_seed_different_schedule(tmp_path):
+    """The seed is load-bearing: a different seed must reshuffle the
+    p-mode schedule (not merely re-label it)."""
+    v1 = ChaosMatrixSoak("backend", seed=1).run(60)
+    v2 = ChaosMatrixSoak("backend", seed=2).run(60)
+    assert v1["schedule"] != v2["schedule"]

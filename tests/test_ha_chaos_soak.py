@@ -43,3 +43,29 @@ def test_ha_chaos_on_durable_backend(tmp_path):
     for app_id, node in soak.placed.items():
         assert rrs[app_id].spec.reservations["driver"].node == node
     replayed.close()
+
+
+def test_ha_chaos_kill_schedule_rides_fault_plan():
+    """The leader kill is a FaultPlan decision (replica.kill surface,
+    ISSUE 9): an every-2nd-cycle plan kills half the cycles and runs the
+    other half's staged windows to completion on the live leader, and
+    lease.* specs in the same plan blip the lease store THROUGH the
+    takeover — absorbed by the LeaseManager's retry ladder, never a
+    spurious deposition."""
+    from spark_scheduler_tpu.faults import FaultPlan, FaultSpec
+
+    plan = FaultPlan(
+        seed=7, name="ha-kill-alternate",
+        specs=[
+            FaultSpec(surface="replica.kill", mode="error", every=2),
+            FaultSpec(surface="lease.read", mode="error", p=0.1, limit=6),
+        ],
+    )
+    soak = HAChaosSoak(
+        strategy="tightly-pack", n_nodes=16, ttl_s=2.0, fault_plan=plan
+    )
+    stats = soak.run(cycles=4, burst=3)
+    assert stats["kills"] == 2 and stats["spared_cycles"] == 2
+    assert stats["promotions"] == 2
+    assert stats["fault_stats"]["fired"].get("replica.kill") == 2
+    soak.check_invariants()

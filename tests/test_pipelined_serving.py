@@ -366,9 +366,12 @@ def test_fetch_failure_applies_surviving_windows_before_redispatch():
 def test_fetch_failure_resets_pipeline_to_host_truth():
     """A failed decision fetch must not leak the window's gangs: the
     pipeline resets and the next build re-uploads from the host view, so
-    the never-reserved capacity is usable again."""
+    the never-reserved capacity is usable again. Without a degraded-mode
+    controller the slot-fatal failure PROPAGATES (pre-ISSUE-9 contract,
+    still the behavior for bare solvers)."""
     h, node_names = _mk_harness(n_nodes=1, fifo=False)
     ext = h.extender
+    ext._solver.degraded = None  # bare solver: no degraded policy wired
     _, args = _driver_args(h, "lost", 7, node_names)
     _, args_b = _driver_args(h, "lost-b", 7, node_names)
     t1 = ext.predicate_window_dispatch([args, args_b])
@@ -393,6 +396,41 @@ def test_fetch_failure_resets_pipeline_to_host_truth():
     t2 = ext.predicate_window_dispatch([okargs, okargs_b])
     r2 = ext.predicate_window_complete(t2)
     assert r2[0].node_names, r2
+
+
+def test_fetch_failure_with_degraded_policy_serves_window_via_fallback():
+    """ISSUE 9: with the degraded controller wired (the app default), a
+    slot-fatal fetch failure no longer loses the window — its decisions
+    re-solve exactly on the host greedy fallback (nothing was applied
+    anywhere yet), the pipeline still resets to host truth, and the next
+    healthy device window clears degraded."""
+    h, node_names = _mk_harness(n_nodes=1, fifo=False)
+    ext = h.extender
+    assert ext._solver.degraded is not None  # wired by build_scheduler_app
+    _, args = _driver_args(h, "kept", 7, node_names)
+    _, args_b = _driver_args(h, "kept-b", 7, node_names)
+    t1 = ext.predicate_window_dispatch([args, args_b])
+
+    class _Boom:
+        def result(self):
+            raise ConnectionError("injected transfer failure")
+
+    t1.handle.blob_future = _Boom()
+    r1 = ext.predicate_window_complete(t1)
+    assert r1[0].node_names, r1  # the window SERVED (host fallback)
+    assert ext._solver._pipe is None  # pipeline still dropped
+    snap = ext._solver.degraded.snapshot()
+    assert snap["active"] and snap["fallback_decisions"] > 0
+
+    # The fallback-served gang's reservation is REAL: a fresh 7-cpu
+    # driver no longer fits the 8-cpu node (the capacity is genuinely
+    # held, not leaked). The window still solves on the device, which
+    # clears the degraded flag.
+    _, okargs = _driver_args(h, "after", 7, node_names)
+    t2 = ext.predicate_window_dispatch([okargs])
+    r2 = ext.predicate_window_complete(t2)
+    assert r2[0].outcome == "failure-fit", r2
+    assert not ext._solver.degraded.active
 
 
 def test_batcher_completes_solo_ticket_before_next_window():
