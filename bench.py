@@ -492,6 +492,19 @@ def _post_predicate(conn, driver, node_names):
 _RTT_FLOOR: dict = {}
 
 
+def _prune_fields(app):
+    """`pruned` + `prune_escalations` on every serving JSON line (ISSUE 10):
+    whether the two-tier solve served any window of this section, and how
+    many windows its soundness certificate escalated to the full re-solve.
+    Default-off configs report {False, 0} — the prune A/B arms live in the
+    candidate_pruning section (hack/prune_bench.py)."""
+    st = getattr(app.solver, "prune_stats", None) or {}
+    return {
+        "pruned": bool(st.get("windows")),
+        "prune_escalations": int(st.get("escalations", 0)),
+    }
+
+
 def _device_rtt_floor_ms() -> float:
     """One minimal device round trip (dispatch + pull a scalar), p50 of 7.
     Over this environment's tunneled TPU this alone exceeds the 50 ms
@@ -604,6 +617,7 @@ def bench_serving_http(rng, transport="threaded", ingest="python"):
             # Windows per device dispatch this section ran with (1 =
             # unfused; the fused A/B lives in the fused_dispatch section).
             "fused_k": batcher_fuse,
+            **_prune_fields(app),
             "r02_ms": 119.68,
         },
     )
@@ -971,6 +985,7 @@ def _bench_serving_concurrent(
         # Windows per device dispatch (1 = unfused serving; the fused
         # claim only engages when solver.fuse-windows > 1).
         "fused_k": stats["fuse_windows"],
+        **_prune_fields(app),
         # Same rig, null handler, SAME body size (10k-node requests carry
         # ~200 KB of node names): what the 1-core HTTP harness itself can
         # carry — decisions/s saturating this floor is a rig limit, not a
@@ -1389,6 +1404,7 @@ def bench_serving_http_executors(rng, transport="threaded"):
         ),
         "host_cpus": os.cpu_count(),
         "fused_k": 1,  # executor ladder is host-side; no fused dispatch
+        **_prune_fields(app),
         "load_generator": "colocated threads, prebuilt bodies (see _threaded_phase)",
         "path": "concurrent executor /predicates -> reservation ladder (host-side)",
     }
@@ -1675,6 +1691,53 @@ def bench_multi_device_serving(rng):
             ),
             "value": arm["decisions_per_s"],
             "unit": "decisions/s",
+            "vs_baseline": vs,
+            "detail": arm,
+        }
+        _RESULTS.append(entry)
+        print(json.dumps(entry), flush=True)
+
+
+def bench_candidate_pruning(rng):
+    """Sound top-K candidate pruning A/B (the two-tier solve, ISSUE 10):
+    window service time + per-window h2d bytes, full vs pruned, at 10k and
+    100k nodes with a prune-slack sweep. Runs as a subprocess
+    (hack/prune_bench.py) with pruned decisions ASSERTED byte-identical to
+    the full arm's and the certificate-escalation rate reported per arm.
+    The pruned 100k arms carry vs_baseline = speedup/3 (>= 1 clears the 3x
+    window-service-time bar); h2d shrink carries its own >= 5x bar via
+    h2d_shrink_vs_full in the detail."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "hack", "prune_bench.py"
+    )
+    out = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=3600,
+    )
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"prune bench failed rc={out.returncode}: {out.stderr[-800:]}"
+        )
+    for line in lines:
+        arm = json.loads(line)
+        speedup = arm.get("speedup_vs_full")
+        if arm["arm"] == "full":
+            vs = 1.0
+        elif arm["nodes"] >= 100_000:
+            vs = round((speedup or 0.0) / 3.0, 2)  # the acceptance bar
+        else:
+            vs = round(speedup or 0.0, 2)  # informational scale point
+        entry = {
+            "metric": (
+                f"candidate_pruning_window_p50_ms_"
+                f"{arm['nodes'] // 1000}k_{arm['arm']}"
+            ),
+            "value": arm["window_p50_ms"],
+            "unit": "ms",
             "vs_baseline": vs,
             "detail": arm,
         }
@@ -2402,6 +2465,10 @@ def main() -> None:
     # Fused multi-window dispatch A/B under simulated device RTT
     # (subprocess): the fused arms at RTT >= 50 ms carry the 3x bar.
     guarded("fused_dispatch", bench_fused_dispatch, rng)
+    # Candidate pruning A/B (subprocess): pruned vs full window service
+    # time + h2d at 10k/100k nodes, byte-identity asserted in-arm; the
+    # pruned 100k arms carry the 3x window-service-time bar.
+    guarded("candidate_pruning", bench_candidate_pruning, rng)
     # Executor bench BEFORE the long concurrent bench: the host-only
     # ladder numbers are the most sensitive to box heat / accumulated
     # process state, so measure them early.

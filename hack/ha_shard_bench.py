@@ -11,14 +11,15 @@ carries the >= 1.5x bar). Byte-identical per-group placements are
 ASSERTED in both arms. Then runs the leader-kill chaos soak
 (testing/soak.py HAChaosSoak, >= 3 cycles).
 
-Runs as a SUBPROCESS of bench.py (like hack/multidevice_bench.py) with
-the persistent XLA compilation cache deliberately NOT enabled: with the
-cache on, concurrently-serving solvers in one process intermittently
-produce wrong window decisions on reloaded executables (observed as
-spurious failure-fit / shifted placements in otherwise-deterministic
-runs; never reproduced with the cache off) — the equivalence assertions
-here must not inherit that flake. One JSON line per arm on stdout;
-standalone:
+Runs as a SUBPROCESS of bench.py (like hack/multidevice_bench.py). The
+persistent XLA compilation cache is ENABLED again: the historical flake
+(concurrently-serving solvers intermittently produced wrong window
+decisions on executables reloaded from the cache, so this arm used to
+run cache-free) is closed by InstallConfig.serialize_jax_cache_io() —
+the cache's executable serialize/deserialize + file I/O now runs behind
+one process-wide lock, which enable_jax_compile_cache installs. The
+equivalence assertions below are the regression guard: a recurrence
+fails the arm loudly. One JSON line per arm on stdout; standalone:
     python hack/ha_shard_bench.py
 """
 
@@ -200,6 +201,14 @@ def sharded_arm(nodes_per_group: int, rtt_ms):
 
 
 def main() -> None:
+    from spark_scheduler_tpu.server.config import InstallConfig
+
+    InstallConfig.enable_jax_compile_cache(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+    )
     # Pure-CPU arm: informational on shared-core boxes.
     pure = sharded_arm(512, None)
     print(json.dumps({"arm": "pure_cpu", **pure}), flush=True)

@@ -1,0 +1,197 @@
+"""Candidate-pruning A/B (the two-tier solve): window service time and
+per-window h2d bytes, pruned vs full, at 10k and 100k nodes.
+
+Drives the SOLVER's pipelined window path directly (build_tensors_pipelined
+-> pack_window_dispatch -> pack_window_fetch, serialized per window so the
+measurement is service time, not pipeline overlap) over a seeded workload
+of serving windows with usage churn between windows. Three arms per node
+count: full (prune off) and pruned at each swept `prune-slack`; pruned
+decisions are ASSERTED byte-identical to the full arm's (the certificate's
+escalation path makes that unconditional — a mismatch is a bug, and this
+bench aborts on it). Certificate-escalation rate is reported per arm.
+
+One JSON line per (nodes, arm) on stdout; standalone:
+    python hack/prune_bench.py
+Env: PRUNE_BENCH_NODES="10000,100000"  PRUNE_BENCH_SLACKS="1.5,3.0"
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import json
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+import numpy as np
+
+WINDOWS = {10_000: 14, 100_000: 6}
+REQS_PER_WINDOW = 8
+EXECS = 3
+
+
+def _nodes(n):
+    from spark_scheduler_tpu.models.kube import Node, ZONE_LABEL
+    from spark_scheduler_tpu.models.resources import Resources
+
+    alloc = Resources.from_quantities("8", "8Gi", "1", round_up=False)
+    return [
+        Node(
+            name=f"pb-n{i:06d}",
+            allocatable=alloc,
+            labels={ZONE_LABEL: f"z{i % 4}"},
+        )
+        for i in range(n)
+    ]
+
+
+def _workload(rng, names, n_windows):
+    """Seeded windows + per-window usage churn, identical across arms."""
+    from spark_scheduler_tpu.core.solver import WindowRequest
+    from spark_scheduler_tpu.models.resources import Resources
+
+    one = Resources.from_quantities("1", "1Gi")
+    two = Resources.from_quantities("2", "2Gi")
+    windows, usages = [], []
+    for _ in range(n_windows):
+        reqs = []
+        for _ in range(REQS_PER_WINDOW):
+            res = two if rng.random() < 0.3 else one
+            reqs.append(
+                WindowRequest(
+                    rows=[(res, one, int(rng.integers(1, EXECS + 1)), False)],
+                    driver_candidate_names=names,
+                )
+            )
+        windows.append(reqs)
+        usage = {}
+        for i in rng.choice(len(names), size=24, replace=False):
+            usage[names[i]] = Resources.from_quantities(
+                str(int(rng.integers(1, 4))), "1Gi"
+            )
+        usages.append(usage)
+    return windows, usages
+
+
+def run_arm(nodes, windows, usages, *, top_k, slack):
+    from spark_scheduler_tpu.core.solver import PlacementSolver
+    from spark_scheduler_tpu.observability.telemetry import (
+        TRANSFER_BYTES,
+        SolverTelemetry,
+    )
+
+    solver = PlacementSolver(prune_top_k=top_k, prune_slack=slack)
+    solver.telemetry = SolverTelemetry(None)
+    h2d = solver.telemetry.registry.counter(TRANSFER_BYTES, direction="h2d")
+
+    # Warmup window (compiles + cold featurize) outside the clock.
+    t = solver.build_tensors_pipelined(nodes, {}, {})
+    solver.pack_window_fetch(
+        solver.pack_window_dispatch("tightly-pack", t, windows[0])
+    )
+    solver.discard_pipeline()
+
+    times_ms, decisions = [], []
+    h2d_start = h2d.value
+    for usage, win in zip(usages, windows):
+        t0 = time.perf_counter()
+        t = solver.build_tensors_pipelined(nodes, usage, {})
+        h = solver.pack_window_dispatch("tightly-pack", t, win)
+        decs = solver.pack_window_fetch(h)
+        times_ms.append((time.perf_counter() - t0) * 1e3)
+        decisions.append(
+            tuple(
+                (
+                    d.admitted,
+                    d.packing.driver_node,
+                    tuple(d.packing.executor_nodes),
+                )
+                for d in decs
+            )
+        )
+    st = dict(solver.prune_stats)
+    return {
+        "window_p50_ms": round(float(np.percentile(times_ms, 50)), 2),
+        "window_mean_ms": round(float(np.mean(times_ms)), 2),
+        "h2d_bytes_per_window": int(
+            (h2d.value - h2d_start) / max(1, len(windows))
+        ),
+        "windows": len(windows),
+        "pruned_windows": st["windows"],
+        "prune_escalations": st["escalations"],
+        "escalation_rate": round(
+            st["escalations"] / st["windows"], 4
+        ) if st["windows"] else 0.0,
+        "escalation_reasons": st["reasons"],
+        "kept_rows_per_window": round(
+            st["kept_rows"] / st["windows"], 1
+        ) if st["windows"] else None,
+        "window_path_counts": dict(solver.window_path_counts),
+    }, decisions
+
+
+def main() -> None:
+    node_counts = [
+        int(x)
+        for x in os.environ.get(
+            "PRUNE_BENCH_NODES", "10000,100000"
+        ).split(",")
+    ]
+    slacks = [
+        float(x)
+        for x in os.environ.get("PRUNE_BENCH_SLACKS", "1.5,3.0").split(",")
+    ]
+    for n in node_counts:
+        nodes = _nodes(n)
+        names = [nd.name for nd in nodes]
+        rng = np.random.default_rng(1234 + n)
+        windows, usages = _workload(rng, names, WINDOWS.get(n, 8))
+
+        full_stats, full_decs = run_arm(
+            nodes, windows, usages, top_k=0, slack=2.0
+        )
+        print(
+            json.dumps({"nodes": n, "arm": "full", **full_stats}),
+            flush=True,
+        )
+        for slack in slacks:
+            st, decs = run_arm(
+                nodes, windows, usages, top_k=16, slack=slack
+            )
+            assert decs == full_decs, (
+                f"pruned decisions diverged from full at {n} nodes, "
+                f"slack {slack}"
+            )
+            speedup = (
+                full_stats["window_p50_ms"] / st["window_p50_ms"]
+                if st["window_p50_ms"]
+                else 0.0
+            )
+            h2d_shrink = (
+                full_stats["h2d_bytes_per_window"]
+                / max(1, st["h2d_bytes_per_window"])
+            )
+            print(
+                json.dumps(
+                    {
+                        "nodes": n,
+                        "arm": f"pruned_slack{slack}",
+                        "prune_slack": slack,
+                        **st,
+                        "speedup_vs_full": round(speedup, 2),
+                        "h2d_shrink_vs_full": round(h2d_shrink, 1),
+                        "decisions_byte_identical": True,
+                    }
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

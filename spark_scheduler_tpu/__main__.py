@@ -85,6 +85,25 @@ def main(argv=None) -> int:
         "= unfused)",
     )
     srv.add_argument(
+        "--prune-top-k",
+        type=int,
+        default=None,
+        help="sound top-K candidate pruning (the two-tier solve): serve "
+        "eligible windows over a gathered top-K sub-cluster sized from "
+        "the window's demand x --prune-slack, with a post-solve "
+        "certificate escalating any window a pruned row could have "
+        "changed (decisions stay byte-identical); overrides the install "
+        "config's solver.prune-top-k (default 0 = off)",
+    )
+    srv.add_argument(
+        "--prune-slack",
+        type=float,
+        default=None,
+        help="candidate-pruning slack factor: kept rows per zone = "
+        "max(prune-top-k, ceil(window aggregate demand x slack)); "
+        "overrides solver.prune-slack (default 2.0)",
+    )
+    srv.add_argument(
         "--ha-replica",
         default=None,
         metavar="REPLICA_ID",
@@ -227,6 +246,10 @@ def main(argv=None) -> int:
         config.solver_mesh_node_shards = None
     if args.fuse_windows is not None:
         config.solver_fuse_windows = args.fuse_windows
+    if args.prune_top_k is not None:
+        config.solver_prune_top_k = args.prune_top_k
+    if args.prune_slack is not None:
+        config.solver_prune_slack = args.prune_slack
     if args.mesh is not None:
         try:
             groups, shards = (int(x) for x in args.mesh.lower().split("x"))

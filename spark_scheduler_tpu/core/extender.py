@@ -521,6 +521,8 @@ class SparkSchedulerExtender:
             snap.nodes, snap.usage, snap.overhead,
             topo_version=snap.nodes_version,
             statics_version=snap.statics_epoch,
+            roster_rows=snap.roster_rows,
+            dirty_hint=snap.dirty_hint,
         )
         t_tensors = self._clock()
         tensors_ms = (t_tensors - t_snap) * 1e3
@@ -628,6 +630,8 @@ class SparkSchedulerExtender:
             snap.nodes, snap.usage, snap.overhead,
             topo_version=snap.nodes_version,
             statics_version=snap.statics_epoch,
+            roster_rows=snap.roster_rows,
+            dirty_hint=snap.dirty_hint,
         )
         phases["featurize_tensors_ms"] = (self._clock() - t_snap) * 1e3
         requests = self._stage_driver_window(
@@ -946,11 +950,14 @@ class SparkSchedulerExtender:
                 snap.nodes, snap.usage, snap.overhead,
                 topo_version=snap.nodes_version,
                 statics_version=snap.statics_epoch,
+                roster_rows=snap.roster_rows,
+                dirty_hint=snap.dirty_hint,
             )
         except PipelineDrainRequired:
             return self._solver.build_tensors(
                 snap.nodes, snap.usage, snap.overhead,
                 full_node_list=True, topo_version=snap.nodes_version,
+                roster_rows=snap.roster_rows,
             )
 
     def _mark_outcome(self, pod, role, outcome, timer_start) -> None:

@@ -59,6 +59,138 @@ class FeatureSnapshot(NamedTuple):
     by_name: Mapping[str, Any]  # name -> Node over the same roster
     usage: Any  # dense int64 [cap,3] (or {node: Resources} w/o tracker)
     overhead: np.ndarray  # dense int64 [cap,3]
+    # Registry row of each node in `nodes` order (int32, frozen) — lets
+    # the solver scatter its request mask instead of walking 100k
+    # name->index lookups per cold build. None only when the registry was
+    # churning under the rebuild.
+    roster_rows: Optional[np.ndarray] = None
+    # (previous nodes_version, changed Node objects) when this snapshot's
+    # roster differs from the last one by UPDATES ONLY — the solver
+    # upserts just those into its native arena instead of the O(nodes)
+    # identity walk. None = no hint (full walk on version mismatch).
+    dirty_hint: Optional[tuple] = None
+
+
+class RankIndex:
+    """Incrementally-maintained node priority ordering for the candidate
+    prefilter (core/prune.py — the two-tier solve's tier 1).
+
+    Keeps every row of the registry index space sorted by the solver's
+    within-zone placement key — (available memory asc, cpu asc, name rank,
+    row index) — exactly the per-node components of ops/sorting.
+    priority_order. Per-group (per-domain) orderings are served by
+    filtering this global order through the group's row mask: subsetting
+    preserves relative order, so one resident index covers every instance
+    group.
+
+    Maintenance is O(changed) key math like the rest of the store: a
+    window's availability deltas touch a handful of rows, which are
+    removed, re-keyed, binary-searched (vectorized lexicographic bisect)
+    and merged back in two linear memcpys — versus a full O(N log N)
+    re-sort per window. Only a roster/statics change (full upload) pays a
+    rebuild.
+    """
+
+    __slots__ = (
+        "_order", "_pos", "_mem", "_cpu", "_name",
+        "rebuilds", "incremental_updates",
+    )
+
+    def __init__(self):
+        self._order: np.ndarray | None = None  # [N] int32
+        self._pos: np.ndarray | None = None  # [N] int32 inverse
+        self._mem: np.ndarray | None = None  # [N] int64 key snapshots
+        self._cpu: np.ndarray | None = None
+        self._name: np.ndarray | None = None
+        self.rebuilds = 0
+        self.incremental_updates = 0
+
+    def invalidate(self) -> None:
+        self._order = None
+
+    @property
+    def valid(self) -> bool:
+        return self._order is not None
+
+    def rebuild(self, avail: np.ndarray, name_rank: np.ndarray) -> None:
+        n = avail.shape[0]
+        self._mem = avail[:, 1].astype(np.int64)  # MEM_DIM
+        self._cpu = avail[:, 0].astype(np.int64)  # CPU_DIM
+        self._name = np.asarray(name_rank).astype(np.int64)
+        rows = np.arange(n)
+        self._order = np.lexsort(
+            (rows, self._name, self._cpu, self._mem)
+        ).astype(np.int32)
+        self._pos = np.empty(n, np.int32)
+        self._pos[self._order] = np.arange(n, dtype=np.int32)
+        self.rebuilds += 1
+
+    def update_rows(
+        self, avail: np.ndarray, name_rank: np.ndarray, dirty: np.ndarray
+    ) -> None:
+        """Re-key `dirty` rows against the new availability and merge them
+        back into the resident order. Callers guarantee the static fields
+        (name ranks, roster) are unchanged — the pipelined builder's delta
+        path proves exactly that before calling."""
+        if self._order is None or self._order.shape[0] != avail.shape[0]:
+            self.rebuild(avail, name_rank)
+            return
+        d = np.unique(np.asarray(dirty))
+        if d.size == 0:
+            return
+        keep = np.ones(self._order.shape[0], bool)
+        keep[self._pos[d]] = False
+        clean = self._order[keep]
+        self._mem[d] = avail[d, 1]
+        self._cpu[d] = avail[d, 0]
+        ds = d[np.lexsort((d, self._name[d], self._cpu[d], self._mem[d]))]
+        pos = self._bisect(clean, ds)
+        self._order = np.insert(clean, pos, ds)
+        self._pos[self._order] = np.arange(
+            self._order.shape[0], dtype=np.int32
+        )
+        self.incremental_updates += 1
+
+    def _bisect(self, clean: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """Vectorized lexicographic bisect: for each row, the count of
+        clean-order entries with a strictly smaller (mem, cpu, name, row)
+        key. Keys are totally ordered (row index tiebreak), so this is an
+        exact insertion position."""
+        mem, cpu, name = self._mem, self._cpu, self._name
+        rm, rc, rn = mem[rows], cpu[rows], name[rows]
+        n = clean.shape[0]
+        lo = np.zeros(rows.shape[0], np.int64)
+        hi = np.full(rows.shape[0], n, np.int64)
+        # Classic lower-bound bisection, all lanes in lockstep; log2(n)+1
+        # rounds always converge (lo == hi for every lane).
+        for _ in range(max(1, int(np.ceil(np.log2(n + 1))) + 1)):
+            active = lo < hi
+            mid = (lo + hi) // 2
+            m = clean[np.minimum(mid, n - 1)]
+            less = (mem[m] < rm) | (
+                (mem[m] == rm)
+                & (
+                    (cpu[m] < rc)
+                    | (
+                        (cpu[m] == rc)
+                        & ((name[m] < rn) | ((name[m] == rn) & (m < rows)))
+                    )
+                )
+            )
+            lo = np.where(active & less, mid + 1, lo)
+            hi = np.where(active & ~less, mid, hi)
+        return lo
+
+    def order(self) -> np.ndarray:
+        """The resident global order (treat as read-only)."""
+        return self._order
+
+    def stats(self) -> dict:
+        return {
+            "rebuilds": self.rebuilds,
+            "incremental_updates": self.incremental_updates,
+            "rows": 0 if self._order is None else int(self._order.shape[0]),
+        }
 
 
 class HostFeatureStore:
@@ -70,8 +202,15 @@ class HostFeatureStore:
         self._lock = threading.Lock()
         self._nodes: tuple = ()
         self._by_name: dict[str, Any] = {}
+        self._node_pos: dict[str, int] = {}  # name -> position in _nodes
         self._roster_topo: Optional[int] = None
         self._roster_dirty = True
+        # Add/delete (or racy) events force the full O(nodes) rebuild;
+        # update-only bursts ride the patch path below.
+        self._dirty_full = True
+        self._dirty_updates: dict[str, Any] = {}  # name -> newest Node
+        self._roster_rows: Optional[np.ndarray] = None
+        self._dirty_hint: Optional[tuple] = None
         self._statics_epoch = 0
         self._epoch = 0
         self._usage: Optional[np.ndarray] = None
@@ -88,23 +227,36 @@ class HostFeatureStore:
         # the tier-1 budget test and the featurize telemetry gauges.
         self.snapshots = 0
         self.roster_rebuilds = 0
+        self.roster_patches = 0
         self.usage_refreshes = 0
         self.overhead_refreshes = 0
         overhead_computer.attach_registry(registry)
         # Node events only mark the roster dirty (O(1)); the next snapshot
-        # pays the single re-list for the whole burst.
+        # pays ONE refresh for the whole burst — a patch (O(changed) dict
+        # update + tuple rebuild) when the burst was updates of known
+        # nodes, the full O(nodes) re-list otherwise.
         backend.subscribe(
             "nodes",
-            on_add=self._on_node_event,
-            on_update=lambda old, new: self._on_node_event(new),
-            on_delete=self._on_node_event,
+            on_add=self._on_node_add_delete,
+            on_update=self._on_node_update,
+            on_delete=self._on_node_add_delete,
         )
 
     # -- events ---------------------------------------------------------------
 
-    def _on_node_event(self, *_args) -> None:
+    def _on_node_add_delete(self, *_args) -> None:
         with self._lock:
             self._roster_dirty = True
+            self._dirty_full = True
+
+    def _on_node_update(self, _old, new) -> None:
+        with self._lock:
+            self._roster_dirty = True
+            if not self._dirty_full:
+                if new.name in self._node_pos:
+                    self._dirty_updates[new.name] = new
+                else:
+                    self._dirty_full = True  # unknown name: treat as add
 
     # -- snapshot -------------------------------------------------------------
 
@@ -114,6 +266,8 @@ class HostFeatureStore:
             self._refresh_roster()
             usage = self._refresh_usage()
             self._refresh_overhead()
+            hint = self._dirty_hint
+            self._dirty_hint = None  # one consumer, one hand-off
             return FeatureSnapshot(
                 epoch=self._epoch,
                 statics_epoch=self._statics_epoch,
@@ -122,33 +276,77 @@ class HostFeatureStore:
                 by_name=self._by_name,
                 usage=usage,
                 overhead=self._overhead_arr,
+                roster_rows=self._roster_rows,
+                dirty_hint=hint,
             )
 
     def _refresh_roster(self) -> None:
-        """Re-list the roster only when a node event (or an unobserved
-        backend version move) says it drifted. Version captured BEFORE the
-        list and re-checked after — a concurrent mutation can only make the
-        roster look stale (one extra walk next snapshot), never fresh over
-        an unsynced list. This is the single owner of that dance; the
-        extender's per-window copy of it is gone."""
+        """Refresh the roster only when a node event (or an unobserved
+        backend version move) says it drifted.
+
+        UPDATE-ONLY bursts (the common node event: heartbeat flips,
+        capacity drift) take the PATCH path: the changed Node objects were
+        captured by the event subscription, so the roster tuple and
+        name->Node map are copied and patched in O(nodes) memcpy +
+        O(changed) dict writes — no backend re-list, no re-intern, and the
+        registry-row array / live-row mask carry over unchanged (the name
+        set is identical). The solver gets the changed objects as
+        `dirty_hint` so its native-arena sync upserts just those rows.
+
+        Adds, deletes, unknown names, or a racing version take the full
+        rebuild: version captured BEFORE the list and re-checked after — a
+        concurrent mutation can only make the roster look stale (one extra
+        walk next snapshot), never fresh over an unsynced list. This is
+        the single owner of that dance."""
         topo = getattr(self._backend, "nodes_version", None)
         if not (
             self._roster_dirty or topo is None or topo != self._roster_topo
         ):
             return
+        can_patch = (
+            not self._dirty_full
+            and self._dirty_updates
+            and topo is not None
+            and self._roster_topo is not None
+        )
+        if can_patch:
+            prev = self._roster_topo
+            updates = self._dirty_updates
+            self._dirty_updates = {}
+            nodes = list(self._nodes)
+            by_name = dict(self._by_name)
+            pos = self._node_pos
+            for name, node in updates.items():
+                nodes[pos[name]] = node
+                by_name[name] = node
+            self._nodes = tuple(nodes)
+            self._by_name = by_name
+            self._roster_topo = topo
+            self._roster_dirty = False
+            self._dirty_hint = (prev, tuple(updates.values()))
+            self._statics_epoch += 1
+            self._epoch += 1
+            self.roster_patches += 1
+            return
         nodes = self._backend.list_nodes()
         topo_after = getattr(self._backend, "nodes_version", None)
         self._nodes = tuple(nodes)
         self._by_name = {n.name: n for n in nodes}
+        self._node_pos = {n.name: i for i, n in enumerate(nodes)}
         raced = topo is None or topo != topo_after
         self._roster_topo = None if raced else topo
         self._roster_dirty = raced
+        self._dirty_full = raced
+        self._dirty_updates = {}
+        self._dirty_hint = None
         # Rebuild the live-row mask (we are already on the O(nodes) path)
-        # and force the overhead copy to re-mask against it.
-        intern = self._registry.intern
-        idx = [intern(n.name) for n in nodes]
+        # and force the overhead copy to re-mask against it. One bulk
+        # intern instead of a lock acquire per name.
+        rows = self._registry.intern_many([n.name for n in nodes])
+        rows.flags.writeable = False
+        self._roster_rows = rows
         mask = np.zeros(max(self._registry.capacity, 1), dtype=bool)
-        mask[idx] = True
+        mask[rows] = True
         self._roster_mask = mask
         self._overhead_version = None
         self._statics_epoch += 1
@@ -200,6 +398,7 @@ class HostFeatureStore:
             return {
                 "snapshots": self.snapshots,
                 "roster_rebuilds": self.roster_rebuilds,
+                "roster_patches": self.roster_patches,
                 "usage_refreshes": self.usage_refreshes,
                 "overhead_refreshes": self.overhead_refreshes,
                 "nodes": len(self._nodes),

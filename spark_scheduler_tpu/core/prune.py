@@ -1,0 +1,463 @@
+"""Sound top-K candidate pruning for the window solve (the two-tier solve).
+
+At 100k nodes the window kernel scans every row per scan step even though a
+32-driver window can only ever touch a few hundred of them. The two-tier
+solve makes the device program O(K):
+
+  Tier 1 (host prefilter, this module): rank the window domain's nodes by
+  the solver's own placement key — the priority order the kernels sort by,
+  (zone rank, available mem asc, cpu asc, name rank) — riding the
+  feature-rank index's resident order (core/feature_store.RankIndex), and
+  gather the top-K candidate rows per zone, K sized from the window's
+  aggregate demand x `solver.prune-slack`. The device then solves a [K,3]
+  gathered sub-cluster with one small h2d instead of shipping [B,N] masks.
+
+  Tier 2 (the certificate, also this module): soundness is ENFORCED, not
+  assumed. After the pruned solve, `certify_window` replays the window's
+  availability thread host-side and verifies that no pruned-away row could
+  have altered any decision:
+
+    - zone ranks are byte-exact by construction (the excluded rows' per-zone
+      availability sums ship into the kernel as constant offsets,
+      ops/sorting.zone_ranks zone_base);
+    - a DENIAL is certified only if no excluded row could have cured it
+      (capacity-bound test over the excluded rows' per-zone availability
+      maxima, for both the driver fit and the executor capacity);
+    - an ADMISSION is certified only if (a) no excluded driver candidate
+      with a better priority key could fit the driver, (b) no excluded
+      executor-capable row ranks before the worst chosen executor row,
+      (c) excluded capacity could not have flipped the feasibility of a
+      better-ranked kept driver candidate the pruned solve rejected, and
+      (d) strategy-specific order hazards are absent (minimal-fragmentation
+      consumes by capacity DESC, so any excluded capacity escalates;
+      distribute-evenly escalates on multi-round fills).
+
+  A failed certificate ESCALATES the window: the solver re-solves it from
+  the exact host reconstruction via the greedy oracle (core/fallback.py —
+  slot-for-slot the kernels' semantics), so decisions stay byte-identical
+  to the unpruned path by construction, and the escalation is counted in
+  `foundry.spark.scheduler.solver.prune.*`.
+
+Every test here is CONSERVATIVE (it may escalate a window the full solve
+would have decided identically, never the reverse): per-dim maxima over
+excluded rows overestimate fit, candidate masks are ignored for excluded
+driver checks, and any uncertainty (a prior window's placement landing on
+an excluded row, a non-kept index in the blob) escalates outright.
+
+Gating (checked by the solver before planning): plain fills only (the
+single-AZ wrappers score zones by subset-dependent efficiencies), no
+configured label priorities (the keys above assume the label rank is
+uniformly INT32_INF), and one shared domain per window (the pooled
+partition path prunes per-partition instead, where each partition's domain
+is uniform by construction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from spark_scheduler_tpu.models.resources import CPU_DIM, MEM_DIM
+
+PLAIN_FILLS = frozenset(
+    {"tightly-pack", "distribute-evenly", "minimal-fragmentation"}
+)
+
+_I64_MAX = np.iinfo(np.int64).max
+
+
+def _bucket(n: int, minimum: int) -> int:
+    out = minimum
+    while out < n:
+        out *= 2
+    return out
+
+
+def zone_ranks_host(
+    mem_sum: np.ndarray,  # [Z] int64 — per-zone available-memory sums
+    cpu_sum: np.ndarray,  # [Z] int64
+    present: np.ndarray,  # [Z] bool — zone has a (domain & valid) node
+) -> np.ndarray:  # [Z] int32 — rank of each zone (0 = highest priority)
+    """Host replica of ops/sorting.zone_ranks: ascending (mem, cpu), absent
+    zones last, zone-id tiebreak. The kernel's chunked int32 aggregation is
+    an exact int64 sum in normal form, so comparing int64 sums here yields
+    the identical order — the certificate depends on that equality."""
+    z = mem_sum.shape[0]
+    absent = np.where(present, 0, 1)
+    order = np.lexsort((np.arange(z), cpu_sum, mem_sum, absent))
+    ranks = np.empty(z, np.int32)
+    ranks[order] = np.arange(z, dtype=np.int32)
+    return ranks
+
+
+def split_zone_sums(sums: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """int64 per-zone sums -> (hi, lo) int32 limbs for the device offset
+    (hi = S >> 24 arithmetic, lo = S & 0xFFFFFF; exact for |S| < 2^55)."""
+    return (
+        (sums >> 24).astype(np.int32),
+        (sums & 0xFFFFFF).astype(np.int32),
+    )
+
+
+def _lex_lt(a0, a1, a2, a3, b0, b1, b2, b3):
+    """Vectorized (a0,a1,a2,a3) < (b0,b1,b2,b3) — the priority-key compare
+    (az rank, mem, cpu, name rank), lower = higher priority."""
+    return (a0 < b0) | (
+        (a0 == b0)
+        & (
+            (a1 < b1)
+            | (
+                (a1 == b1)
+                & ((a2 < b2) | ((a2 == b2) & (a3 < b3)))
+            )
+        )
+    )
+
+
+@dataclasses.dataclass
+class PrunePlan:
+    """One window's candidate-pruning decision: the kept row set, the
+    device zone-sum offsets, and the excluded-row summaries the
+    certificate tests against. All arrays are host numpy."""
+
+    keep: np.ndarray  # [Kp] int32 — kept global rows, real first, padded
+    k_real: int  # number of real kept rows (padding repeats keep[0])
+    kept_mask: np.ndarray  # [N] bool
+    dom_mask: np.ndarray  # [N] bool — window domain & valid
+    num_zones: int  # the solver's zone bucket Zb
+    # Device offsets: excluded-row zone sums as int32 limbs + present.
+    zone_base: tuple  # (mem_hi, mem_lo, cpu_hi, cpu_lo, present) [Zb] each
+    # Dispatch-time zone sums over the WHOLE domain (kept + excluded) —
+    # the certificate threads these (minus committed placements) to
+    # replicate the kernel's per-segment zone ranks.
+    zone_mem: np.ndarray  # [Zb] int64
+    zone_cpu: np.ndarray  # [Zb] int64
+    present: np.ndarray  # [Zb] bool
+    # Excluded-row summaries, per zone, over rows RELEVANT to this window
+    # (rows fitting the window's per-dim minimum demand; rows that fit no
+    # request are provably transparent — zero capacity, no driver fit).
+    e_cnt_exec: np.ndarray  # [Zb] int64 — relevant excluded exec-eligible
+    e_max_exec: np.ndarray  # [Zb,3] int64 — per-dim avail max (conservative fit)
+    e_key_exec: np.ndarray  # [Zb,3] int64 — lexmin (mem,cpu,name), I64_MAX pad
+    e_cnt_drv: np.ndarray  # [Zb] int64
+    e_max_drv: np.ndarray  # [Zb,3] int64
+    e_key_drv: np.ndarray  # [Zb,3] int64
+    # Per-request driver candidate masks gathered onto the kept rows.
+    cand_kept: list  # [B_req] of [Kp] bool
+    dom_rows: int  # |domain| (stats)
+
+
+def plan_window_prune(
+    host,
+    *,
+    order: np.ndarray,  # RankIndex order: all rows sorted by (mem,cpu,name)
+    dom_mask: np.ndarray,  # [N] bool — shared window domain, already & valid
+    cand_per_req: list,  # per-request [N] bool driver candidate masks
+    drv_arr: np.ndarray,  # [B,3] i32 — per flat row
+    exc_arr: np.ndarray,  # [B,3] i32
+    counts: np.ndarray,  # [B] i32
+    num_zones: int,
+    top_k: int,
+    slack: float,
+) -> PrunePlan | None:
+    """Build the window's pruning plan, or None when pruning cannot help
+    (the kept set would cover most of the domain anyway)."""
+    avail = np.asarray(host.available)
+    zone_id = np.asarray(host.zone_id)
+    n = avail.shape[0]
+
+    # Per-dim minimum demand over every flat row (hypotheticals included):
+    # a row that cannot fit this vector cannot host any driver/executor of
+    # the window, so it is provably transparent to every choice the kernel
+    # makes (zero capacity for every request, driver fit false) — only its
+    # zone-sum contribution matters, and that ships as the device offset.
+    min_dr = drv_arr.min(axis=0)
+    min_er = exc_arr.min(axis=0)
+
+    exec_elig = (
+        dom_mask
+        & ~np.asarray(host.unschedulable, bool)
+        & np.asarray(host.ready, bool)
+    )
+    fit_e = (avail >= min_er[None, :]).all(axis=1) & exec_elig
+    fit_d = (avail >= min_dr[None, :]).all(axis=1) & dom_mask
+
+    b = drv_arr.shape[0]
+    demand = int(counts.sum()) + b
+    k_per_zone = max(int(top_k), int(np.ceil(demand * slack)))
+
+    # Top-K PER ZONE of the priority order, separately for executor-capable
+    # and driver-capable rows: a per-zone prefix stays a prefix under any
+    # zone-rank permutation, so mid-window zone-rank drift cannot promote
+    # an excluded row past a kept one within its zone.
+    fo = order[fit_e[order]]
+    do = order[fit_d[order]]
+    zids = np.unique(zone_id[dom_mask]) if dom_mask.any() else np.array([], np.int32)
+    sel: list[np.ndarray] = []
+    for z in zids:
+        sel.append(fo[zone_id[fo] == z][:k_per_zone])
+        sel.append(do[zone_id[do] == z][:k_per_zone])
+    kept_mask = np.zeros(n, dtype=bool)
+    if sel:
+        kept_mask[np.concatenate(sel)] = True
+    keep = np.flatnonzero(kept_mask).astype(np.int32)
+    k_real = len(keep)
+    dom_rows = int(dom_mask.sum())
+    if k_real == 0 or k_real >= 0.7 * dom_rows:
+        return None  # pruning buys nothing on this window
+
+    zb = num_zones
+    excl = dom_mask & ~kept_mask
+    e_rows = np.flatnonzero(excl)
+    e_zone = zone_id[e_rows]
+    e_avail = avail[e_rows].astype(np.int64)
+
+    # Device zone-sum offsets: ALL excluded domain rows (relevant or not).
+    s_mem = np.zeros(zb, np.int64)
+    s_cpu = np.zeros(zb, np.int64)
+    np.add.at(s_mem, e_zone, e_avail[:, MEM_DIM])
+    np.add.at(s_cpu, e_zone, e_avail[:, CPU_DIM])
+    present = np.zeros(zb, bool)
+    present[np.unique(zone_id[dom_mask])] = True
+
+    # Whole-domain dispatch sums = kept sums + excluded sums.
+    zone_mem = s_mem.copy()
+    zone_cpu = s_cpu.copy()
+    kept_avail = avail[keep].astype(np.int64)
+    kept_zone = zone_id[keep]
+    np.add.at(zone_mem, kept_zone, kept_avail[:, MEM_DIM])
+    np.add.at(zone_cpu, kept_zone, kept_avail[:, CPU_DIM])
+
+    name_rank = np.asarray(host.name_rank).astype(np.int64)
+
+    def _summaries(rel_mask: np.ndarray):
+        rows = np.flatnonzero(rel_mask & excl)
+        cnt = np.bincount(zone_id[rows], minlength=zb).astype(np.int64)
+        mx = np.full((zb, avail.shape[1]), np.iinfo(np.int64).min, np.int64)
+        np.maximum.at(mx, zone_id[rows], avail[rows].astype(np.int64))
+        # The priority order IS sorted by (mem, cpu, name): the first
+        # relevant excluded row of each zone in order is that zone's lexmin
+        # key — no per-window sort.
+        key = np.full((zb, 3), _I64_MAX, np.int64)
+        ro = order[(rel_mask & excl)[order]]
+        zfirst, first_idx = np.unique(zone_id[ro], return_index=True)
+        fr = ro[first_idx]
+        key[zfirst, 0] = avail[fr, MEM_DIM]
+        key[zfirst, 1] = avail[fr, CPU_DIM]
+        key[zfirst, 2] = name_rank[fr]
+        return cnt, mx, key
+
+    e_cnt_exec, e_max_exec, e_key_exec = _summaries(fit_e)
+    e_cnt_drv, e_max_drv, e_key_drv = _summaries(fit_d)
+
+    kp = _bucket(k_real, 64)
+    keep_padded = np.full(kp, keep[0], np.int32)
+    keep_padded[:k_real] = keep
+
+    mem_hi, mem_lo = split_zone_sums(s_mem)
+    cpu_hi, cpu_lo = split_zone_sums(s_cpu)
+    return PrunePlan(
+        keep=keep_padded,
+        k_real=k_real,
+        kept_mask=kept_mask,
+        dom_mask=dom_mask,
+        num_zones=zb,
+        zone_base=(mem_hi, mem_lo, cpu_hi, cpu_lo, present),
+        zone_mem=zone_mem,
+        zone_cpu=zone_cpu,
+        present=present,
+        e_cnt_exec=e_cnt_exec,
+        e_max_exec=e_max_exec,
+        e_key_exec=e_key_exec,
+        e_cnt_drv=e_cnt_drv,
+        e_max_drv=e_max_drv,
+        e_key_drv=e_key_drv,
+        cand_kept=[np.asarray(c)[keep_padded] for c in cand_per_req],
+        dom_rows=dom_rows,
+    )
+
+
+def certify_window(
+    plan: PrunePlan,
+    *,
+    strategy: str,
+    requests,  # the window's WindowRequests (row counts per segment)
+    drivers: np.ndarray,  # [B] int64 GLOBAL node indices (-1 = none)
+    admitted: np.ndarray,  # [B] bool
+    packed: np.ndarray,  # [B] bool
+    execs: np.ndarray,  # [B, Emax] int64 GLOBAL indices
+    drv64: np.ndarray,  # [B, 3] int64 per-row driver request
+    exc64: np.ndarray,  # [B, 3] int64 per-row executor request
+    base: np.ndarray,  # [N, 3] int64 — EXACT dispatch base (host view minus
+    #                     in-flight priors' placements); NOT mutated
+    host,  # host ClusterTensors view at dispatch
+    prior_rows: np.ndarray,  # rows any in-flight prior placed on (global)
+) -> tuple[bool, str | None]:
+    """Replay the window's availability thread and certify that the pruned
+    solve's decisions equal the full solve's. Returns (ok, reason) —
+    reason names the first failed test (telemetry label)."""
+    # The device offsets assumed excluded rows kept their host-view
+    # availability; a prior window's placement on an excluded row breaks
+    # that (the plan was built before the prior's placements were known).
+    # Rows outside the window domain are transparent to every choice
+    # (masked from eligibility and zone sums alike), so only domain rows
+    # are tested.
+    prior_rows = prior_rows[plan.dom_mask[prior_rows]]
+    if prior_rows.size and not plan.kept_mask[prior_rows].all():
+        return False, "prior-placed-excluded"
+
+    zone_id = np.asarray(host.zone_id)
+    name_rank = np.asarray(host.name_rank).astype(np.int64)
+    keep = plan.keep[: plan.k_real]
+    lut = np.full(zone_id.shape[0], -1, np.int32)
+    lut[keep] = np.arange(plan.k_real, dtype=np.int32)
+
+    k_zone = zone_id[keep]
+    k_name = name_rank[keep]
+    base_kept = base[keep].copy()  # threaded across segments (commits only)
+    zs_mem = plan.zone_mem.copy()
+    zs_cpu = plan.zone_cpu.copy()
+    # Priors placed only on kept rows (verified above): fold their
+    # placements out of the dispatch sums to reach the true base sums.
+    # base == host view - priors, and plan sums were over the host view.
+    if prior_rows.size:
+        delta = np.asarray(host.available).astype(np.int64)[prior_rows] - base[prior_rows]
+        np.add.at(zs_mem, zone_id[prior_rows], -delta[:, MEM_DIM])
+        np.add.at(zs_cpu, zone_id[prior_rows], -delta[:, CPU_DIM])
+
+    # Per-row conservative excluded-fit tables, vectorized across the batch.
+    b = drv64.shape[0]
+    fit_e_zb = (
+        (plan.e_max_exec[None, :, :] >= exc64[:, None, :]).all(axis=2)
+        & (plan.e_cnt_exec > 0)[None, :]
+    )  # [B, Zb]
+    fit_d_zb = (
+        (plan.e_max_drv[None, :, :] >= drv64[:, None, :]).all(axis=2)
+        & (plan.e_cnt_drv > 0)[None, :]
+    )
+
+    az = zone_ranks_host(zs_mem, zs_cpu, plan.present)
+    az_dirty = False
+    row = 0
+    for req_i, req in enumerate(requests):
+        nrows = len(req.rows)
+        if az_dirty:
+            az = zone_ranks_host(zs_mem, zs_cpu, plan.present)
+            az_dirty = False
+        # Segment-start keys: the kernel computes priority orders ONCE per
+        # segment from the segment-start availability and reuses them while
+        # only availability mutates (resource.go:299 semantics) — so every
+        # key comparison below uses these, while fit/capacity tests use the
+        # current in-segment availability.
+        k_az = az[k_zone].astype(np.int64)
+        k_mem = base_kept[:, MEM_DIM].copy()
+        k_cpu = base_kept[:, CPU_DIM].copy()
+        cand_k = plan.cand_kept[req_i][: plan.k_real]
+        seg_kept = None  # lazy copy — only hypothetical commits mutate it
+        for j in range(nrows):
+            r = row + j
+            cur = base_kept if seg_kept is None else seg_kept
+            dr = drv64[r]
+            er = exc64[r]
+            any_e = bool(fit_e_zb[r].any())
+            any_d = bool(fit_d_zb[r].any())
+            if not packed[r]:
+                # Denial: could an excluded row have cured it? Excluded
+                # rows' availability is static during the window, so the
+                # per-zone maxima are a sound (conservative) upper bound.
+                if any_e or any_d:
+                    return False, "denial-curable"
+            elif admitted[r]:
+                # Only admitted rows subtract availability, so only their
+                # CHOICES must be pinned; a packed-but-blocked row's flags
+                # are already implied identical by the preceding checks.
+                if strategy == "minimal-fragmentation" and any_e:
+                    # Consumption order is capacity DESC — any excluded
+                    # capacity can reorder it regardless of priority rank.
+                    return False, "minfrag-excluded-capacity"
+                d = int(drivers[r])
+                dl = lut[d] if d >= 0 else -1
+                ev = execs[r][execs[r] >= 0]
+                el = lut[ev] if ev.size else ev.astype(np.int32)
+                if d < 0 or dl < 0 or (ev.size and (el < 0).any()):
+                    return False, "non-kept-choice"  # cannot happen; belt+braces
+                key_d = (k_az[dl], k_mem[dl], k_cpu[dl], k_name[dl])
+                # (a) Excluded driver candidate with a better key that fits.
+                zsel = fit_d_zb[r]
+                if zsel.any():
+                    better = _lex_lt(
+                        az[zsel].astype(np.int64),
+                        plan.e_key_drv[zsel, 0],
+                        plan.e_key_drv[zsel, 1],
+                        plan.e_key_drv[zsel, 2],
+                        *key_d,
+                    )
+                    if better.any():
+                        return False, "driver-excluded-better"
+                # (c) Feasibility flip: the pruned solve rejected every
+                # better-ranked kept fitting candidate for capacity; with
+                # excluded capacity in play the full solve might not have.
+                if any_e:
+                    fits_kept = (cur >= dr[None, :]).all(axis=1) & cand_k
+                    if fits_kept.any():
+                        better_kept = fits_kept & _lex_lt(
+                            k_az, k_mem, k_cpu, k_name, *key_d
+                        )
+                        if better_kept.any():
+                            return False, "driver-feasibility-flip"
+                if ev.size:
+                    # (b) Worst chosen executor row vs best excluded
+                    # executor-capable row, by segment-start keys.
+                    cu = np.unique(el)
+                    worst = cu[
+                        np.lexsort(
+                            (k_name[cu], k_cpu[cu], k_mem[cu], k_az[cu])
+                        )[-1]
+                    ]
+                    key_w = (
+                        k_az[worst], k_mem[worst], k_cpu[worst], k_name[worst]
+                    )
+                    zsel = fit_e_zb[r]
+                    if zsel.any():
+                        better = _lex_lt(
+                            az[zsel].astype(np.int64),
+                            plan.e_key_exec[zsel, 0],
+                            plan.e_key_exec[zsel, 1],
+                            plan.e_key_exec[zsel, 2],
+                            *key_w,
+                        )
+                        if better.any():
+                            return False, "executor-excluded-better"
+                    # (d) distribute-evenly revisits nodes round-robin: a
+                    # second round would have visited excluded open rows
+                    # before re-filling kept ones.
+                    if (
+                        strategy == "distribute-evenly"
+                        and any_e
+                        and ev.size > len(cu)
+                    ):
+                        return False, "distribute-multi-round"
+                # Apply the row's placements to the thread.
+                is_commit = j == nrows - 1
+                if is_commit:
+                    target = base_kept
+                    if dl >= 0:
+                        np.add.at(zs_mem, [k_zone[dl]], -int(dr[MEM_DIM]))
+                        np.add.at(zs_cpu, [k_zone[dl]], -int(dr[CPU_DIM]))
+                    if ev.size:
+                        np.add.at(
+                            zs_mem, k_zone[el], -int(er[MEM_DIM])
+                        )
+                        np.add.at(
+                            zs_cpu, k_zone[el], -int(er[CPU_DIM])
+                        )
+                    az_dirty = True
+                else:
+                    if seg_kept is None:
+                        seg_kept = base_kept.copy()
+                    target = seg_kept
+                target[dl] -= dr
+                np.subtract.at(target, el, er[None, :])
+        row += nrows
+    return True, None

@@ -137,6 +137,18 @@ def _tensors_nbytes(host) -> int:
     return total
 
 
+def _gather_statics_host(host, keep: np.ndarray, k_real: int) -> tuple:
+    """Host-side gather of the static cluster fields onto a (padded) kept
+    row set for the pruned sub-cluster upload. Padding repeats keep[0];
+    the padded rows' `valid` is forced False so they are transparent to
+    the kernel (eligibility, zone sums, capacity all mask on valid)."""
+    fields = [np.asarray(f)[keep] for f in cluster_statics(host)]
+    valid = fields[-1].copy()  # cluster_statics order ends with `valid`
+    valid[k_real:] = False
+    fields[-1] = valid
+    return tuple(fields)
+
+
 # Fields that force a full re-upload when they change (node topology /
 # attribute changes — rare next to availability churn).
 _STATIC_FIELDS = (
@@ -307,15 +319,67 @@ def _window_blob_split(avail, statics, apps, *, fill, emax, num_zones):
     return blob, out.available_after
 
 
+def _window_blob_pruned_split(
+    avail, statics, apps, zone_base, *, fill, emax, num_zones
+):
+    """Pruned-window solve over a GATHERED top-K sub-cluster (core/prune.py):
+    `avail`/`statics` hold only the kept rows, `zone_base` carries the
+    excluded rows' per-zone availability sums so zone ranks stay byte-exact
+    with the full solve (ops/sorting.zone_ranks). Returns the decision blob
+    plus the availability DELTA (after - before): padding rows and
+    duplicate padded indices then scatter back into the resident [N,3]
+    carry as additive zeros — deterministic where a .set of padded values
+    would race."""
+    out = batched_fifo_pack(
+        cluster_from_statics(avail, statics), apps,
+        fill=fill, emax=emax, num_zones=num_zones, zone_base=zone_base,
+    )
+    blob = jnp.concatenate(
+        [
+            out.driver_node[:, None],
+            out.admitted[:, None].astype(jnp.int32),
+            out.packed[:, None].astype(jnp.int32),
+            out.executor_nodes,
+        ],
+        axis=1,
+    )
+    return blob, out.available_after - avail
+
+
+_window_blob_pruned = jax.jit(
+    _window_blob_pruned_split, static_argnames=("fill", "emax", "num_zones")
+)
+
+
 _window_blob_statics = jax.jit(
     _window_blob_split, static_argnames=("fill", "emax", "num_zones")
 )
+
+
+def _window_blob_split_donated(avail, statics, apps, *, fill, emax, num_zones):
+    """`_window_blob_split` under a DONATION-MARKED module name. The
+    persistent compilation cache must never serve a donated program from
+    disk: reloaded donated executables intermittently returned WRONG
+    window decisions (spurious failure-fit / shifted placements —
+    reproduced 4/4 on hack/ha_shard_bench.py's chaos soak whenever the
+    donated `jit__window_blob_split` entry was a cache HIT, never on a
+    miss; PR 8 ran that bench cache-free as the workaround). Donation is
+    invisible in the cache-key string, so the jitted wrapper gets its own
+    function name and InstallConfig.serialize_jax_cache_io() gates every
+    donation-marked module out of cache reads AND writes — donated
+    programs always compile in-process (a few seconds once per process),
+    while the expensive undonated kernels keep the cache."""
+    return _window_blob_split(
+        avail, statics, apps, fill=fill, emax=emax, num_zones=num_zones
+    )
+
+
 # Double-buffered committed base: the carry is DONATED, so available_after
 # reuses the input buffer in place instead of copy-on-write. The input base
 # is DEAD after the call — the pipeline threads available_after forward and
 # nothing else may read the consumed buffer (tests pin the deletion).
 _window_blob_donated = jax.jit(
-    _window_blob_split,
+    _window_blob_split_donated,
     static_argnames=("fill", "emax", "num_zones"),
     donate_argnums=(0,),
 )
@@ -333,7 +397,9 @@ def _take_rows(arr, idx):
 def _scatter_rows_exact_donated(base, idx, rows):
     """Scatter a partition's committed sub-base back into the (DONATED)
     global base. `idx` is the partition's EXACT domain index list — no
-    padding, no duplicates — so .set is deterministic and in-place."""
+    padding, no duplicates — so .set is deterministic and in-place.
+    The "_donated" function name is load-bearing: it marks the module for
+    the persistent-cache donation gate (see _window_blob_split_donated)."""
     return base.at[idx].set(rows)
 
 
@@ -341,7 +407,8 @@ def _scatter_rows_exact_donated(base, idx, rows):
 def _add_rows_donated(avail, idx, delta_rows):
     """`_add_rows` with the pipelined base DONATED: external availability
     deltas update the committed base in place. The input buffer is dead
-    after the call; only the returned array may be threaded forward."""
+    after the call; only the returned array may be threaded forward.
+    "_donated" in the name feeds the persistent-cache donation gate."""
     return avail.at[idx].add(delta_rows)
 
 
@@ -611,11 +678,12 @@ class _WindowPart:
     __slots__ = (
         "future", "after_future", "req_ids", "requests", "row_drv",
         "row_exc", "row_skip", "idx", "slot", "rows", "idx_key", "apps",
+        "prune",
     )
 
     def __init__(self, *, future, after_future, req_ids, requests, row_drv,
                  row_exc, row_skip, idx, slot, rows, idx_key=None,
-                 apps=None):
+                 apps=None, prune=None):
         self.future = future
         self.after_future = after_future
         self.req_ids = req_ids  # original positions in the window
@@ -631,6 +699,10 @@ class _WindowPart:
         # part's solve on a surviving slot byte-identically.
         self.idx_key = idx_key
         self.apps = apps
+        # PrunePlan when this part solved a pruned top-K gather of its
+        # domain (core/prune.py): its after_future then carries a DELTA
+        # (combined additively), and the fetch runs the certificate.
+        self.prune = prune
 
 
 @_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
@@ -699,7 +771,7 @@ class WindowHandle:
         "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
         "info", "parts", "request_device", "dispatch_id", "dispatched_at",
         "fused_decisions", "released", "host_tensors", "use_fallback",
-        "__weakref__",
+        "prune", "fallback_reason", "__weakref__",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
@@ -755,6 +827,15 @@ class WindowHandle:
         # True: no device solved this window (every slot quarantined at
         # dispatch); pack_window_fetch serves it via the greedy fallback.
         self.use_fallback = False
+        # Candidate-pruning state (core/prune.PrunePlan) when this window
+        # was solved over a gathered top-K sub-cluster; pack_window_fetch
+        # maps the blob's local indices back and runs the certificate.
+        self.prune = None
+        # Why use_fallback was set ("prune-escalation" = a sibling window's
+        # failed certificate invalidated the carry this window solved on;
+        # None = degraded-mode serving — only the latter counts against the
+        # degraded controller's decision gauges).
+        self.fallback_reason = None
 
     def release_buffers(self) -> None:
         """Drop the dispatch's staging buffers: the device decision blob
@@ -855,8 +936,27 @@ class PlacementSolver:
         device_pool: int = 1,
         mesh: tuple[int, int] | None = None,
         quarantine_probe_s: float = 5.0,
+        prune_top_k: int = 0,
+        prune_slack: float = 2.0,
     ):
         self.registry = NodeRegistry()
+        # Candidate pruning (`solver.prune-top-k` / `solver.prune-slack`,
+        # core/prune.py): when top-k > 0, eligible pipelined windows solve
+        # a gathered top-K sub-cluster and every decision is certified
+        # against the full solve at fetch (escalating to the exact host
+        # re-solve on a failed certificate). 0 = off (the default): the
+        # classic full-tensor paths byte-for-byte.
+        self._prune_top_k = int(prune_top_k)
+        self._prune_slack = float(prune_slack)
+        self._rank_index = None  # lazy core/feature_store.RankIndex
+        self.prune_stats = {
+            "windows": 0,
+            "escalations": 0,
+            "kept_rows": 0,
+            "window_rows": 0,
+            "candidate_rows": 0,
+            "reasons": {},
+        }
         # Multi-device window-solve engine (`solver.device-pool` /
         # `solver.mesh` install keys): `mesh=(groups, node_shards)` builds
         # `groups` pool slots of `node_shards` devices each (node_shards>1
@@ -982,6 +1082,118 @@ class PlacementSolver:
             self._fallback = GreedyFallbackSolver(self)
         return self._fallback
 
+    # -- candidate pruning (core/prune.py) --------------------------------
+
+    def _prune_eligible(self, strategy: str) -> bool:
+        """Static gate for the two-tier solve: plain fills only (single-AZ
+        wrappers score zones by subset-dependent efficiencies) and no
+        configured label priorities (the prefilter/certificate keys assume
+        a uniform label rank)."""
+        from spark_scheduler_tpu.core.prune import PLAIN_FILLS
+
+        return (
+            self._prune_top_k > 0
+            and strategy in PLAIN_FILLS
+            and self._driver_label_priority is None
+            and self._executor_label_priority is None
+        )
+
+    def _rank_order(self, host) -> np.ndarray:
+        """The resident priority order for the prefilter, synced to the
+        current host availability (O(changed) merge; full rebuild only
+        after a topology/statics change invalidated it)."""
+        if self._rank_index is None:
+            from spark_scheduler_tpu.core.feature_store import RankIndex
+
+            self._rank_index = RankIndex()
+        idx = self._rank_index
+        avail = np.asarray(host.available)
+        if not idx.valid or idx.order().shape[0] != avail.shape[0]:
+            idx.rebuild(avail, host.name_rank)
+        else:
+            dirty = np.flatnonzero(
+                (idx._mem != avail[:, 1]) | (idx._cpu != avail[:, 0])
+            )
+            if dirty.size > avail.shape[0] // 4:
+                idx.rebuild(avail, host.name_rank)
+            elif dirty.size:
+                idx.update_rows(avail, host.name_rank, dirty)
+        return idx.order()
+
+    def _plan_prune(
+        self, host, dom_mask, cand_per_req, drv_arr, exc_arr, counts
+    ):
+        """Build a PrunePlan for one window/partition, or None."""
+        from spark_scheduler_tpu.core.prune import plan_window_prune
+
+        return plan_window_prune(
+            host,
+            order=self._rank_order(host),
+            dom_mask=np.asarray(dom_mask, bool),
+            cand_per_req=cand_per_req,
+            drv_arr=drv_arr,
+            exc_arr=exc_arr,
+            counts=counts,
+            num_zones=self._num_zones_bucket(),
+            top_k=self._prune_top_k,
+            slack=self._prune_slack,
+        )
+
+    def _shared_prune_domain(self, requests, dom_keys, dom_per_req):
+        """The single shared window domain, or None when requests pin
+        distinct domains (the pooled partition path prunes per-partition
+        instead; a mixed single-device window solves full)."""
+        if any(r.domain_mask is not None for r in requests):
+            return None
+        keys = set(dom_keys)
+        if len(keys) != 1:
+            return None
+        return dom_per_req[0]
+
+    def _note_prune_dispatch(self, plan, window_rows: int) -> None:
+        st = self.prune_stats
+        st["windows"] += 1
+        st["kept_rows"] += plan.k_real
+        st["window_rows"] += window_rows
+        st["candidate_rows"] += plan.dom_rows
+        if self.telemetry is not None:
+            self.telemetry.on_prune_dispatch(plan.k_real, plan.dom_rows)
+
+    def _note_prune_escalation(self, handle, reason: str) -> None:
+        st = self.prune_stats
+        st["escalations"] += 1
+        st["reasons"][reason] = st["reasons"].get(reason, 0) + 1
+        if handle.info is not None:
+            handle.info["prune_escalated"] = reason
+        if self.telemetry is not None:
+            self.telemetry.on_prune_escalation(reason)
+            self.telemetry.on_pipeline_event("prune-escalation")
+        # The carry embodies the pruned (now-discarded) placements: every
+        # window dispatched on it re-solves from its exact host
+        # reconstruction, and the next build full-uploads host truth.
+        p = self._pipe
+        if p is not None:
+            if handle in p["unfetched"]:
+                p["unfetched"].remove(handle)
+            for h in p["unfetched"]:
+                h.use_fallback = True
+                h.fallback_reason = "prune-escalation"
+            self._pipe = None
+
+    def _prior_placement_rows(self, handle) -> np.ndarray:
+        """Global rows any still-relevant prior window placed on — the
+        certificate's excluded-row-integrity input. A prior with unknown
+        placements (failed fetch) poisons certifiability outright, which
+        the caller maps to an escalation."""
+        rows: list[np.ndarray] = []
+        for prior in handle.priors:
+            if prior.placements is None:
+                return None
+            rows.append(np.flatnonzero(prior.placements.any(axis=1)))
+        if not rows:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(rows))
+
     def device_health(self) -> dict:
         """{slots, healthy, quarantined: [labels]} — /debug/state and the
         readiness probe's degraded view."""
@@ -1091,6 +1303,8 @@ class PlacementSolver:
         *,
         full_node_list: bool = False,
         topo_version: Optional[int] = None,
+        roster_rows: "np.ndarray | None" = None,
+        dirty_hint: "tuple | None" = None,
     ):
         """`usage` / `overhead` are either {node: Resources} maps (the
         reference's shape) or dense int64 [cap, 3] arrays indexed by this
@@ -1104,11 +1318,21 @@ class PlacementSolver:
         listing `nodes` — capture-before-list means a concurrent mutation
         makes the version look stale (extra walk, safe) and never fresh
         (skipped walk over unsynced state, unsafe). Both together enable
-        skipping the O(nodes) sync walk and memoizing the request mask."""
+        skipping the O(nodes) sync walk and memoizing the request mask.
+
+        `roster_rows` / `dirty_hint` are the HostFeatureStore's cold-path
+        accelerators (FeatureSnapshot fields): the registry row of each
+        node (the request mask becomes one scatter instead of an O(nodes)
+        name->index walk), and the changed Node objects since
+        `dirty_hint[0]` (an update-only node event upserts O(changed)
+        arena rows instead of the O(nodes) identity walk). Both optional
+        and verified before use — a mismatched hint falls back to the
+        full walk."""
         if self._arena is not None:
             return self._build_tensors_native(
                 list(nodes), usage, overhead,
                 full_node_list=full_node_list, topo_version=topo_version,
+                roster_rows=roster_rows, dirty_hint=dirty_hint,
             )
         for n in nodes:
             self.registry.intern(n.name)
@@ -1129,6 +1353,8 @@ class PlacementSolver:
         usage,
         overhead,
         topo_version: Optional[int] = None,
+        roster_rows=None,
+        dirty_hint=None,
     ) -> ClusterTensors:
         """Device-resident cluster state with delta updates (VERDICT r2 #3).
 
@@ -1149,6 +1375,7 @@ class PlacementSolver:
         host = self.build_tensors(
             nodes, usage, overhead,
             full_node_list=True, topo_version=topo_version,
+            roster_rows=roster_rows, dirty_hint=dirty_hint,
         )
         stats = self.device_state_stats
         dev = self._dev
@@ -1267,6 +1494,8 @@ class PlacementSolver:
         overhead,
         topo_version: Optional[int] = None,
         statics_version: Optional[int] = None,
+        roster_rows=None,
+        dirty_hint=None,
     ) -> ClusterTensors:
         """Device-resident availability threaded ACROSS serving windows.
 
@@ -1297,6 +1526,7 @@ class PlacementSolver:
         host = self.build_tensors(
             nodes, usage, overhead,
             full_node_list=True, topo_version=topo_version,
+            roster_rows=roster_rows, dirty_hint=dirty_hint,
         )
         stats = self.device_state_stats
         p = self._pipe
@@ -1376,8 +1606,11 @@ class PlacementSolver:
         stats["full_uploads"] += 1
         self.last_state_upload = "full"
         # Statics may have changed with this full upload: pool replicas
-        # re-upload on their next turn.
+        # re-upload on their next turn, and the prefilter's rank index
+        # rebuilds (name ranks / roster may have moved under it).
         self._static_epoch += 1
+        if self._rank_index is not None:
+            self._rank_index.invalidate()
         if self.telemetry is not None:
             self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
         self._pipe = {
@@ -1425,6 +1658,8 @@ class PlacementSolver:
         *,
         full_node_list: bool = False,
         topo_version: Optional[int] = None,
+        roster_rows: "np.ndarray | None" = None,
+        dirty_hint: "tuple | None" = None,
     ) -> ClusterTensors:
         """Arena-backed ClusterTensors. Deviation from the Python builder,
         deliberate: name ranks are GLOBAL over all known nodes rather than
@@ -1442,34 +1677,54 @@ class PlacementSolver:
         # no node was created/updated/deleted since the FULL-list build that
         # recorded it, so the walk would upsert nothing.
         topo = topo_version
+
+        def _upsert(node) -> None:
+            seen[node.name] = node
+            idx = self.registry.intern(node.name)
+            arena.upsert(
+                idx,
+                node.allocatable.as_array(),
+                self.registry.zone_id(node.zone),
+                node.unschedulable,
+                node.ready,
+                self._label_rank(node, self._driver_label_priority),
+                self._label_rank(node, self._executor_label_priority),
+            )
+
         if not (topo is not None and topo == self._topo_seen):
-            changed_names = False
-            for node in nodes:
-                if seen.get(node.name) is node:
-                    continue
-                if node.name not in seen:
-                    changed_names = True
-                seen[node.name] = node
-                idx = self.registry.intern(node.name)
-                arena.upsert(
-                    idx,
-                    node.allocatable.as_array(),
-                    self.registry.zone_id(node.zone),
-                    node.unschedulable,
-                    node.ready,
-                    self._label_rank(node, self._driver_label_priority),
-                    self._label_rank(node, self._executor_label_priority),
-                )
-            if changed_names or self._rank_epoch < 0:
-                ordered = sorted(seen)
-                arena.set_name_ranks(
-                    [self.registry.index_of(name) for name in ordered]
-                )
-                self._rank_epoch += 1
-            if full_node_list and topo is not None:
-                # Only a full-list walk proves the arena is synced for this
-                # version; a filtered subset must not suppress future walks.
+            if (
+                dirty_hint is not None
+                and full_node_list
+                and topo is not None
+                and dirty_hint[0] == self._topo_seen
+                and all(n.name in seen for n in dirty_hint[1])
+            ):
+                # Update-only node event with a verified version chain
+                # (the feature store captured exactly what changed since
+                # the version this arena last synced to): upsert just the
+                # changed rows. Known names only, so name ranks stand.
+                for node in dirty_hint[1]:
+                    _upsert(node)
                 self._topo_seen = topo
+            else:
+                changed_names = False
+                for node in nodes:
+                    if seen.get(node.name) is node:
+                        continue
+                    if node.name not in seen:
+                        changed_names = True
+                    _upsert(node)
+                if changed_names or self._rank_epoch < 0:
+                    ordered = sorted(seen)
+                    arena.set_name_ranks(
+                        [self.registry.index_of(name) for name in ordered]
+                    )
+                    self._rank_epoch += 1
+                if full_node_list and topo is not None:
+                    # Only a full-list walk proves the arena is synced for
+                    # this version; a filtered subset must not suppress
+                    # future walks.
+                    self._topo_seen = topo
         pad = _bucket(self.registry.capacity, 8)
 
         usage_t = self._dense_or_scatter(usage, pad)
@@ -1494,8 +1749,15 @@ class PlacementSolver:
             request_mask = cached[1]
         else:
             request_mask = np.zeros(pad, dtype=bool)
-            idxs = [self.registry.index_of(n.name) for n in nodes]
-            request_mask[[i for i in idxs if i is not None and i < pad]] = True
+            if roster_rows is not None and len(roster_rows) == len(nodes):
+                # Feature-store rows for exactly this node list: the mask
+                # is one scatter, not an O(nodes) name->index walk.
+                request_mask[roster_rows[roster_rows < pad]] = True
+            else:
+                idxs = [self.registry.index_of(n.name) for n in nodes]
+                request_mask[
+                    [i for i in idxs if i is not None and i < pad]
+                ] = True
             if cacheable:
                 self._topo_request_mask = (
                     (topo, pad, len(nodes)), request_mask,
@@ -1811,6 +2073,24 @@ class PlacementSolver:
                 cand_per_req=cand_per_req, dom_per_req=dom_per_req,
                 dom_keys=dom_keys, req_row_ranges=req_row_ranges,
             )
+        if pipelined and self._prune_eligible(strategy):
+            # Two-tier solve (core/prune.py): gather the prefilter's top-K
+            # candidate rows out of the resident carry and solve a [K,3]
+            # sub-cluster instead of [N,3]; decisions are certified at
+            # fetch and escalate to the exact host re-solve on failure.
+            dom_shared = self._shared_prune_domain(
+                requests, dom_keys, dom_per_req
+            )
+            if dom_shared is not None:
+                handle = self._dispatch_pruned(
+                    strategy, requests, host=host, p=p, n=n,
+                    drv_arr=drv_arr, exc_arr=exc_arr, counts=counts,
+                    skip_arr=skip_arr, emax=emax, cand_rows=cand_rows,
+                    commit=commit, reset=reset, dom_shared=dom_shared,
+                    cand_per_req=cand_per_req,
+                )
+                if handle is not None:
+                    return handle
         from spark_scheduler_tpu.tracing import tracer
 
         # Route the segmented window to the Pallas path when the backend
@@ -1928,11 +2208,20 @@ class PlacementSolver:
                 path, nodes=n, rows=b, row_bucket=row_bucket,
                 segment_bucket=seg_bucket,
             )
-            tel.on_transfer(
-                "h2d",
-                drv_arr.nbytes + exc_arr.nbytes + counts.nbytes
-                + skip_arr.nbytes,
-            )
+            if use_pallas:
+                nbytes = (
+                    drv_arr.nbytes + exc_arr.nbytes + counts.nbytes
+                    + skip_arr.nbytes
+                )
+            else:
+                # What the XLA window dispatch actually ships: the app
+                # batch INCLUDING its [B, N] candidate/domain masks — at
+                # 100k nodes the masks dominate the per-window h2d (the
+                # O(N) blob the pruned path shrinks to [B, K]).
+                nbytes = sum(
+                    getattr(f, "nbytes", 0) for f in apps
+                )
+            tel.on_transfer("h2d", nbytes)
         priors: tuple = ()
         if pipelined:
             priors = tuple(p["unfetched"])
@@ -2023,7 +2312,10 @@ class PlacementSolver:
         )
         handle.placements = placements
         d = self.degraded
-        if d is not None:
+        if d is not None and handle.fallback_reason is None:
+            # Prune-escalation re-solves are correctness machinery, not
+            # degraded-mode serving — they must not flip the degraded
+            # controller's decision gauges.
             d.on_fallback_decision(len(decisions))
         p = self._pipe
         if p is not None and handle in p["unfetched"]:
@@ -2092,6 +2384,216 @@ class PlacementSolver:
             views.append(FusedWindowView(owner, lo, hi, i, k))
             lo = hi
         return views
+
+    def _dispatch_pruned(
+        self, strategy, requests, *, host, p, n, drv_arr, exc_arr, counts,
+        skip_arr, emax, cand_rows, commit, reset, dom_shared, cand_per_req,
+    ) -> "WindowHandle | None":
+        """Tier-1 dispatch of the two-tier solve (single-device pipelined
+        path): the prefilter's kept rows gather out of the resident device
+        carry (a device-side [K] gather — the [N,3] base never moves), the
+        statics gather host-side into a small fresh upload, the app batch
+        ships [B,K] masks instead of [B,N], and the solve's availability
+        DELTA scatters back into the carry additively (padding rows add
+        zero). Returns None when the planner declines — the caller falls
+        through to the full-tensor paths."""
+        from spark_scheduler_tpu.tracing import tracer
+
+        plan = self._plan_prune(
+            host, dom_shared, cand_per_req, drv_arr, exc_arr, counts
+        )
+        if plan is None:
+            return None
+        b = len(drv_arr)
+        tel = self.telemetry
+        compiles_before = tel.compile_count() if tel is not None else None
+        keep = plan.keep
+        statics_np = _gather_statics_host(host, keep, plan.k_real)
+        cand_sub = np.stack([c[keep] for c in cand_rows])
+        dom_sub = np.broadcast_to(
+            np.asarray(dom_shared)[keep], (b, len(keep))
+        )
+        try:
+            with tracer().span(
+                "solve-dispatch", strategy=strategy, nodes=n,
+                window_requests=len(requests), window_rows=b, batched=True,
+                path="xla-pruned",
+            ):
+                _shim("h2d")
+                idx_dev = jnp.asarray(keep)
+                sub_avail = _take_rows(p["avail"], idx_dev)
+                statics_dev = tuple(
+                    jax.device_put(f) for f in statics_np
+                )
+                zone_base_dev = tuple(
+                    jnp.asarray(a) for a in plan.zone_base
+                )
+                apps = make_app_batch(
+                    drv_arr, exc_arr, counts, skippable=skip_arr,
+                    pad_to=_bucket(b, 32),
+                    driver_cand=cand_sub,
+                    domain=dom_sub,
+                    commit=commit, reset=reset,
+                )
+                blob, delta = _window_blob_pruned(
+                    sub_avail, statics_dev, apps,
+                    zone_base_dev, fill=strategy, emax=emax,
+                    num_zones=self._num_zones_bucket(),
+                )
+                p["avail"] = _add_rows_donated(p["avail"], idx_dev, delta)
+        except Exception as exc:
+            if not classify_slot_failure(exc):
+                raise
+            # Same contract as the full-tensor dispatch: the carry may be
+            # half-mutated — drop the pipeline and serve per the degraded
+            # policy.
+            priors = tuple(p["unfetched"])
+            self._pipe = None
+            if tel is not None:
+                tel.on_pipeline_event("device-failure")
+            self._degraded_or_raise(exc)
+            return self._make_fallback_handle(
+                strategy, requests, host, n, priors
+            )
+
+        self.window_path_counts["xla-pruned"] = (
+            self.window_path_counts.get("xla-pruned", 0) + 1
+        )
+        row_bucket = _bucket(b, 32)
+        info = {
+            "path": "xla-pruned",
+            "nodes": n,
+            "rows": b,
+            "row_bucket": row_bucket,
+            "emax": emax,
+            "state_upload": self.last_state_upload,
+            "compile_cache_hit": (
+                tel.compile_count() == compiles_before
+                if tel is not None
+                else None
+            ),
+            "dispatch_id": next(self._dispatch_seq),
+            "fused_k": 1,
+            "pruned": True,
+            "kept_rows": plan.k_real,
+            "candidate_rows": plan.dom_rows,
+        }
+        self.last_solve_info = info
+        self._note_prune_dispatch(plan, b)
+        if tel is not None:
+            tel.on_window_dispatch(
+                "xla-pruned", nodes=n, rows=b, row_bucket=row_bucket,
+            )
+            # What the pruned dispatch actually ships: gathered statics +
+            # app arrays + [B,K] masks + the zone offsets — the O(N) blob
+            # (and the [B,N] masks) never leave the host.
+            tel.on_transfer(
+                "h2d",
+                sum(f.nbytes for f in statics_np)
+                + drv_arr.nbytes + exc_arr.nbytes + counts.nbytes
+                + skip_arr.nbytes + cand_sub.nbytes + dom_sub.nbytes
+                + sum(a.nbytes for a in plan.zone_base)
+                + keep.nbytes,
+            )
+        handle = WindowHandle(
+            strategy=strategy,
+            blob=blob,
+            requests=tuple(requests),
+            flat_rows=[],
+            host_avail=np.array(
+                np.asarray(host.available), dtype=np.int64
+            ),
+            host_schedulable=np.asarray(host.schedulable),
+            priors=tuple(p["unfetched"]),
+            n=n,
+        )
+        handle.row_driver_req = drv_arr.astype(np.int64)
+        handle.row_exec_req = exc_arr.astype(np.int64)
+        handle.row_skippable = skip_arr
+        handle.host_tensors = host
+        handle.prune = plan
+        handle.info = info
+        handle.dispatch_id = info["dispatch_id"]
+        handle.dispatched_at = self._clock()
+        p["unfetched"].append(handle)
+        handle.blob_future = _shared_fetch_pool().submit(
+            _shimmed_device_get, blob
+        )
+        self._track(handle.blob_future)
+        return handle
+
+    def _fetch_pruned(self, handle: "WindowHandle", blob) -> "list[WindowDecision]":
+        """Tier 2 of the two-tier solve: map the fetched blob's sub-cluster
+        indices back to global rows, run the soundness certificate against
+        the exact host reconstruction, and either apply the decisions (the
+        normal path) or escalate the window to the exact host re-solve."""
+        from spark_scheduler_tpu.core.prune import certify_window
+
+        plan = handle.prune
+        blob = np.asarray(blob)
+        gmap = plan.keep.astype(np.int64)
+        drivers_l = blob[:, 0].astype(np.int64)
+        admitted = blob[:, 1].astype(bool)
+        packed = blob[:, 2].astype(bool)
+        execs_l = blob[:, 3:].astype(np.int64)
+        drivers = np.where(
+            drivers_l >= 0, gmap[np.clip(drivers_l, 0, None)], -1
+        )
+        execs = np.where(execs_l >= 0, gmap[np.clip(execs_l, 0, None)], -1)
+        base = handle.host_avail.copy()
+        for prior in handle.priors:
+            if prior.placements is not None:
+                base -= prior.placements
+        prior_rows = self._prior_placement_rows(handle)
+        if prior_rows is None:
+            ok, reason = False, "prior-unknown"
+        else:
+            ok, reason = certify_window(
+                plan,
+                strategy=handle.strategy,
+                requests=handle.requests,
+                drivers=drivers,
+                admitted=admitted,
+                packed=packed,
+                execs=execs,
+                drv64=handle.row_driver_req,
+                exc64=handle.row_exec_req,
+                base=base,
+                host=handle.host_tensors,
+                prior_rows=prior_rows,
+            )
+        if not ok:
+            return self._escalate_pruned(handle, base, reason)
+        placements = np.zeros_like(base)
+        decisions = self._reconstruct_requests(
+            handle.requests, drivers, admitted, packed, execs,
+            handle.row_driver_req, handle.row_exec_req,
+            handle.row_skippable, base, placements,
+            handle.host_schedulable,
+        )
+        handle.placements = placements
+        p = self._pipe
+        if p is not None and handle in p["unfetched"]:
+            p["unfetched"].remove(handle)
+            p["mirror"] -= placements
+        self._note_dispatch_complete(handle)
+        self._device_recovered()
+        return decisions
+
+    def _escalate_pruned(self, handle, base, reason) -> "list[WindowDecision]":
+        """Failed certificate: re-solve the whole window host-side via the
+        greedy oracle (slot-for-slot the kernels' semantics — pinned by
+        the golden parity suite), so the escalated decisions equal the
+        full-tensor device solve's byte for byte. The poisoned carry and
+        every window dispatched on it are invalidated by
+        _note_prune_escalation."""
+        decisions, placements = self.fallback.window_decisions(
+            handle.strategy, handle.host_tensors, base, handle.requests
+        )
+        handle.placements = placements
+        self._note_prune_escalation(handle, reason)
+        self._note_dispatch_complete(handle)
+        return decisions
 
     def _dispatch_pooled(
         self, strategy, tensors, requests, *, host, drv_arr, exc_arr,
@@ -2175,12 +2677,43 @@ class PlacementSolver:
         request_device: list = [None] * len(requests)
         parts: list[_WindowPart] = []
 
+        # Candidate pruning on the pooled engine: each partition (or the
+        # whole window when it does not partition, provided its requests
+        # share one domain) prunes its own gather to the prefilter's top-K
+        # rows — the sub-cluster solve machinery is identical, only the
+        # index set shrinks and the committed rows scatter back as deltas.
+        try_prune = self._prune_eligible(strategy)
+        shared_dom = (
+            self._shared_prune_domain(requests, dom_keys, dom_per_req)
+            if try_prune
+            else None
+        )
+
         def submit_part(slot, req_ids, idx_key, idx):
             row_sel = np.concatenate(
                 [np.arange(*req_row_ranges[r]) for r in req_ids]
             )
             drv_g, exc_g = drv_arr[row_sel], exc_arr[row_sel]
             cnt_g, skip_g = counts[row_sel], skip_arr[row_sel]
+            prune_plan = None
+            if try_prune:
+                part_dom = (
+                    dom_per_req[req_ids[0]] if idx is not None
+                    else shared_dom
+                )
+                if part_dom is not None:
+                    prune_plan = self._plan_prune(
+                        host, part_dom,
+                        [cand_per_req[r] for r in req_ids],
+                        drv_g, exc_g, cnt_g,
+                    )
+                if prune_plan is not None:
+                    # The pruned gather REPLACES the domain gather: padded
+                    # keep rows, no sub-replica caching (the keep set
+                    # changes with every window's availability).
+                    idx = prune_plan.keep
+                    idx_key = None
+                    self._note_prune_dispatch(prune_plan, len(row_sel))
             commit_g: list[bool] = []
             reset_g: list[bool] = []
             cand_g: list[np.ndarray] = []
@@ -2213,6 +2746,21 @@ class PlacementSolver:
             if idx is None:
                 statics = slot.resident_statics(host, epoch, self._clock, tel)
                 sub_avail = slot.place_avail(base)
+            elif prune_plan is not None:
+                # Fresh per-window upload of the small gathered statics:
+                # the keep set tracks availability, so the sub-replica
+                # cache could never hit (and a key-less hit would serve a
+                # different window's rows).
+                statics_np = _gather_statics_host(
+                    host, idx, prune_plan.k_real
+                )
+                statics = tuple(slot._put(f) for f in statics_np)
+                if tel is not None:
+                    tel.on_device_upload(
+                        slot.label, "full",
+                        sum(f.nbytes for f in statics_np),
+                    )
+                sub_avail = slot.place_avail(_take_rows(base, jnp.asarray(idx)))
             else:
                 statics = slot.sub_replica(
                     host, idx_key, idx, epoch, self._clock, tel
@@ -2221,7 +2769,22 @@ class PlacementSolver:
             apps = slot.place_apps(apps)
             # Donate the sub-base on plain devices: a gathered copy (or a
             # base the combine will replace) that nothing else reads.
-            fn = _window_blob_statics if slot.is_mesh else _window_blob_donated
+            if prune_plan is not None:
+                zone_base_dev = tuple(
+                    slot._put(a) for a in prune_plan.zone_base
+                )
+
+                def fn(avail_, statics_, apps_, *, fill, emax, num_zones,
+                       _zb=zone_base_dev):
+                    return _window_blob_pruned(
+                        avail_, statics_, apps_, _zb,
+                        fill=fill, emax=emax, num_zones=num_zones,
+                    )
+            else:
+                fn = (
+                    _window_blob_statics if slot.is_mesh
+                    else _window_blob_donated
+                )
             slot.inflight += 1
             if tel is not None:
                 tel.on_device_inflight(slot.label, slot.inflight)
@@ -2280,7 +2843,7 @@ class PlacementSolver:
                 row_drv=drv_g.astype(np.int64),
                 row_exc=exc_g.astype(np.int64),
                 row_skip=skip_g, idx=idx, slot=slot, rows=b_g,
-                idx_key=idx_key, apps=apps_host,
+                idx_key=idx_key, apps=apps_host, prune=prune_plan,
             )
 
         try:
@@ -2298,9 +2861,23 @@ class PlacementSolver:
                         )
                     )
                     head = parts[0]
-                    p["avail"] = _PendingBase(
-                        lambda: head.after_future.result()
-                    )
+                    if head.prune is not None:
+                        # Pruned whole-window solve: the part returns the
+                        # kept rows' availability DELTA — fold it into the
+                        # (donated) global base instead of replacing it.
+                        p["avail"] = _PendingBase(
+                            lambda: _add_rows_donated(
+                                base,
+                                jnp.asarray(head.idx),
+                                jax.device_put(
+                                    head.after_future.result(), base_device
+                                ),
+                            )
+                        )
+                    else:
+                        p["avail"] = _PendingBase(
+                            lambda: head.after_future.result()
+                        )
                 else:
                     for key, req_ids in plan:
                         idx = np.flatnonzero(
@@ -2315,15 +2892,22 @@ class PlacementSolver:
                         # into the global base (disjoint rows; the base is
                         # DONATED through the chain — in-place double-buffer).
                         # Waits only on the solves (after_future), never on
-                        # the decision-blob transfers.
+                        # the decision-blob transfers. Pruned partitions
+                        # return DELTAS over padded keep rows — those fold
+                        # in additively (padding adds zero).
                         out = base
                         for part in parts:
                             rows = jax.device_put(
                                 part.after_future.result(), base_device
                             )
-                            out = _scatter_rows_exact_donated(
-                                out, jnp.asarray(part.idx), rows
-                            )
+                            if part.prune is not None:
+                                out = _add_rows_donated(
+                                    out, jnp.asarray(part.idx), rows
+                                )
+                            else:
+                                out = _scatter_rows_exact_donated(
+                                    out, jnp.asarray(part.idx), rows
+                                )
                         return out
 
                     p["avail"] = _PendingBase(combine)
@@ -2465,6 +3049,8 @@ class PlacementSolver:
                 raise
         if self.telemetry is not None:
             self.telemetry.on_transfer("d2h", getattr(blob, "nbytes", 0))
+        if handle.prune is not None:
+            return self._fetch_pruned(handle, blob)
         if handle.seg_map is not None:
             # Pallas window path: the device blob is [S, R, 3+emax];
             # flatten the real rows back into flat-row order host-side.
@@ -2624,6 +3210,48 @@ class PlacementSolver:
                     execs = np.where(
                         execs >= 0, gmap[np.clip(execs, 0, None)], -1
                     )
+                if part.prune is not None:
+                    # Two-tier certificate, per partition. Partitions are
+                    # domain-disjoint, so `base` at this point still holds
+                    # THIS part's domain rows at their dispatch values —
+                    # earlier parts only touched their own domains.
+                    from spark_scheduler_tpu.core.prune import (
+                        certify_window,
+                    )
+
+                    prior_rows = self._prior_placement_rows(handle)
+                    if prior_rows is None:
+                        cert_ok, reason = False, "prior-unknown"
+                    else:
+                        cert_ok, reason = certify_window(
+                            part.prune,
+                            strategy=handle.strategy,
+                            requests=part.requests,
+                            drivers=drivers,
+                            admitted=admitted,
+                            packed=packed,
+                            execs=execs,
+                            drv64=part.row_drv,
+                            exc64=part.row_exc,
+                            base=base,
+                            host=handle.host_tensors,
+                            prior_rows=prior_rows,
+                        )
+                    if not cert_ok:
+                        # Escalate just this partition: re-solve it on the
+                        # exact host reconstruction (other partitions are
+                        # row-disjoint and stand), then invalidate the
+                        # poisoned carry and the windows dispatched on it.
+                        decs, ppl = self.fallback.window_decisions(
+                            handle.strategy, handle.host_tensors, base,
+                            part.requests,
+                        )
+                        base -= ppl
+                        placements += ppl
+                        for rid, d in zip(part.req_ids, decs):
+                            results[rid] = d
+                        self._note_prune_escalation(handle, reason)
+                        continue
                 decisions = self._reconstruct_requests(
                     part.requests, drivers, admitted, packed, execs,
                     part.row_drv, part.row_exc, part.row_skip,
@@ -2688,6 +3316,18 @@ class PlacementSolver:
                         host, epoch, self._clock, self.telemetry
                     )
                     avail_rows = base
+                elif part.prune is not None:
+                    # Pruned partition: fresh gathered statics on the
+                    # survivor (the keep set is per-window, never cached);
+                    # the gathered base rows equal what the dead slot's
+                    # device gather embodied.
+                    statics = tuple(
+                        slot._put(f)
+                        for f in _gather_statics_host(
+                            host, part.idx, part.prune.k_real
+                        )
+                    )
+                    avail_rows = base[part.idx]
                 else:
                     statics = slot.sub_replica(
                         host, part.idx_key, part.idx, epoch, self._clock,
@@ -2698,10 +3338,22 @@ class PlacementSolver:
                     np.asarray(avail_rows, dtype=np.int32)
                 )
                 apps = slot.place_apps(part.apps)
-                fn = (
-                    _window_blob_statics if slot.is_mesh
-                    else _window_blob_donated
-                )
+                if part.prune is not None:
+                    zone_base_dev = tuple(
+                        slot._put(a) for a in part.prune.zone_base
+                    )
+
+                    def fn(avail_, statics_, apps_, *, fill, emax,
+                           num_zones, _zb=zone_base_dev):
+                        return _window_blob_pruned(
+                            avail_, statics_, apps_, _zb,
+                            fill=fill, emax=emax, num_zones=num_zones,
+                        )
+                else:
+                    fn = (
+                        _window_blob_statics if slot.is_mesh
+                        else _window_blob_donated
+                    )
                 _shim("dispatch")
                 blob, _after = fn(
                     sub_avail, statics, apps,

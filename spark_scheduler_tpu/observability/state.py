@@ -131,4 +131,13 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
         if degraded is not None:
             faults["degraded"] = degraded.snapshot()
         out["faults"] = faults
+        prune = getattr(solver, "prune_stats", None)
+        if prune is not None and prune.get("windows"):
+            # Two-tier solve: pruned-window volume, kept-row ratio, and
+            # the certificate-escalation ledger by reason — the evidence
+            # that pruning is both engaged and sound, live. Deep-copy the
+            # nested reasons ledger: sharing the live dict with the solve
+            # thread would let a concurrent escalation resize it under
+            # this snapshot's JSON serialization.
+            out["prune"] = {**prune, "reasons": dict(prune["reasons"])}
     return out

@@ -63,6 +63,10 @@ BREAKER_STATE_VALUES = {"closed": 0, "half-open": 1, "open": 2}
 # busy the dispatch surface (pool slots / in-flight pipeline) was when a
 # new dispatch launched — the upload/solve/fetch overlap actually
 # engaging.
+PRUNE_WINDOWS = "foundry.spark.scheduler.solver.prune.windows"
+PRUNE_ESCALATIONS = "foundry.spark.scheduler.solver.prune.escalations"
+PRUNE_KEPT_ROWS = "foundry.spark.scheduler.solver.prune.kept.rows"
+PRUNE_KEPT_RATIO = "foundry.spark.scheduler.solver.prune.kept.ratio"
 DISPATCH_FUSED_K = "foundry.spark.scheduler.solver.dispatch.fused.k"
 DISPATCH_AMORTIZED_RTT_MS = (
     "foundry.spark.scheduler.solver.dispatch.amortized.rtt.ms"
@@ -274,6 +278,25 @@ class SolverTelemetry:
 
     def on_degraded(self, active: bool) -> None:
         self.registry.gauge(FAULTS_DEGRADED_ACTIVE).set(1 if active else 0)
+
+    # -- candidate pruning (the two-tier solve) ------------------------------
+
+    def on_prune_dispatch(self, kept_rows: int, candidate_rows: int) -> None:
+        """One window (or pooled partition) served over a pruned top-K
+        gather: how many rows the device actually solved vs the domain's
+        full candidate count."""
+        self.registry.counter(PRUNE_WINDOWS).inc()
+        self.registry.histogram(PRUNE_KEPT_ROWS).update(kept_rows)
+        if candidate_rows > 0:
+            self.registry.histogram(PRUNE_KEPT_RATIO).update(
+                round(kept_rows / candidate_rows, 4)
+            )
+
+    def on_prune_escalation(self, reason: str) -> None:
+        """A failed soundness certificate: the window re-solved on the
+        exact full path. Labeled by the first failed test so a hot
+        escalation reason is visible."""
+        self.registry.counter(PRUNE_ESCALATIONS, reason=reason).inc()
 
     # -- pipeline ------------------------------------------------------------
 

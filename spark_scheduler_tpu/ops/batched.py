@@ -146,6 +146,7 @@ def batched_fifo_pack(
     emax: int,
     num_zones: int,
     unroll: int = 2,
+    zone_base: tuple | None = None,
 ) -> BatchedPacking:
     """Admit a FIFO queue of gang requests in one compiled program.
 
@@ -159,8 +160,20 @@ def batched_fifo_pack(
     pack + efficiency-scored zone pick (single_az.go:23-97) INSIDE the scan
     step (VERDICT r2 #2), with the zone efficiencies always computed against
     the then-current availability.
+
+    `zone_base` (candidate pruning, core/prune.py): constant excluded-row
+    zone-sum offsets forwarded to every per-segment zone_ranks call, so a
+    gathered top-K sub-cluster ranks zones byte-identically to the full
+    solve. Plain fills only — the single-AZ wrappers additionally score
+    zones by subset-dependent efficiencies, so the pruned path never routes
+    them here with offsets.
     """
     single_az = fill in _SINGLE_AZ_INNER
+    if zone_base is not None and single_az:
+        raise ValueError(
+            "zone_base offsets are only sound for plain fills; "
+            f"got single-AZ strategy {fill!r}"
+        )
     az_fallback = fill == "az-aware-tightly-pack"
     fill_fn = _FILLS[_SINGLE_AZ_INNER.get(fill, fill)]
     include_exec_in_reserved = _SINGLE_AZ_INNER.get(fill) != "minimal-fragmentation"
@@ -193,7 +206,9 @@ def batched_fifo_pack(
     def _fresh_orders(avail, driver_elig, exec_elig, domain):
         """Priority orders from the given availability (the sort at
         resource.go:299)."""
-        zrank = zone_ranks(cluster, domain, num_zones, available=avail)
+        zrank = zone_ranks(
+            cluster, domain, num_zones, available=avail, zone_base=zone_base
+        )
         d_order, _ = priority_order(
             cluster, driver_elig, zrank, cluster.label_rank_driver,
             available=avail,

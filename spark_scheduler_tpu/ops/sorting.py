@@ -29,24 +29,36 @@ def zone_ranks(
     domain_mask: jnp.ndarray,  # [N] bool — nodes in the metadata domain
     num_zones: int,  # static upper bound on zone-id space
     available: jnp.ndarray | None = None,  # [N,3] override (defaults to cluster's)
+    zone_base: tuple | None = None,  # pruned-solve zone-sum offsets (see below)
 ) -> jnp.ndarray:  # [num_zones] i32: rank of each zone (0 = highest priority)
     """Zones ordered ascending by (total available memory, total CPU)
     (nodesorting.go:101-104, 124-134). Zones with no domain nodes rank last.
 
     `available` lets callers rank against a mutated availability (the batched
     FIFO scan threads availability through admissions) without rebuilding the
-    whole ClusterTensors."""
+    whole ClusterTensors.
+
+    `zone_base` is the candidate-pruning contract (core/prune.py): a gathered
+    top-K sub-cluster must still rank zones by the FULL domain's availability
+    sums, so the host ships the pruned-away rows' per-zone sums as a constant
+    (mem_hi, mem_lo, cpu_hi, cpu_lo, present) tuple of [num_zones] arrays —
+    each int64 sum S split into int32 limbs hi = S >> 24, lo = S & 0xFFFFFF
+    (exact for |S| < 2^55, i.e. any 100k-node cluster of int32 rows). The
+    offsets stay constant across the window's scan because a certified pruned
+    solve never places on an excluded row."""
     if available is None:
         available = cluster.available
     mask = domain_mask & cluster.valid
 
-    def _zone_sum_chunks(vals: jnp.ndarray) -> list[jnp.ndarray]:
+    def _zone_sum_chunks(vals: jnp.ndarray, base_hi=None, base_lo=None) -> list[jnp.ndarray]:
         # Exact int32-safe aggregation without x64: split each value into
         # four 8-bit chunks (top chunk keeps the sign via arithmetic shift),
         # segment-sum each, then normalize carries upward. Each low-chunk
         # sum is <= n*255, exact for n < 2^23 nodes; the top-chunk sum is
         # bounded by n*2^7 after the shift. Chunks returned most-significant
-        # first, comparable lexicographically.
+        # first, comparable lexicographically. Excluded-row base offsets add
+        # into the chunks BEFORE carry normalization, so the normal form
+        # (and therefore the rank order) equals the unpruned sums exactly.
         v = jnp.where(mask, vals, 0)
 
         def seg(x):
@@ -56,6 +68,11 @@ def zone_ranks(
         s2 = seg((v >> 16) & 0xFF)
         s1 = seg((v >> 8) & 0xFF)
         s0 = seg(v & 0xFF)
+        if base_hi is not None:
+            s3 = s3 + base_hi
+            s2 = s2 + ((base_lo >> 16) & 0xFF)
+            s1 = s1 + ((base_lo >> 8) & 0xFF)
+            s0 = s0 + (base_lo & 0xFF)
         s1 = s1 + (s0 >> 8)
         s0 = s0 & 0xFF
         s2 = s2 + (s1 >> 8)
@@ -64,9 +81,17 @@ def zone_ranks(
         s2 = s2 & 0xFF
         return [s3, s2, s1, s0]
 
-    mem_k = _zone_sum_chunks(available[:, MEM_DIM])
-    cpu_k = _zone_sum_chunks(available[:, CPU_DIM])
+    if zone_base is not None:
+        mem_hi, mem_lo, cpu_hi, cpu_lo, base_present = zone_base
+        mem_k = _zone_sum_chunks(available[:, MEM_DIM], mem_hi, mem_lo)
+        cpu_k = _zone_sum_chunks(available[:, CPU_DIM], cpu_hi, cpu_lo)
+    else:
+        base_present = None
+        mem_k = _zone_sum_chunks(available[:, MEM_DIM])
+        cpu_k = _zone_sum_chunks(available[:, CPU_DIM])
     present = jnp.zeros(num_zones, jnp.bool_).at[cluster.zone_id].max(mask)
+    if base_present is not None:
+        present = present | base_present
     # Absent zones last; ties between zones are unordered in the reference
     # (map iteration); pin with zone id. lexsort: last key is primary.
     keys = (

@@ -235,6 +235,8 @@ def build_scheduler_app(
         device_pool=config.solver_device_pool,
         mesh=mesh,
         quarantine_probe_s=config.quarantine_probe_s,
+        prune_top_k=config.solver_prune_top_k,
+        prune_slack=config.solver_prune_slack,
     )
     recorder = None
     if config.flight_recorder:

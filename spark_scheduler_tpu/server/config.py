@@ -163,6 +163,18 @@ class InstallConfig:
     solver_device_pool: int = 1
     solver_mesh_groups: Optional[int] = None
     solver_mesh_node_shards: Optional[int] = None
+    # Sound top-K candidate pruning (`solver.prune-top-k` /
+    # `solver.prune-slack`, core/prune.py — the two-tier solve): when
+    # top-k > 0, eligible serving windows solve a gathered top-K
+    # sub-cluster (K per zone = max(top-k, window aggregate demand x
+    # slack)) instead of the full [N,3] tensor, and every pruned decision
+    # is verified by a post-solve certificate — a failed certificate
+    # escalates the window to the exact full re-solve, so decisions stay
+    # byte-identical to the unpruned path by construction
+    # (`foundry.spark.scheduler.solver.prune.*` counts the escalations).
+    # 0 (the default) = off: the classic full-tensor paths byte-for-byte.
+    solver_prune_top_k: int = 0
+    solver_prune_slack: float = 2.0
     # Fused multi-window device dispatch (`solver.fuse-windows`): when the
     # predicate backlog holds more than one window's worth of requests,
     # the batcher claims up to fuse-windows x predicate-max-window of them
@@ -227,6 +239,71 @@ class InstallConfig:
     breaker_failure_threshold: int = 8
     breaker_reset_timeout_s: float = 5.0
 
+    # Module-name markers of DONATED jitted programs (the persistent cache
+    # key string is "<module_name>-<hash>"). Donation is invisible in the
+    # key, so donated entry points carry it in their function names
+    # (core/solver._window_blob_split_donated explains the convention);
+    # batched_fifo_pack_carry is the ops-level donated entry the bench
+    # drives directly.
+    JAX_CACHE_DONATION_MARKERS = ("donated", "batched_fifo_pack_carry")
+
+    @staticmethod
+    def serialize_jax_cache_io() -> bool:
+        """Make the persistent compilation cache safe for this scheduler's
+        concurrent, donation-heavy serving paths. Two measures, installed
+        idempotently at the cache's get/put seam:
+
+        1. DONATION GATE — donated programs never read from or write to
+           the persistent cache. Executables RELOADED from the cache with
+           donated argument buffers intermittently returned WRONG window
+           decisions (spurious failure-fit / shifted placements in
+           otherwise-deterministic runs; reproduced 4/4 on the HA chaos
+           soak whenever the donated window-solve entry was a cache hit,
+           0/3 with cache reads disabled — PR 8 ran
+           hack/ha_shard_bench.py cache-free as the workaround). Donated
+           programs now always compile in-process; the expensive
+           undonated kernels (the Mosaic window/queue programs that
+           motivated the cache) keep full caching.
+
+        2. WRITE/READ SERIALIZATION — one process-wide lock around the
+           cache's executable (de)serialization + file I/O, so two
+           threads can never interleave backend.serialize_executable /
+           deserialize_executable through the cache (compiles themselves
+           still overlap).
+
+        Returns whether the wrappers are installed."""
+        try:
+            from jax._src import compilation_cache as _cc
+        except Exception:
+            return False
+        if getattr(_cc, "_spark_scheduler_cache_lock", None) is not None:
+            return True
+        import threading as _threading
+
+        lock = _threading.Lock()
+        markers = InstallConfig.JAX_CACHE_DONATION_MARKERS
+        _get, _put = _cc.get_executable_and_time, _cc.put_executable_and_time
+
+        def _donation_marked(module_name: str) -> bool:
+            return any(m in module_name for m in markers)
+
+        def get_gated(cache_key, *a, **kw):
+            if _donation_marked(cache_key.rsplit("-", 1)[0]):
+                return None, None  # always a miss: compile in-process
+            with lock:
+                return _get(cache_key, *a, **kw)
+
+        def put_gated(cache_key, module_name, *a, **kw):
+            if _donation_marked(module_name):
+                return None  # never persisted
+            with lock:
+                return _put(cache_key, module_name, *a, **kw)
+
+        _cc.get_executable_and_time = get_gated
+        _cc.put_executable_and_time = put_gated
+        _cc._spark_scheduler_cache_lock = lock
+        return True
+
     @staticmethod
     def enable_jax_compile_cache(cache_dir: str) -> None:
         """Point jax at a persistent compilation cache (shared helper for
@@ -234,6 +311,7 @@ class InstallConfig:
         the knobs."""
         import jax
 
+        InstallConfig.serialize_jax_cache_io()
         try:
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update(
@@ -389,6 +467,12 @@ class InstallConfig:
             ),
             solver_fuse_windows=int(
                 block_key(solver_block, "fuse-windows", 1)
+            ),
+            solver_prune_top_k=int(
+                block_key(solver_block, "prune-top-k", 0)
+            ),
+            solver_prune_slack=float(
+                block_key(solver_block, "prune-slack", 2.0)
             ),
             runtime_config_path=raw.get("runtime-config-path"),
             jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),

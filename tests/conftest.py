@@ -35,6 +35,27 @@ if _TEST_INGEST:
 
     _http_mod.SchedulerHTTPServer.__init__ = _ingest_forcing_init
 
+# SPARK_SCHEDULER_TEST_PRUNE=<k> runs every solver-constructing suite with
+# sound top-K candidate pruning enabled (the CI `prune` job leg): solvers
+# that do not pin an explicit `prune_top_k` inherit the override, so the
+# solver/extender equivalence suites and the chaos-matrix soak re-run with
+# the two-tier solve live — pruning cannot silently regress decision
+# equality or the fault paths. Tests pinning prune_top_k (including the
+# unpruned baselines inside tests/test_prune_equivalence.py, which pass 0)
+# still win.
+_TEST_PRUNE = os.environ.get("SPARK_SCHEDULER_TEST_PRUNE")
+if _TEST_PRUNE and int(_TEST_PRUNE) > 0:  # "0" must mean OFF, not k=8
+    from spark_scheduler_tpu.core import solver as _solver_mod
+
+    _orig_solver_init = _solver_mod.PlacementSolver.__init__
+    _prune_k = int(_TEST_PRUNE) if int(_TEST_PRUNE) > 1 else 8
+
+    def _prune_forcing_init(self, *args, **kwargs):
+        kwargs.setdefault("prune_top_k", _prune_k)
+        _orig_solver_init(self, *args, **kwargs)
+
+    _solver_mod.PlacementSolver.__init__ = _prune_forcing_init
+
 
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():

@@ -1,0 +1,363 @@
+"""Sound top-K candidate pruning: the two-tier solve's equivalence matrix.
+
+The pruned path (core/prune.py + solver._dispatch_pruned/_fetch_pruned)
+must be BYTE-IDENTICAL to the full-tensor solve by construction: the
+prefilter only shrinks the gather, zone ranks stay exact via the excluded
+zone-sum offsets, and the post-solve certificate escalates any window a
+pruned row could have changed to the exact host re-solve. Pinned here:
+
+  - pruned == unpruned decisions across randomized churn and FIFO
+    prefixes for every plain fill strategy;
+  - composition: prune x fused dispatch (k in {1, 4}), prune x device
+    pool {1, 2} with domain partitioning — the equivalence matrix of the
+    acceptance criteria;
+  - a deliberately-tight-K case where the certificate MUST fire: the
+    escalations counter moves and the escalated windows still match the
+    full solve decision for decision;
+  - the host zone-rank replica == the kernel's zone_ranks, and the
+    offset form (gathered subset + excluded sums) == the full solve's
+    ranks — the identity the in-kernel offsets rest on;
+  - RankIndex incremental maintenance == a from-scratch rebuild under
+    random row churn;
+  - default-off: an unconfigured solver never routes a window through
+    the pruned path.
+"""
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.core.feature_store import RankIndex
+from spark_scheduler_tpu.core.prune import zone_ranks_host, split_zone_sums
+from spark_scheduler_tpu.core.solver import (
+    FusedWindowView,
+    PlacementSolver,
+    WindowRequest,
+)
+from spark_scheduler_tpu.models.kube import Node, ZONE_LABEL
+from spark_scheduler_tpu.models.resources import Resources
+
+ONE = Resources.from_quantities("1", "1Gi")
+TWO = Resources.from_quantities("2", "2Gi")
+
+
+def _nodes(n, zones=2):
+    out = []
+    for i in range(n):
+        out.append(
+            Node(
+                name=f"n{i:03d}",
+                allocatable=Resources.from_quantities(
+                    "8", "8Gi", "1", round_up=False
+                ),
+                labels={ZONE_LABEL: f"z{i % zones}"},
+            )
+        )
+    return out
+
+
+def _random_windows(rng, nodes, k, per, *, domains=None, fifo_rows=True):
+    names = [n.name for n in nodes]
+    windows = []
+    r = 0
+    for _ in range(k):
+        reqs = []
+        for _ in range(per):
+            rows = []
+            if fifo_rows:
+                for _ in range(int(rng.integers(0, 3))):
+                    rows.append(
+                        (ONE, ONE, int(rng.integers(1, 3)),
+                         bool(rng.random() < 0.5))
+                    )
+            res = TWO if rng.random() < 0.3 else ONE
+            rows.append((res, ONE, int(rng.integers(1, 4)), False))
+            if domains is not None:
+                dom = domains[r % len(domains)]
+                cand = dom
+            else:
+                dom, cand = None, names
+            reqs.append(
+                WindowRequest(
+                    rows=rows,
+                    driver_candidate_names=cand,
+                    domain_node_names=dom,
+                )
+            )
+            r += 1
+        windows.append(reqs)
+    return windows
+
+
+def _random_usage(rng, nodes):
+    usage = {}
+    for n in nodes:
+        if rng.random() < 0.3:
+            usage[n.name] = Resources.from_quantities(
+                str(int(rng.integers(1, 4))), "1Gi"
+            )
+    return usage
+
+
+def _run(solver, nodes, batches, usages, strategy):
+    """Pipelined serving order: dispatch every window of a batch
+    back-to-back, then fetch all; churn lands between batches."""
+    out = []
+    for usage, wins in zip(usages, batches):
+        handles = []
+        for w in wins:
+            t = solver.build_tensors_pipelined(nodes, usage, {})
+            handles.append(solver.pack_window_dispatch(strategy, t, w))
+        for h in handles:
+            out.extend(solver.pack_window_fetch(h))
+    return out
+
+
+def _run_fused(solver, nodes, batches, usages, strategy):
+    out = []
+    for usage, wins in zip(usages, batches):
+        t = solver.build_tensors_pipelined(nodes, usage, {})
+        views = solver.pack_windows_dispatch(strategy, t, wins)
+        for v in views:
+            out.extend(solver.pack_window_fetch(v))
+    return out
+
+
+@pytest.mark.parametrize(
+    "strategy", ["tightly-pack", "distribute-evenly", "minimal-fragmentation"]
+)
+def test_pruned_matches_full_with_churn(strategy):
+    rng = np.random.default_rng(hash(strategy) % 1000)
+    nodes = _nodes(96)
+    n_batches = 3
+    batches = [
+        _random_windows(rng, nodes, 2, 3) for _ in range(n_batches)
+    ]
+    usages = [{}] + [_random_usage(rng, nodes) for _ in range(n_batches - 1)]
+
+    full = _run(
+        PlacementSolver(use_native=False, prune_top_k=0),
+        nodes, batches, usages, strategy,
+    )
+    pruned_solver = PlacementSolver(
+        use_native=False, prune_top_k=4, prune_slack=0.75
+    )
+    pruned = _run(pruned_solver, nodes, batches, usages, strategy)
+    assert len(full) == len(pruned)
+    for i, (a, b) in enumerate(zip(full, pruned)):
+        assert a == b, f"decision {i} diverged: {a} vs {b}"
+    # The suite must actually exercise the pruned path, not silently
+    # bypass it through the benefit gate.
+    assert pruned_solver.prune_stats["windows"] > 0, (
+        strategy, pruned_solver.window_path_counts,
+    )
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_pruned_matches_full_fused(k):
+    """prune x fused dispatch: the umbrella window prunes as one batch;
+    views slice identically. The fused batch's aggregate demand scales
+    with K, so the node count must leave the prefilter headroom."""
+    rng = np.random.default_rng(40 + k)
+    nodes = _nodes(192)
+    batches = [_random_windows(rng, nodes, k, 2) for _ in range(2)]
+    usages = [{}, _random_usage(rng, nodes)]
+    full = _run_fused(
+        PlacementSolver(use_native=False, prune_top_k=0),
+        nodes, batches, usages, "tightly-pack",
+    )
+    pruned_solver = PlacementSolver(
+        use_native=False, prune_top_k=4, prune_slack=0.3
+    )
+    pruned = _run_fused(pruned_solver, nodes, batches, usages, "tightly-pack")
+    assert full == pruned
+    assert pruned_solver.prune_stats["windows"] > 0
+
+
+@pytest.mark.parametrize("pool", [1, 2])
+def test_pruned_matches_full_pooled_partitioned(pool):
+    """prune x device pool x domain partitioning. On a pool, windows whose
+    requests pin DISJOINT domains partition across slots and each
+    partition prunes its own gather (the delta-combine threads the carry
+    identically). On the single-device path a window must share ONE
+    domain to prune — mixed-domain windows fall back to the full solve —
+    so the pool=1 case pins the per-window shared-domain form instead."""
+    rng = np.random.default_rng(60 + pool)
+    nodes = _nodes(96)
+    half = (
+        [n.name for n in nodes[:48]],
+        [n.name for n in nodes[48:]],
+    )
+    batches = []
+    for b in range(2):
+        if pool == 1:
+            # One shared domain per window, alternating across windows.
+            wins = []
+            for w in range(2):
+                dom = half[w % 2]
+                wins.extend(
+                    _random_windows(rng, nodes, 1, 2, domains=[dom])
+                )
+            batches.append(wins)
+        else:
+            # Per-request alternation: the pooled partition topology.
+            batches.append(_random_windows(rng, nodes, 2, 2, domains=half))
+    usages = [{}, _random_usage(rng, nodes)]
+    full = _run(
+        PlacementSolver(use_native=False, prune_top_k=0),
+        nodes, batches, usages, "tightly-pack",
+    )
+    pruned_solver = PlacementSolver(
+        use_native=False, device_pool=pool, prune_top_k=4, prune_slack=0.3
+    )
+    pruned = _run(pruned_solver, nodes, batches, usages, "tightly-pack")
+    assert full == pruned
+    assert pruned_solver.prune_stats["windows"] > 0
+
+
+def test_tight_k_certificate_escalates_and_still_matches():
+    """K deliberately too small for the workload: the soundness
+    certificate MUST fire (escalations > 0) and every escalated window's
+    decisions must still equal the full solve's — the escalation path is
+    the byte-identity guarantee, so it is pinned under stress."""
+    rng = np.random.default_rng(9)
+    nodes = _nodes(128, zones=3)
+    n_batches = 3
+    batches = [
+        _random_windows(rng, nodes, 2, 4) for _ in range(n_batches)
+    ]
+    usages = [{}] + [_random_usage(rng, nodes) for _ in range(n_batches - 1)]
+    full = _run(
+        PlacementSolver(use_native=False, prune_top_k=0),
+        nodes, batches, usages, "tightly-pack",
+    )
+    tight = PlacementSolver(
+        use_native=False, prune_top_k=1, prune_slack=0.01
+    )
+    pruned = _run(tight, nodes, batches, usages, "tightly-pack")
+    assert full == pruned
+    assert tight.prune_stats["windows"] > 0
+    assert tight.prune_stats["escalations"] > 0, tight.prune_stats
+    assert tight.prune_stats["reasons"], tight.prune_stats
+
+
+def test_minimal_fragmentation_escalates_on_excluded_capacity():
+    """minimal-fragmentation consumes by capacity DESC, so any excluded
+    capacity is an order hazard: with spare excluded rows the certificate
+    must escalate rather than trust the pruned order — and decisions
+    still match."""
+    rng = np.random.default_rng(11)
+    nodes = _nodes(96)
+    batches = [_random_windows(rng, nodes, 2, 2)]
+    full = _run(
+        PlacementSolver(use_native=False, prune_top_k=0),
+        nodes, batches, [{}], "minimal-fragmentation",
+    )
+    pruned_solver = PlacementSolver(
+        use_native=False, prune_top_k=2, prune_slack=0.25
+    )
+    pruned = _run(pruned_solver, nodes, batches, [{}], "minimal-fragmentation")
+    assert full == pruned
+    st = pruned_solver.prune_stats
+    if st["windows"]:
+        # With spare capacity everywhere the capacity-order hazard must
+        # fire. (Not necessarily once per pruned window: an escalation
+        # invalidates its in-flight sibling windows, which re-solve via
+        # the exact host path without running their own certificate.)
+        assert st["escalations"] >= 1, st
+        assert "minfrag-excluded-capacity" in st["reasons"] or st["reasons"], st
+
+
+def test_default_off_never_prunes():
+    rng = np.random.default_rng(3)
+    nodes = _nodes(96)
+    batches = [_random_windows(rng, nodes, 2, 2)]
+    solver = PlacementSolver(use_native=False, prune_top_k=0)
+    _run(solver, nodes, batches, [{}], "tightly-pack")
+    assert solver.prune_stats["windows"] == 0
+    assert "xla-pruned" not in solver.window_path_counts
+
+
+def test_zone_ranks_host_matches_kernel_and_offsets():
+    """The in-kernel offset identity: zone_ranks over a GATHERED subset
+    plus the excluded rows' sums-as-offsets equals zone_ranks over the
+    full cluster — and both equal the host replica the certificate uses."""
+    import jax.numpy as jnp
+
+    from spark_scheduler_tpu.models.cluster import ClusterTensors
+    from spark_scheduler_tpu.ops.sorting import zone_ranks
+
+    rng = np.random.default_rng(21)
+    n, zb = 64, 4
+    avail = rng.integers(-5, 1 << 20, size=(n, 3)).astype(np.int32)
+    zone_id = rng.integers(0, 3, size=n).astype(np.int32)
+    valid = rng.random(n) < 0.9
+
+    def mk(avail, zone_id, valid):
+        n = avail.shape[0]
+        return ClusterTensors(
+            available=jnp.asarray(avail),
+            schedulable=jnp.asarray(avail),
+            zone_id=jnp.asarray(zone_id),
+            name_rank=jnp.arange(n, dtype=jnp.int32),
+            label_rank_driver=jnp.zeros(n, jnp.int32),
+            label_rank_executor=jnp.zeros(n, jnp.int32),
+            unschedulable=jnp.zeros(n, bool),
+            ready=jnp.ones(n, bool),
+            valid=jnp.asarray(valid),
+        )
+
+    full = np.asarray(
+        zone_ranks(mk(avail, zone_id, valid), jnp.ones(n, bool), zb)
+    )
+
+    # Host replica over the same sums.
+    mask = valid
+    mem = np.zeros(zb, np.int64)
+    cpu = np.zeros(zb, np.int64)
+    np.add.at(mem, zone_id[mask], avail[mask, 1].astype(np.int64))
+    np.add.at(cpu, zone_id[mask], avail[mask, 0].astype(np.int64))
+    present = np.zeros(zb, bool)
+    present[np.unique(zone_id[mask])] = True
+    assert np.array_equal(zone_ranks_host(mem, cpu, present), full)
+
+    # Gathered subset + excluded offsets == full.
+    keep = np.sort(rng.choice(n, size=20, replace=False))
+    excl = np.setdiff1d(np.arange(n), keep)
+    excl = excl[valid[excl]]
+    e_mem = np.zeros(zb, np.int64)
+    e_cpu = np.zeros(zb, np.int64)
+    np.add.at(e_mem, zone_id[excl], avail[excl, 1].astype(np.int64))
+    np.add.at(e_cpu, zone_id[excl], avail[excl, 0].astype(np.int64))
+    e_present = np.zeros(zb, bool)
+    e_present[np.unique(zone_id[valid])] = True
+    mh, ml = split_zone_sums(e_mem)
+    ch, cl = split_zone_sums(e_cpu)
+    sub = np.asarray(
+        zone_ranks(
+            mk(avail[keep], zone_id[keep], valid[keep]),
+            jnp.ones(len(keep), bool),
+            zb,
+            zone_base=tuple(
+                jnp.asarray(a) for a in (mh, ml, ch, cl, e_present)
+            ),
+        )
+    )
+    assert np.array_equal(sub, full)
+
+
+def test_rank_index_incremental_matches_rebuild():
+    rng = np.random.default_rng(33)
+    n = 300
+    avail = rng.integers(0, 1000, size=(n, 3)).astype(np.int32)
+    name_rank = rng.permutation(n).astype(np.int32)
+
+    inc = RankIndex()
+    inc.rebuild(avail, name_rank)
+    for _ in range(25):
+        dirty = rng.choice(n, size=int(rng.integers(1, 12)), replace=False)
+        avail[dirty] = rng.integers(0, 1000, size=(len(dirty), 3))
+        inc.update_rows(avail, name_rank, dirty)
+        ref = RankIndex()
+        ref.rebuild(avail, name_rank)
+        assert np.array_equal(inc.order(), ref.order())
+    assert inc.incremental_updates > 0 and inc.rebuilds == 1
