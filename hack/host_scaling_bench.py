@@ -1,0 +1,200 @@
+"""Host-scaling sweep (ISSUE 11, the million-node tier).
+
+One JSON line per tier (default 10k / 100k / 1M, override with
+BENCH_SCALE_TIERS="10000,100000"), measuring the four numbers the tier is
+judged on:
+
+  window_p50_ms        steady-state serving-window service time (extender
+                       dispatch -> decisions, pruned two-tier solve);
+  node_update_ms /     cost of one node event: the event applied through
+  node_add_ms          the backend, then ONE single-request window served
+                       (snapshot patch + O(changed) build + delta upload +
+                       solve) — the end-to-end node-event path;
+  upload_bytes_per_event
+                       h2d bytes per device-state upload during the event
+                       phase (the O(changed) claim as a number);
+  warm_restart_ms      discard the pipeline and re-serve from warm host
+                       caches — the warm-standby promotion analog (caches
+                       hot, device state cold; the HA promotion itself is
+                       measured in PR 8's ha_failover section).
+
+Everything runs in process against the local jax backend: no HTTP hop, no
+tunnel — this is the HOST scaling story. Candidate names ride an
+identity-keyed ticket (the in-process analog of the native ingest lane's
+digest ticket) so the 1M-name candidate list is not re-hashed per request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+class NameTicket(list):
+    """Candidate-name list with O(1) identity hash/eq — the in-process
+    stand-in for server/ingest.NativeNodeNames, so the solver's
+    candidate-mask LRU hits without hashing N strings per request."""
+
+    __hash__ = object.__hash__
+
+    def __eq__(self, other):
+        return self is other
+
+    @property
+    def names_digest(self):
+        return id(self)
+
+
+def _pct(vals, q):
+    return round(float(np.percentile(vals, q)), 3)
+
+
+def run_tier(n_nodes: int, windows: int) -> dict:
+    import dataclasses
+
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+    from spark_scheduler_tpu.server.app import build_scheduler_app
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.store.backend import InMemoryBackend
+    from spark_scheduler_tpu.testing.harness import (
+        INSTANCE_GROUP_LABEL,
+        new_node,
+        static_allocation_spark_pods,
+    )
+
+    backend = InMemoryBackend()
+    t0 = time.perf_counter()
+    for i in range(n_nodes):
+        backend.add_node(new_node(f"s{i:07d}", zone=f"zone{i % 4}"))
+    roster_ingest_s = time.perf_counter() - t0
+    names = NameTicket(f"s{i:07d}" for i in range(n_nodes))
+
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=False,
+            sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+            solver_prune_top_k=64,
+            flight_recorder=False,
+        ),
+    )
+    ext = app.extender
+    ext._last_request = float("inf")
+    seq = iter(range(10_000_000))
+
+    def serve_window(n_req=4, execs=2):
+        args = []
+        for _ in range(n_req):
+            d = static_allocation_spark_pods(f"hs-{next(seq)}", execs)[0]
+            backend.add_pod(d)
+            args.append(ExtenderArgs(pod=d, node_names=names))
+        t0 = time.perf_counter()
+        tok = ext.predicate_window_dispatch(args)
+        res = ext.predicate_window_complete(tok)
+        return (time.perf_counter() - t0) * 1e3, res
+
+    # Boot: cold featurize + first full upload + first (compiling) window.
+    t0 = time.perf_counter()
+    boot_ms_raw, res = serve_window(1)
+    boot_ms = (time.perf_counter() - t0) * 1e3
+    assert res[0].node_names, "boot window failed to place"
+
+    # Steady-state window service (4-request windows), plus a WIDE arm
+    # (16-request windows — the natural fill at fleet-scale traffic):
+    # per-decision cost is the tier's acceptance number, and the wide
+    # windows amortize the per-window host passes exactly as real load
+    # does.
+    lat = [serve_window()[0] for _ in range(windows)]
+    lat_wide = [
+        serve_window(16)[0] for _ in range(max(4, windows // 2))
+    ]
+
+    stats = app.solver.device_state_stats
+
+    def upload_bytes_per_event(before, after):
+        events = sum(
+            after[k] - before[k]
+            for k in ("full_uploads", "delta_uploads", "static_delta_uploads")
+        )
+        if not events:
+            return 0.0
+        return round((after["upload_bytes"] - before["upload_bytes"]) / events, 1)
+
+    # Node events: updates (unschedulable flip on high-index idle nodes)
+    # and adds, each followed by ONE single-request window.
+    upd_lat, add_lat = [], []
+    before_events = dict(stats)
+    for j in range(6):
+        name = f"s{n_nodes - 1 - j:07d}"
+        cur = backend.get_node(name)
+        t0 = time.perf_counter()
+        backend.update(
+            "nodes", dataclasses.replace(cur, unschedulable=not cur.unschedulable)
+        )
+        w_ms, _ = serve_window(1)
+        upd_lat.append((time.perf_counter() - t0) * 1e3)
+    for j in range(6):
+        t0 = time.perf_counter()
+        backend.add_node(new_node(f"late{j:03d}", zone=f"zone{j % 4}"))
+        w_ms, _ = serve_window(1)
+        add_lat.append((time.perf_counter() - t0) * 1e3)
+    after_events = dict(stats)
+
+    fs = ext.features.stats()
+
+    # Warm restart (promotion analog): device state dropped, host caches hot.
+    app.solver.discard_pipeline()
+    t0 = time.perf_counter()
+    serve_window(1)
+    warm_restart_ms = (time.perf_counter() - t0) * 1e3
+
+    out = {
+        "n_nodes": n_nodes,
+        "roster_ingest_s": round(roster_ingest_s, 2),
+        "boot_ms": round(boot_ms, 1),
+        "window_p50_ms": _pct(lat, 50),
+        "window_p95_ms": _pct(lat, 95),
+        "decisions_per_s": round(4 / (_pct(lat, 50) / 1e3), 1),
+        "window16_p50_ms": _pct(lat_wide, 50),
+        "per_decision_ms": round(_pct(lat_wide, 50) / 16, 3),
+        "node_update_ms_p50": _pct(upd_lat, 50),
+        "node_add_ms_p50": _pct(add_lat, 50),
+        "upload_bytes_per_event": upload_bytes_per_event(
+            before_events, after_events
+        ),
+        "warm_restart_ms": round(warm_restart_ms, 1),
+        "roster_rebuilds_after_boot": fs["roster_rebuilds"] - 1,
+        "roster_add_patches": fs["roster_add_patches"],
+        "device_state": dict(stats),
+        "prune": dict(app.solver.prune_stats, reasons=dict(
+            app.solver.prune_stats["reasons"])),
+        "native_arena": app.solver.uses_native_arena,
+    }
+    app.stop()
+    return out
+
+
+def main():
+    tiers = [
+        int(x)
+        for x in os.environ.get(
+            "BENCH_SCALE_TIERS", "10000,100000,1000000"
+        ).split(",")
+    ]
+    windows = int(os.environ.get("BENCH_SCALE_WINDOWS", "12"))
+    for n in tiers:
+        out = run_tier(n, windows)
+        print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Scale smoke (ISSUE 11 satellite, the `scale-smoke` CI leg).
+
+Boots the scheduler server at a synthetic SCALE_SMOKE_NODES-node roster
+(default 1,000,000 — statics only, no predicate traffic), serves exactly
+one warm-up window to force the cold featurize + full upload, then applies
+a handful of node events and asserts the O(changed) invariants as
+COUNTERS, not timings (no hot-loop timing flakiness):
+
+  - zero full roster rebuilds across the event phase (adds ride the
+    append patch, updates the patch path);
+  - per-event state-upload bytes under a fixed ceiling (64 KiB — a full
+    1M-node upload is ~40 MB, so an accidental O(N) regression misses the
+    ceiling by three orders of magnitude);
+  - boot (roster ingest + cold featurize + first served window) under a
+    wall-clock budget (SCALE_SMOKE_BUDGET_S, default 600 — generous: the
+    budget catches quadratic boot regressions, not jitter).
+
+Exit code 0 = pass; assertion failure names the broken invariant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_NODES = int(os.environ.get("SCALE_SMOKE_NODES", "1000000"))
+BUDGET_S = float(os.environ.get("SCALE_SMOKE_BUDGET_S", "600"))
+EVENT_BYTES_CEILING = 64 * 1024
+
+
+def main() -> None:
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+    from spark_scheduler_tpu.server.app import build_scheduler_app
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+    from spark_scheduler_tpu.store.backend import InMemoryBackend
+    from spark_scheduler_tpu.testing.harness import (
+        INSTANCE_GROUP_LABEL,
+        new_node,
+        static_allocation_spark_pods,
+    )
+
+    t_boot = time.perf_counter()
+    backend = InMemoryBackend()
+    for i in range(N_NODES):
+        backend.add_node(new_node(f"s{i:07d}", zone=f"zone{i % 4}"))
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            fifo=False,
+            sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+            solver_prune_top_k=64,
+            flight_recorder=False,
+        ),
+    )
+    server = SchedulerHTTPServer(app, host="127.0.0.1", port=0)
+    server.start()
+    ext = app.extender
+    ext._last_request = float("inf")
+
+    # One warm-up window (in process — the leg smokes the host paths, not
+    # HTTP throughput) to force cold featurize + the one full upload.
+    names = [f"s{i:07d}" for i in range(min(N_NODES, 512))]
+
+    def serve_one(tag: str) -> None:
+        d = static_allocation_spark_pods(f"smoke-{tag}", 2)[0]
+        backend.add_pod(d)
+        tok = ext.predicate_window_dispatch(
+            [ExtenderArgs(pod=d, node_names=list(names))]
+        )
+        res = ext.predicate_window_complete(tok)
+        assert res[0].node_names, f"window {tag} failed to place"
+
+    serve_one("boot")
+    boot_s = time.perf_counter() - t_boot
+    assert boot_s < BUDGET_S, (
+        f"boot took {boot_s:.1f}s > budget {BUDGET_S}s at {N_NODES} nodes"
+    )
+
+    store = ext.features
+    stats = app.solver.device_state_stats
+    rebuilds_before = store.stats()["roster_rebuilds"]
+    bytes_before = stats["upload_bytes"]
+    events_before = (
+        stats["full_uploads"]
+        + stats["delta_uploads"]
+        + stats["static_delta_uploads"]
+    )
+
+    # Event phase: 4 adds + 4 updates, one served window each.
+    for j in range(4):
+        backend.add_node(new_node(f"late{j:03d}", zone="zone0"))
+        serve_one(f"add{j}")
+    for j in range(4):
+        name = f"s{N_NODES - 1 - j:07d}"
+        cur = backend.get_node(name)
+        backend.update(
+            "nodes",
+            dataclasses.replace(cur, unschedulable=not cur.unschedulable),
+        )
+        serve_one(f"upd{j}")
+
+    fs = store.stats()
+    assert fs["roster_rebuilds"] == rebuilds_before, (
+        f"node events paid {fs['roster_rebuilds'] - rebuilds_before} full "
+        "roster rebuilds (O(N) regression)"
+    )
+    assert fs["roster_add_patches"] >= 4, fs
+    events = (
+        stats["full_uploads"]
+        + stats["delta_uploads"]
+        + stats["static_delta_uploads"]
+        - events_before
+    )
+    per_event = (stats["upload_bytes"] - bytes_before) / max(events, 1)
+    assert per_event < EVENT_BYTES_CEILING, (
+        f"{per_event:.0f} upload bytes/event >= ceiling "
+        f"{EVENT_BYTES_CEILING} (O(N) upload regression)"
+    )
+
+    print(
+        json.dumps(
+            {
+                "scale_smoke": "pass",
+                "n_nodes": N_NODES,
+                "boot_s": round(boot_s, 1),
+                "upload_bytes_per_event": round(per_event, 1),
+                "roster_add_patches": fs["roster_add_patches"],
+                "device_state": dict(stats),
+            }
+        ),
+        flush=True,
+    )
+    server.stop()
+    app.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -894,6 +894,22 @@ void arena_set_name_ranks(void* h, const int64_t* sorted_idx, int64_t n) {
   }
 }
 
+// Scatter EXPLICIT rank values onto slots. The gapped (order-maintenance)
+// name-rank scheme rides this: every kernel consumes rank ORDER only, so
+// values need not be dense — a node ADD assigns a midpoint between its
+// lexicographic neighbours' values and touches ONE slot, where the dense
+// scheme (arena_set_name_ranks) renumbers every slot per add. Unlisted
+// slots keep their previous ranks.
+void arena_set_name_rank_values(void* h, const int64_t* idx,
+                                const int32_t* ranks, int64_t n) {
+  auto* a = static_cast<ClusterArena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    a->ensure(idx[i]);
+    a->name_rank[idx[i]] = ranks[i];
+  }
+}
+
 // Materialize the solver inputs for slots [0, n) into caller buffers.
 // usage/overhead are [n*3] int64 (sparse scatter done by the caller into a
 // reusable buffer); outputs are the ClusterTensors fields.

@@ -104,6 +104,24 @@ def main(argv=None) -> int:
         "overrides solver.prune-slack (default 2.0)",
     )
     srv.add_argument(
+        "--scale-tier",
+        action="store_true",
+        default=None,
+        help="million-node scale tier: run certificate escalations and "
+        "cold full-tensor re-solves as a node-sharded device solve over "
+        "the local mesh instead of the host greedy walk (byte-identical "
+        "decisions; wants an ICI-class interconnect); overrides "
+        "solver.scale-tier (default off)",
+    )
+    srv.add_argument(
+        "--no-delta-statics",
+        action="store_true",
+        default=None,
+        help="disable delta STATIC uploads (solver.delta-statics): every "
+        "statics change re-uploads the full blob and drains in-flight "
+        "windows, the pre-ISSUE-11 behavior",
+    )
+    srv.add_argument(
         "--ha-replica",
         default=None,
         metavar="REPLICA_ID",
@@ -250,6 +268,10 @@ def main(argv=None) -> int:
         config.solver_prune_top_k = args.prune_top_k
     if args.prune_slack is not None:
         config.solver_prune_slack = args.prune_slack
+    if args.scale_tier:
+        config.solver_scale_tier = True
+    if args.no_delta_statics:
+        config.solver_delta_statics = False
     if args.mesh is not None:
         try:
             groups, shards = (int(x) for x in args.mesh.lower().split("x"))

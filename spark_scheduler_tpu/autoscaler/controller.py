@@ -58,12 +58,17 @@ class ElasticAutoscaler:
         metrics: AutoscalerMetrics | None = None,
         recorder=None,
         clock=None,
+        census=None,
     ):
         import time as _time
 
         self._backend = backend
         self.provisioner = provisioner
         self.drainer = drainer
+        # Incremental census (core/census.py): cluster size becomes an
+        # O(1) counter read instead of materializing the full node list
+        # (three times per pass). None = the reference's list_nodes walk.
+        self._census = census
         self.max_cluster_size = max_cluster_size
         self._poll_interval_s = poll_interval_s
         self.metrics = metrics or AutoscalerMetrics()
@@ -176,7 +181,7 @@ class ElasticAutoscaler:
         # pending -> cannot-fulfill with two status writes per pass.
         # Unit-infeasible demands (a unit larger than an empty template
         # node) stay terminal.
-        cluster_size = len(self._backend.list_nodes())
+        cluster_size = self._cluster_size()
         pending: list[Demand] = []
         live: set[tuple[str, str]] = set()
         for d in self._backend.list("demands"):
@@ -229,7 +234,7 @@ class ElasticAutoscaler:
             # Prefix node count is monotone in prefix length (a superset of
             # units never packs into fewer bins), so binary-search the cut
             # instead of re-packing per one-demand decrement.
-            cluster_size = len(self._backend.list_nodes())
+            cluster_size = self._cluster_size()
             units = lambda ds: [u for d in ds for u in d.spec.units]  # noqa: E731
             lo, hi, needed = 0, len(feasible), 0
             while lo < hi:
@@ -264,8 +269,13 @@ class ElasticAutoscaler:
         summary["drained"] = drained
         if drained:
             self.metrics.on_nodes_drained(len(drained))
-        self.metrics.set_cluster_size(len(self._backend.list_nodes()))
+        self.metrics.set_cluster_size(self._cluster_size())
         return summary
+
+    def _cluster_size(self) -> int:
+        if self._census is not None:
+            return self._census.node_count()
+        return len(self._backend.list_nodes())
 
     # -- phase transitions ---------------------------------------------------
 

@@ -39,6 +39,7 @@ class ScaleDownDrainer:
         idle_ttl_s: float = 300.0,
         clock=None,
         drain_static_fleet: bool = False,
+        census=None,
     ):
         import time as _time
 
@@ -48,6 +49,12 @@ class ScaleDownDrainer:
         self.idle_ttl_s = idle_ttl_s
         self._clock = clock or _time.time
         self._drain_static = drain_static_fleet
+        # Incremental control-loop census (core/census.py): when attached,
+        # a drain pass reads the resident node mirror / busy refcounts
+        # (O(eligible fleet) per pass, O(1) busy checks) instead of
+        # re-listing every node, pod, and reservation. None = the
+        # reference's full walks.
+        self._census = census
         self._idle_since: dict[str, float] = {}
         # Nodes WE cordoned, pending deletion next pass. Operator cordons
         # are not in this map and are never uncordoned by us.
@@ -57,6 +64,8 @@ class ScaleDownDrainer:
 
     def reserved_node_names(self) -> set[str]:
         """Every node a hard OR soft reservation names — the never-drain set."""
+        if self._census is not None:
+            return self._census.reserved_node_names()
         used: set[str] = set()
         for rr in self._rr_cache.list():
             for res in rr.spec.reservations.values():
@@ -79,9 +88,23 @@ class ScaleDownDrainer:
         """One drain pass; returns the names of nodes deleted this pass."""
         if now is None:
             now = self._clock()
-        busy = self._busy_nodes()
+        census = self._census
+        if census is not None:
+            # Census pass: scan only the eligible (provisioned) fleet —
+            # at the million-node tier the static fleet never enters the
+            # loop — with O(1) busy checks against the resident refcounts.
+            # Identical decisions to the full-walk pass (the census is the
+            # same sources, event-maintained).
+            busy = None
+            live = (
+                census.nodes_view()
+                if self._drain_static
+                else census.eligible_view()
+            )
+        else:
+            busy = self._busy_nodes()
+            live = {n.name: n for n in self._backend.list_nodes()}
         drained: list[str] = []
-        live = {n.name: n for n in self._backend.list_nodes()}
         # Forget tracking state for nodes that disappeared out from under us.
         for name in list(self._idle_since):
             if name not in live:
@@ -94,7 +117,8 @@ class ScaleDownDrainer:
             )
             if not eligible:
                 continue
-            if name in busy:
+            is_busy = census.is_busy(name) if busy is None else name in busy
+            if is_busy:
                 # Busy again: reset the idle clock; if we had cordoned it
                 # for drain, hand it back (a reservation raced the cordon).
                 # On a failed uncordon write (rv conflict with concurrent

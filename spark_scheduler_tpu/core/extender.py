@@ -63,6 +63,28 @@ LEADER_ELECTION_INTERVAL_S = 15.0  # resource.go:54-57
 # sparkpods for core-layer convenience.
 
 
+class _DomainNames(list):
+    """A memoized affinity-domain name list with an O(1) identity digest —
+    the in-process analog of server/ingest.NativeNodeNames. The domain
+    cache reuses ONE object per (selector signature, topology version), so
+    keying the solver's candidate-mask LRU and the window dispatch's
+    domain memo on `names_digest` makes every steady-state lookup O(1)
+    where tuple-keying hashed (and first built a tuple of) every name —
+    a measured per-window O(N) host cost at the million-node tier."""
+
+    __hash__ = object.__hash__
+
+    def __eq__(self, other):
+        return self is other
+
+    def __ne__(self, other):
+        return self is not other
+
+    @property
+    def names_digest(self) -> int:
+        return id(self)
+
+
 class ExtenderArgs(NamedTuple):
     """schedulerapi.ExtenderArgs: the pod + kube-scheduler's candidates."""
 
@@ -711,8 +733,15 @@ class SparkSchedulerExtender:
         # signature: requests without selector/affinity — the overwhelmingly
         # common case — share the all-nodes domain (None => pack_window uses
         # every valid node), and identical selectors run the O(nodes)
-        # matcher walk once per window instead of once per request.
+        # matcher walk once per window instead of once per request. A node
+        # event no longer invalidates the cache wholesale (ISSUE 11): an
+        # update/add burst PATCHES the cached membership through the
+        # snapshot's dirty hint — O(changed) matcher calls — and when
+        # membership is unchanged (the common event: capacity drift,
+        # cordons; labels untouched) the SAME domain object survives, so
+        # the solver's digest-keyed candidate-mask memo keeps hitting.
         domains = t.domains
+        hint = snap.dirty_hint
         domain_by_sig: dict[tuple, list[str] | None] = {}
         for i, pod, res, args in window:
             sig = (
@@ -732,15 +761,49 @@ class SparkSchedulerExtender:
                     )
                     if cached is not None and cached[0] == topo:
                         domain_by_sig[sig] = cached[1]
+                    elif (
+                        cached is not None
+                        and hint is not None
+                        and cached[0] == hint[0]
+                    ):
+                        # Version chain verified: the cache was current as
+                        # of the hint's base version, and the hint carries
+                        # exactly the nodes changed since.
+                        names, name_set = cached[1], cached[2]
+                        added = [
+                            n.name
+                            for n in hint[1]
+                            if n.name not in name_set
+                            and pod_matches_node(pod, n)
+                        ]
+                        removed = {
+                            n.name
+                            for n in hint[1]
+                            if n.name in name_set
+                            and not pod_matches_node(pod, n)
+                        }
+                        if added or removed:
+                            if removed:
+                                names = _DomainNames(
+                                    nm for nm in names if nm not in removed
+                                )
+                            else:
+                                names = _DomainNames(names)
+                            names.extend(added)
+                            name_set = (name_set - removed) | set(added)
+                        domain_by_sig[sig] = names
+                        self._domain_cache.put(sig, (topo, names, name_set))
                     else:
-                        names = [
+                        names = _DomainNames(
                             n.name
                             for n in all_nodes
                             if pod_matches_node(pod, n)
-                        ]
+                        )
                         domain_by_sig[sig] = names
                         if topo is not None:
-                            self._domain_cache.put(sig, (topo, names))
+                            self._domain_cache.put(
+                                sig, (topo, names, set(names))
+                            )
             domains[i] = domain_by_sig[sig]
         t_domains = self._clock()
         phases["featurize_domains_ms"] = (t_domains - t_stage) * 1e3

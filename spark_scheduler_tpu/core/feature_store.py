@@ -65,9 +65,11 @@ class FeatureSnapshot(NamedTuple):
     # churning under the rebuild.
     roster_rows: Optional[np.ndarray] = None
     # (previous nodes_version, changed Node objects) when this snapshot's
-    # roster differs from the last one by UPDATES ONLY — the solver
-    # upserts just those into its native arena instead of the O(nodes)
-    # identity walk. None = no hint (full walk on version mismatch).
+    # roster differs from the last one by UPDATES AND/OR ADDS only — the
+    # solver upserts just those into its native arena (interning the new
+    # names and inserting their name ranks incrementally) instead of the
+    # O(nodes) identity walk. None = no hint (full walk on version
+    # mismatch; deletes always rebuild).
     dirty_hint: Optional[tuple] = None
 
 
@@ -143,6 +145,11 @@ class RankIndex:
         clean = self._order[keep]
         self._mem[d] = avail[d, 1]
         self._cpu[d] = avail[d, 0]
+        # Re-key the name component too: a statics row-delta (node ADD
+        # under the gapped-rank scheme) changes the dirty rows' name
+        # ranks without a roster rebuild — unchanged rows re-assign
+        # their existing value (a no-op).
+        self._name[d] = np.asarray(name_rank)[d]
         ds = d[np.lexsort((d, self._name[d], self._cpu[d], self._mem[d]))]
         pos = self._bisect(clean, ds)
         self._order = np.insert(clean, pos, ds)
@@ -205,10 +212,11 @@ class HostFeatureStore:
         self._node_pos: dict[str, int] = {}  # name -> position in _nodes
         self._roster_topo: Optional[int] = None
         self._roster_dirty = True
-        # Add/delete (or racy) events force the full O(nodes) rebuild;
-        # update-only bursts ride the patch path below.
+        # Delete (or racy) events force the full O(nodes) rebuild;
+        # update-only and add-only bursts ride the patch paths below.
         self._dirty_full = True
         self._dirty_updates: dict[str, Any] = {}  # name -> newest Node
+        self._dirty_adds: dict[str, Any] = {}  # name -> added Node
         self._roster_rows: Optional[np.ndarray] = None
         self._dirty_hint: Optional[tuple] = None
         self._statics_epoch = 0
@@ -228,6 +236,7 @@ class HostFeatureStore:
         self.snapshots = 0
         self.roster_rebuilds = 0
         self.roster_patches = 0
+        self.roster_add_patches = 0
         self.usage_refreshes = 0
         self.overhead_refreshes = 0
         overhead_computer.attach_registry(registry)
@@ -237,26 +246,45 @@ class HostFeatureStore:
         # nodes, the full O(nodes) re-list otherwise.
         backend.subscribe(
             "nodes",
-            on_add=self._on_node_add_delete,
+            on_add=self._on_node_add,
             on_update=self._on_node_update,
-            on_delete=self._on_node_add_delete,
+            on_delete=self._on_node_delete,
         )
 
     # -- events ---------------------------------------------------------------
 
-    def _on_node_add_delete(self, *_args) -> None:
+    def _on_node_delete(self, *_args) -> None:
         with self._lock:
             self._roster_dirty = True
             self._dirty_full = True
+
+    def _on_node_add(self, new) -> None:
+        """Node ADDs ride their own patch path (ISSUE 11 satellite: a
+        single added node used to trigger the full re-list + re-intern):
+        the added Node object is captured here, and the next snapshot
+        APPENDS it — roster tuple, name maps, registry row, live mask —
+        in O(changed), never re-walking the existing roster. A name we
+        already track arriving as an "add" is a racy replay: full rebuild."""
+        with self._lock:
+            self._roster_dirty = True
+            if not self._dirty_full:
+                if new.name in self._node_pos or new.name in self._dirty_adds:
+                    self._dirty_full = True
+                else:
+                    self._dirty_adds[new.name] = new
 
     def _on_node_update(self, _old, new) -> None:
         with self._lock:
             self._roster_dirty = True
             if not self._dirty_full:
-                if new.name in self._node_pos:
+                if new.name in self._dirty_adds:
+                    # Added then updated within one burst: the add entry
+                    # carries the newest object.
+                    self._dirty_adds[new.name] = new
+                elif new.name in self._node_pos:
                     self._dirty_updates[new.name] = new
                 else:
-                    self._dirty_full = True  # unknown name: treat as add
+                    self._dirty_full = True  # unknown name: racy — rebuild
 
     # -- snapshot -------------------------------------------------------------
 
@@ -305,25 +333,56 @@ class HostFeatureStore:
             return
         can_patch = (
             not self._dirty_full
-            and self._dirty_updates
+            and (self._dirty_updates or self._dirty_adds)
             and topo is not None
             and self._roster_topo is not None
         )
         if can_patch:
             prev = self._roster_topo
             updates = self._dirty_updates
+            adds = self._dirty_adds
             self._dirty_updates = {}
+            self._dirty_adds = {}
             nodes = list(self._nodes)
             by_name = dict(self._by_name)
             pos = self._node_pos
             for name, node in updates.items():
                 nodes[pos[name]] = node
                 by_name[name] = node
+            if adds:
+                # APPEND path (node-ADD, O(changed)): new names intern in
+                # one bulk call, the registry-row array and live-row mask
+                # extend in place, and the overhead copy re-masks against
+                # the grown mask on its next refresh. The existing roster
+                # is never re-listed or re-interned.
+                for name, node in adds.items():
+                    pos[name] = len(nodes)
+                    nodes.append(node)
+                    by_name[name] = node
+                new_rows = self._registry.intern_many(list(adds))
+                rows = np.concatenate(
+                    [self._roster_rows, new_rows.astype(np.int32)]
+                )
+                rows.flags.writeable = False
+                self._roster_rows = rows
+                cap = max(self._registry.capacity, 1)
+                mask = self._roster_mask
+                if mask is None or mask.shape[0] < cap:
+                    grown = np.zeros(cap, dtype=bool)
+                    if mask is not None:
+                        grown[: mask.shape[0]] = mask
+                    mask = grown
+                mask[new_rows] = True
+                self._roster_mask = mask
+                self._overhead_version = None  # re-mask on next refresh
+                self.roster_add_patches += 1
             self._nodes = tuple(nodes)
             self._by_name = by_name
             self._roster_topo = topo
             self._roster_dirty = False
-            self._dirty_hint = (prev, tuple(updates.values()))
+            self._dirty_hint = (
+                prev, tuple(updates.values()) + tuple(adds.values()),
+            )
             self._statics_epoch += 1
             self._epoch += 1
             self.roster_patches += 1
@@ -338,6 +397,7 @@ class HostFeatureStore:
         self._roster_dirty = raced
         self._dirty_full = raced
         self._dirty_updates = {}
+        self._dirty_adds = {}
         self._dirty_hint = None
         # Rebuild the live-row mask (we are already on the O(nodes) path)
         # and force the overhead copy to re-mask against it. One bulk
@@ -399,6 +459,7 @@ class HostFeatureStore:
                 "snapshots": self.snapshots,
                 "roster_rebuilds": self.roster_rebuilds,
                 "roster_patches": self.roster_patches,
+                "roster_add_patches": self.roster_add_patches,
                 "usage_refreshes": self.usage_refreshes,
                 "overhead_refreshes": self.overhead_refreshes,
                 "nodes": len(self._nodes),

@@ -74,6 +74,19 @@ def _bucket(n: int, minimum: int) -> int:
     return out
 
 
+def _zone_sum(zones: np.ndarray, vals: np.ndarray, zb: int) -> np.ndarray:
+    """Exact per-zone int64 sums. bincount accumulates in float64 —
+    exact while |sum| < 2^53, guaranteed for < 2^22 int32 rows (2^22 x
+    2^31/2 = 2^52); larger row sets take the exact-but-slow np.add.at."""
+    if vals.size >= (1 << 22):
+        out = np.zeros(zb, np.int64)
+        np.add.at(out, zones, vals.astype(np.int64))
+        return out
+    return np.bincount(
+        zones, weights=vals, minlength=zb
+    ).astype(np.int64)
+
+
 def zone_ranks_host(
     mem_sum: np.ndarray,  # [Z] int64 — per-zone available-memory sums
     cpu_sum: np.ndarray,  # [Z] int64
@@ -193,7 +206,16 @@ def plan_window_prune(
     # an excluded row past a kept one within its zone.
     fo = order[fit_e[order]]
     do = order[fit_d[order]]
-    zids = np.unique(zone_id[dom_mask]) if dom_mask.any() else np.array([], np.int32)
+    # Per-zone domain counts via bincount (zone ids are < num_zones by
+    # construction): np.unique sorts N values — a measured per-window
+    # host cost at the million-node tier.
+    zb = num_zones
+    dom_zcnt = (
+        np.bincount(zone_id[dom_mask], minlength=zb)
+        if dom_mask.any()
+        else np.zeros(zb, np.int64)
+    )
+    zids = np.flatnonzero(dom_zcnt)
     sel: list[np.ndarray] = []
     for z in zids:
         sel.append(fo[zone_id[fo] == z][:k_per_zone])
@@ -207,19 +229,17 @@ def plan_window_prune(
     if k_real == 0 or k_real >= 0.7 * dom_rows:
         return None  # pruning buys nothing on this window
 
-    zb = num_zones
     excl = dom_mask & ~kept_mask
     e_rows = np.flatnonzero(excl)
     e_zone = zone_id[e_rows]
-    e_avail = avail[e_rows].astype(np.int64)
 
     # Device zone-sum offsets: ALL excluded domain rows (relevant or not).
-    s_mem = np.zeros(zb, np.int64)
-    s_cpu = np.zeros(zb, np.int64)
-    np.add.at(s_mem, e_zone, e_avail[:, MEM_DIM])
-    np.add.at(s_cpu, e_zone, e_avail[:, CPU_DIM])
-    present = np.zeros(zb, bool)
-    present[np.unique(zone_id[dom_mask])] = True
+    # bincount-with-weights accumulates in float64 — exact for |sum| <
+    # 2^53, i.e. any cluster under ~4M int32 rows (guarded); np.add.at is
+    # an order of magnitude slower at 1M rows.
+    s_mem = _zone_sum(e_zone, avail[e_rows, MEM_DIM], zb)
+    s_cpu = _zone_sum(e_zone, avail[e_rows, CPU_DIM], zb)
+    present = dom_zcnt > 0
 
     # Whole-domain dispatch sums = kept sums + excluded sums.
     zone_mem = s_mem.copy()
@@ -233,19 +253,27 @@ def plan_window_prune(
 
     def _summaries(rel_mask: np.ndarray):
         rows = np.flatnonzero(rel_mask & excl)
-        cnt = np.bincount(zone_id[rows], minlength=zb).astype(np.int64)
+        rz = zone_id[rows]
+        cnt = np.bincount(rz, minlength=zb).astype(np.int64)
         mx = np.full((zb, avail.shape[1]), np.iinfo(np.int64).min, np.int64)
-        np.maximum.at(mx, zone_id[rows], avail[rows].astype(np.int64))
+        # Per-zone maxima: one vectorized pass per present zone (zones
+        # are few) instead of np.maximum.at's per-element inner loop.
+        av = avail[rows]
+        for z in np.flatnonzero(cnt):
+            mx[z] = av[rz == z].max(axis=0)
         # The priority order IS sorted by (mem, cpu, name): the first
         # relevant excluded row of each zone in order is that zone's lexmin
-        # key — no per-window sort.
+        # key — no per-window sort. First-occurrence per zone via argmax
+        # on the present zones (np.unique sorts N values — measured at
+        # the 1M tier); zones are few.
         key = np.full((zb, 3), _I64_MAX, np.int64)
         ro = order[(rel_mask & excl)[order]]
-        zfirst, first_idx = np.unique(zone_id[ro], return_index=True)
-        fr = ro[first_idx]
-        key[zfirst, 0] = avail[fr, MEM_DIM]
-        key[zfirst, 1] = avail[fr, CPU_DIM]
-        key[zfirst, 2] = name_rank[fr]
+        rzo = zone_id[ro]
+        for z in np.flatnonzero(cnt):
+            fr = ro[int(np.argmax(rzo == z))]
+            key[z, 0] = avail[fr, MEM_DIM]
+            key[z, 1] = avail[fr, CPU_DIM]
+            key[z, 2] = name_rank[fr]
         return cnt, mx, key
 
     e_cnt_exec, e_max_exec, e_key_exec = _summaries(fit_e)

@@ -353,7 +353,19 @@ class ResourceReservationManager:
     def compact_dynamic_allocation_applications(self) -> None:
         """Migrate soft reservations of live executors into freed hard slots
         (resourcereservations.go:238-268). Apps are queued by the executor
-        pod-deletion handler and drained here, on the request path."""
+        pod-deletion handler and drained here, on the request path.
+
+        One unbound-slot derivation and ONE reservation write per app: the
+        per-pod form re-derived the active pod set and re-wrote the
+        reservation once per soft executor — O(slots x pods) per
+        compaction pass, a measured host cost at high dynamic-allocation
+        churn. Slot choice per pod is unchanged (prefer a slot already on
+        the pod's node, else the first unbound slot,
+        resourcereservations.go:283-301); a consumed slot is not re-offered
+        within the pass even when the bind leaves it node-mismatched —
+        semantically equivalent, the same deviation contract as
+        executor_ladder_batch (any unbound slot satisfies the reservation;
+        the reference itself picks arbitrarily)."""
         with self._compaction_lock:
             drained, self._compaction_apps = self._compaction_apps, {}
         with self._mutex:
@@ -362,26 +374,52 @@ class ResourceReservationManager:
                 if not ok:
                     continue
                 pods = self._get_active_pods(app_id, namespace)
-                for pod_name in list(sr.reservations):
-                    pod = pods.get(pod_name)
-                    if pod is None:
-                        continue  # no longer active
-                    self._compact_soft_reservation_pod(pod)
+                live = [
+                    pods[name] for name in sr.reservations if name in pods
+                ]
+                if not live:
+                    continue
+                self._compact_app(app_id, live, pods)
 
-    def _compact_soft_reservation_pod(self, pod: Pod) -> None:
-        app_id = pod.labels.get(SPARK_APP_ID_LABEL, "")
-        unbound = self._get_unbound_reservations(app_id, pod.namespace)
+    def _compact_app(
+        self, app_id: str, pods: list[Pod], active: dict[str, Pod]
+    ) -> None:
+        """`active` is the app's already-derived active-pod map — the
+        caller pays that walk exactly once per compacted app."""
+        if not pods:
+            return
+        namespace = pods[0].namespace
+        rr = self.get_resource_reservation(app_id, namespace)
+        if rr is None:
+            return
+        unbound = self._unbound_of(rr, active)
         if not unbound:
             return
-        # Prefer a slot already on the pod's node (resourcereservations.go:283-301)
-        for res_name, res_node in unbound.items():
-            if res_node == pod.node_name:
-                self._bind_executor_to_resource_reservation(pod, res_name, res_node)
-                self.soft_store.remove_executor_reservation(app_id, pod.name)
-                return
-        res_name = next(iter(unbound))
-        self._bind_executor_to_resource_reservation(pod, res_name, unbound[res_name])
-        self.soft_store.remove_executor_reservation(app_id, pod.name)
+        binds: list[tuple[Pod, str, str]] = []  # (pod, slot, node)
+        for pod in pods:
+            if not unbound:
+                break
+            res_name = next(
+                (
+                    name
+                    for name, node in unbound.items()
+                    if node == pod.node_name
+                ),
+                None,
+            )
+            if res_name is None:
+                res_name = next(iter(unbound))
+            binds.append((pod, res_name, unbound.pop(res_name)))
+        if not binds:
+            return
+        updated = rr.copy()
+        for pod, res_name, node in binds:
+            updated.spec.reservations[res_name].node = node
+            updated.status.pods[res_name] = pod.name
+        if not self.rr_cache.update(updated):
+            raise ReservationError("failed to update resource reservation")
+        for pod, _res_name, _node in binds:
+            self.soft_store.remove_executor_reservation(app_id, pod.name)
 
     # -- internals ----------------------------------------------------------
 
@@ -415,13 +453,11 @@ class ResourceReservationManager:
             Reservation(node, app_resources.executor_resources.copy()),
         )
 
-    def _get_unbound_reservations(self, app_id: str, namespace: str) -> dict[str, str]:
+    @staticmethod
+    def _unbound_of(rr: ResourceReservation, active: dict[str, Pod]) -> dict[str, str]:
         """Slots not bound to an active pod, bound to a dead pod, or bound to
-        a pod that landed on a different node (resourcereservations.go:358-380)."""
-        rr = self.get_resource_reservation(app_id, namespace)
-        if rr is None:
-            raise ReservationError("failed to get resource reservation")
-        active = self._get_active_pods(app_id, namespace)
+        a pod that landed on a different node (resourcereservations.go:358-380),
+        over an already-derived active-pod map."""
         unbound: dict[str, str] = {}
         for res_name, res in rr.spec.reservations.items():
             pod_name = rr.status.pods.get(res_name)
@@ -433,6 +469,12 @@ class ResourceReservationManager:
             ):
                 unbound[res_name] = res.node
         return unbound
+
+    def _get_unbound_reservations(self, app_id: str, namespace: str) -> dict[str, str]:
+        rr = self.get_resource_reservation(app_id, namespace)
+        if rr is None:
+            raise ReservationError("failed to get resource reservation")
+        return self._unbound_of(rr, self._get_active_pods(app_id, namespace))
 
     def _get_free_soft_reservation_spots(self, app_id: str, namespace: str) -> int:
         sr, ok = self.soft_store.get_soft_reservation(app_id)

@@ -10,10 +10,12 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from types import MappingProxyType
+from typing import Mapping
 
 from spark_scheduler_tpu.models.kube import Pod
 from spark_scheduler_tpu.models.reservations import Reservation
-from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.models.resources import FrozenResources, Resources
 from spark_scheduler_tpu.core.sparkpods import (
     ROLE_DRIVER,
     ROLE_EXECUTOR,
@@ -52,6 +54,15 @@ class SoftReservationStore:
         # gains/loses a soft reservation — the overhead computer's signal
         # that the pod flipped between overhead and reserved.
         self._membership_listeners: list = []
+        # Incrementally-maintained per-node usage aggregate (the dense
+        # mirror behind used_soft_reservation_resources): mutable running
+        # sums + reservation refcounts per node, updated under the lock by
+        # the same mutations that feed the delta listeners. The walk over
+        # every app x reservation is gone from the query path.
+        self._usage_sum: dict[str, Resources] = {}
+        self._usage_refs: dict[str, int] = {}
+        self._usage_version = 0
+        self._usage_view: tuple[int, Mapping[str, FrozenResources]] | None = None
         if backend is not None:
             backend.subscribe("pods", on_delete=self._on_pod_deletion)
 
@@ -95,15 +106,50 @@ class SoftReservationStore:
                 return sr.reservations[executor.name].copy()
         return None
 
-    def used_soft_reservation_resources(self) -> dict[str, Resources]:
+    def used_soft_reservation_resources(self) -> Mapping[str, Resources]:
         """Per-node usage of all live soft reservations
-        (softreservations.go:155-172)."""
+        (softreservations.go:155-172).
+
+        Returns a MEMOIZED IMMUTABLE view (MappingProxyType of
+        FrozenResources) over the incrementally-maintained aggregate —
+        the same shape as the reference's fresh dict, but O(1) when
+        nothing changed since the last call and never a per-app walk.
+        Mutating the view (or a value in it) raises; call `.copy()` on a
+        value for a mutable one."""
         with self._lock:
-            out: dict[str, Resources] = {}
-            for sr in self._store.values():
-                for r in sr.reservations.values():
-                    out.setdefault(r.node, Resources.zero()).add(r.resources)
-            return out
+            view = self._usage_view
+            if view is not None and view[0] == self._usage_version:
+                return view[1]
+            frozen = MappingProxyType(
+                {
+                    node: FrozenResources(
+                        res.cpu_milli, res.mem_kib, res.gpu_milli
+                    )
+                    for node, res in self._usage_sum.items()
+                }
+            )
+            self._usage_view = (self._usage_version, frozen)
+            return frozen
+
+    def _usage_apply(self, node: str, resources: Resources, sign: int) -> None:
+        """Apply one reservation delta to the dense mirror (caller holds
+        the lock). Refcounted so a node whose reservations all vanish
+        drops out of the view exactly as the reference's walk would omit
+        it — including zero-resource reservations."""
+        refs = self._usage_refs.get(node, 0) + sign
+        if refs <= 0:
+            self._usage_refs.pop(node, None)
+            self._usage_sum.pop(node, None)
+        else:
+            self._usage_refs[node] = refs
+            cur = self._usage_sum.get(node)
+            if cur is None:
+                cur = self._usage_sum[node] = Resources.zero()
+            if sign > 0:
+                cur.add(resources)
+            else:
+                cur.sub(resources)
+        self._usage_version += 1
 
     # -- mutations ----------------------------------------------------------
 
@@ -126,6 +172,7 @@ class SoftReservationStore:
                 return
             sr.reservations[pod_name] = reservation
             sr.status[pod_name] = True
+            self._usage_apply(reservation.node, reservation.resources, +1)
         self._notify_delta(reservation.node, reservation.resources, +1)
         self._notify_membership(app_id, pod_name)
 
@@ -138,6 +185,8 @@ class SoftReservationStore:
             # Always tombstone: remember the death to beat the
             # death-event/schedule-request race (softreservations.go:197-210).
             sr.status[executor_name] = False
+            if removed is not None:
+                self._usage_apply(removed.node, removed.resources, -1)
         if removed is not None:
             self._notify_delta(removed.node, removed.resources, -1)
             self._notify_membership(app_id, executor_name)
@@ -145,6 +194,9 @@ class SoftReservationStore:
     def remove_driver_reservation(self, app_id: str) -> None:
         with self._lock:
             sr = self._store.pop(app_id, None)
+            if sr is not None:
+                for r in sr.reservations.values():
+                    self._usage_apply(r.node, r.resources, -1)
         if sr is not None:
             for name, r in sr.reservations.items():
                 self._notify_delta(r.node, r.resources, -1)

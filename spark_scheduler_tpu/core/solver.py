@@ -459,10 +459,12 @@ class _PoolSlot:
         # idx_key -> (epoch, statics tuple, idx device array) for gathered
         # partition sub-clusters.
         self.sub_statics: dict = {}
-        # Per-slot replica decisions: "full" (statics uploaded) vs "reuse"
-        # (resident copy served). Availability DELTAS are pipeline-level
-        # (one thread for the whole pool), counted in device_state_stats.
-        self.uploads = {"full": 0, "reuse": 0}
+        # Per-slot replica decisions: "full" (statics uploaded), "delta"
+        # (lagging replica caught up by scattering the journal's changed
+        # rows), "reuse" (resident copy served). Availability DELTAS are
+        # pipeline-level (one thread for the whole pool), counted in
+        # device_state_stats.
+        self.uploads = {"full": 0, "delta": 0, "reuse": 0}
         self.last_full_upload = 0.0
         self.inflight = 0
         # Slot-failure quarantine (ISSUE 9): a quarantined slot takes no
@@ -497,21 +499,66 @@ class _PoolSlot:
 
         return shard_apps(apps, self.placement)
 
-    def resident_statics(self, host, epoch, clock, telemetry):
-        """The slot's resident full-cluster static replica, re-uploaded
-        only when the statics epoch moved (topology/attribute change)."""
-        if self.statics is None or self.statics_epoch != epoch:
-            self.statics = tuple(self._put(f) for f in cluster_statics(host))
-            self.statics_epoch = epoch
-            self.uploads["full"] += 1
-            self.last_full_upload = clock()
-            if telemetry is not None:
-                nbytes = sum(getattr(f, "nbytes", 0) for f in cluster_statics(host))
-                telemetry.on_device_upload(self.label, "full", nbytes)
-        else:
+    def resident_statics(self, host, epoch, clock, telemetry, journal=None):
+        """The slot's resident full-cluster static replica.
+
+        Epoch current: serve the resident copy. Epoch behind with every
+        missed epoch present in `journal` (the solver's statics-delta
+        journal): catch up by scattering just the union of changed rows —
+        a node event costs each slot O(changed) upload bytes instead of
+        the full multi-MB blob. Anything else — first touch, a shape
+        change, an evicted journal epoch (delta against a stale epoch
+        must NEVER silently skew), a full upload having cleared the
+        journal, or a mesh slot (sharded scatter stays out of scope) —
+        re-uploads the full statics."""
+        if self.statics is not None and self.statics_epoch == epoch:
             self.uploads["reuse"] += 1
             if telemetry is not None:
                 telemetry.on_device_upload(self.label, "reuse", 0)
+            return self.statics
+        statics_np = cluster_statics(host)
+        if (
+            self.statics is not None
+            and not self.is_mesh
+            and journal
+            and 0 <= self.statics_epoch < epoch
+            and getattr(self.statics[0], "shape", (None,))[0]
+            == np.asarray(statics_np[0]).shape[0]
+            and all(
+                e in journal for e in range(self.statics_epoch + 1, epoch + 1)
+            )
+        ):
+            rows = np.unique(
+                np.concatenate(
+                    [
+                        journal[e]
+                        for e in range(self.statics_epoch + 1, epoch + 1)
+                    ]
+                )
+            )
+            idx = np.resize(rows, _bucket(len(rows), 16)).astype(np.int32)
+            idx_dev = self._put(idx)
+            nbytes = idx.nbytes
+            updated = []
+            for dev_f, host_f in zip(self.statics, statics_np):
+                vals = np.asarray(host_f)[idx]
+                updated.append(
+                    _scatter_rows(dev_f, idx_dev, self._put(vals))
+                )
+                nbytes += vals.nbytes
+            self.statics = tuple(updated)
+            self.statics_epoch = epoch
+            self.uploads["delta"] += 1
+            if telemetry is not None:
+                telemetry.on_device_upload(self.label, "delta", nbytes)
+            return self.statics
+        self.statics = tuple(self._put(f) for f in statics_np)
+        self.statics_epoch = epoch
+        self.uploads["full"] += 1
+        self.last_full_upload = clock()
+        if telemetry is not None:
+            nbytes = sum(getattr(f, "nbytes", 0) for f in statics_np)
+            telemetry.on_device_upload(self.label, "full", nbytes)
         return self.statics
 
     def sub_replica(self, host, idx_key, idx, epoch, clock, telemetry):
@@ -715,6 +762,68 @@ def _pack_blob(cluster, dreq, ereq, count, dmask, dom, *, fill, emax, num_zones)
     return jnp.concatenate(
         [p.driver_node[None], p.has_capacity.astype(jnp.int32)[None], p.executor_nodes]
     )
+
+
+class _NameRankSpace:
+    """Order-maintenance name ranks for the native arena (the node-ADD
+    cold-rebuild fix, ISSUE 11).
+
+    Every kernel and certificate consumes name_rank as a lexsort KEY —
+    rank order matters, values never do (the native builder already
+    documents global-vs-subset value deviation). So ranks need not be
+    dense: values are assigned with GAPS, and an added node takes the
+    midpoint between its lexicographic neighbours' values — O(log n)
+    bisect + one arena scatter, where the dense scheme renumbered every
+    slot per add (the measured ~96 ms at 100k). Gap exhaustion (adds
+    landing repeatedly in one interval) triggers a full renumber, counted
+    in `renumbers`.
+
+    Values stay under 2^29 < kInt32Inf/2, so they can never collide with
+    the arena's invalid-slot sentinel."""
+
+    _SPAN = 1 << 29
+
+    __slots__ = ("names", "ranks", "renumbers")
+
+    def __init__(self):
+        self.names: list[str] = []  # lexicographically sorted
+        self.ranks: list[int] = []  # parallel gapped values, ascending
+        self.renumbers = 0
+
+    def assign_all(self, names_sorted) -> None:
+        self.names = list(names_sorted)
+        gap = max(1, self._SPAN // (len(self.names) + 1))
+        self.ranks = [(i + 1) * gap for i in range(len(self.names))]
+        self.renumbers += 1
+
+    def insert(self, name: str) -> bool:
+        """Insert one name; returns True when a full renumber was needed
+        (the caller must then re-scatter EVERY rank, not just this one)."""
+        import bisect as _bisect
+
+        i = _bisect.bisect_left(self.names, name)
+        if i < len(self.names) and self.names[i] == name:
+            return False  # already ranked (idempotent re-add)
+        lo = self.ranks[i - 1] if i > 0 else 0
+        hi = (
+            self.ranks[i]
+            if i < len(self.ranks)
+            else min(lo + 2 * max(1, self._SPAN // (len(self.names) + 2)),
+                     self._SPAN)
+        )
+        if hi - lo < 2:
+            self.names.insert(i, name)
+            self.assign_all(self.names)
+            return True
+        self.names.insert(i, name)
+        self.ranks.insert(i, (lo + hi) // 2)
+        return False
+
+    def rank_of(self, name: str) -> int:
+        import bisect as _bisect
+
+        i = _bisect.bisect_left(self.names, name)
+        return self.ranks[i]
 
 
 class HostPacking(NamedTuple):
@@ -938,8 +1047,34 @@ class PlacementSolver:
         quarantine_probe_s: float = 5.0,
         prune_top_k: int = 0,
         prune_slack: float = 2.0,
+        delta_statics: bool = True,
+        scale_tier: bool = False,
     ):
         self.registry = NodeRegistry()
+        # Delta STATIC uploads (`solver.delta-statics`, ISSUE 11): a node
+        # event that touches few rows ships a row-scatter of the changed
+        # static-field rows instead of the full multi-MB blob (and pool
+        # replicas catch up from the epoch journal). Default ON — pinned
+        # byte-identical to the full upload by the delta-equivalence
+        # suite; False restores the full-upload-per-statics-change paths.
+        self._delta_statics = bool(delta_statics)
+        # Statics-epoch journal: epoch -> the rows that changed in that
+        # epoch's delta. A pool slot whose resident replica is E epochs
+        # behind scatters the union of those rows; a slot whose needed
+        # epochs were evicted (or that predates a full upload, which
+        # clears the journal) must full re-upload — the torn-update
+        # contract.
+        self._static_journal: dict[int, np.ndarray] = {}
+        # Scale-tier serving (`solver.scale-tier`): certificate
+        # escalations and cold full-tensor re-solves run as a node-sharded
+        # device solve over the mesh of local devices instead of the
+        # host-Python greedy walk — the [N] escalation path stops being a
+        # host O(N x rows) cost at the million-node tier. Decisions are
+        # byte-identical (same kernels; parity-suite pinned); any failure
+        # falls back to the host greedy oracle. Default OFF.
+        self._scale_tier = bool(scale_tier)
+        self._scale_mesh = None  # lazy ("nodes",) mesh over local devices
+        self.scale_tier_stats = {"resolves": 0, "sharded": 0, "fallbacks": 0}
         # Candidate pruning (`solver.prune-top-k` / `solver.prune-slack`,
         # core/prune.py): when top-k > 0, eligible pipelined windows solve
         # a gathered top-K sub-cluster and every decision is certified
@@ -1018,6 +1153,9 @@ class PlacementSolver:
         self._arena = None
         self._node_seen: dict[str, Node] = {}
         self._rank_epoch = -1
+        # Gapped name-rank order (see _NameRankSpace): a node ADD inserts
+        # one rank value instead of renumbering every slot.
+        self._rank_space = _NameRankSpace()
         if use_native and native.available():
             self._arena = native.ClusterArena()
         # Device-resident cluster state (VERDICT r2 #3): the last uploaded
@@ -1051,6 +1189,14 @@ class PlacementSolver:
             "delta_uploads": 0,
             "delta_rows": 0,
             "reuse_hits": 0,
+            # Delta STATIC uploads (row-scatters of changed static-field
+            # rows — node events that used to force the full blob).
+            "static_delta_uploads": 0,
+            "static_delta_rows": 0,
+            # Total h2d bytes of every state upload above (full blobs +
+            # both delta kinds) — upload_bytes / (full + delta uploads)
+            # is the bench's upload_bytes_per_event.
+            "upload_bytes": 0,
         }
         # Which device path served each dispatched window (pallas | xla).
         self.window_path_counts: dict[str, int] = {}
@@ -1410,6 +1556,7 @@ class PlacementSolver:
                     )
                     stats["delta_uploads"] += 1
                     stats["delta_rows"] += k
+                    stats["upload_bytes"] += rows.nbytes + idx.nbytes
                     self.last_state_upload = "delta"
                     if self.telemetry is not None:
                         self.telemetry.on_transfer(
@@ -1420,6 +1567,7 @@ class PlacementSolver:
                         dev["tensors"], available=jax.device_put(host.available)
                     )
                     stats["full_uploads"] += 1
+                    stats["upload_bytes"] += host.available.nbytes
                     self.last_state_upload = "full"
                     if self.telemetry is not None:
                         self.telemetry.on_transfer(
@@ -1428,6 +1576,7 @@ class PlacementSolver:
         if tensors is None:
             tensors = jax.device_put(host)
             stats["full_uploads"] += 1
+            stats["upload_bytes"] += _tensors_nbytes(host)
             self.last_state_upload = "full"
             if self.telemetry is not None:
                 self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
@@ -1532,6 +1681,7 @@ class PlacementSolver:
         p = self._pipe
         if p is not None and not self._resolve_base(p):
             p = None  # pooled combine failed: pipeline dead, full re-upload
+        static_plan = None
         if p is not None and p["host"].available.shape == host.available.shape:
             statics_same = (
                 statics_version is not None
@@ -1540,22 +1690,39 @@ class PlacementSolver:
                 np.array_equal(getattr(p["host"], f), getattr(host, f))
                 for f in _STATIC_FIELDS
             )
+            if not statics_same and self._delta_statics:
+                # Node event touching few rows: ship a static row-scatter
+                # delta instead of the full blob (and instead of draining
+                # the pipeline). In-flight windows are unaffected — their
+                # decisions were computed from (and reconstruct against)
+                # their own dispatch-time host view, exactly as with
+                # availability deltas.
+                static_plan = self._plan_static_delta(p["host"], host)
         else:
             statics_same = False
-        if statics_same:
-            cur = host.available.astype(np.int64)
-            delta = cur - p["mirror"]
-            dirty = np.flatnonzero(delta.any(axis=1))
+        if statics_same or static_plan is not None:
+            mirror = p["mirror"]
+            # Buffered mixed-dtype != (numpy casts in chunks): the dirty
+            # scan never materializes an int64 copy of the whole
+            # availability — a measured per-window cost at 1M nodes. The
+            # delta itself is computed over the dirty rows only.
+            dirty = np.flatnonzero(
+                (mirror != host.available).any(axis=1)
+            )
             avail = p["avail"]
             k = len(dirty)
+            if k:
+                delta_rows = (
+                    host.available[dirty].astype(np.int64) - mirror[dirty]
+                )
             # An external availability swing too large for the int32 delta
             # rows falls through to a FULL re-upload instead of wrapping
             # silently and corrupting the device base (with windows in
             # flight that raises PipelineDrainRequired below — the standard
             # retry contract of this method).
             fits_i32 = k == 0 or (
-                delta.min() >= np.iinfo(np.int32).min
-                and delta.max() <= np.iinfo(np.int32).max
+                delta_rows.min() >= np.iinfo(np.int32).min
+                and delta_rows.max() <= np.iinfo(np.int32).max
             )
             if not fits_i32 and p["unfetched"]:
                 if self.telemetry is not None:
@@ -1564,6 +1731,11 @@ class PlacementSolver:
                     "availability delta exceeds int32 with a window in flight"
                 )
             if fits_i32:
+                static_fields = {}
+                if static_plan is not None:
+                    static_fields = self._apply_static_delta(
+                        p, host, static_plan
+                    )
                 if k:
                     # Pad with a repeated index but ZERO delta rows: .add
                     # is cumulative, so padding must contribute nothing.
@@ -1574,24 +1746,33 @@ class PlacementSolver:
                     idx = np.full(kb, dirty[0], dtype=np.int32)
                     idx[:k] = dirty
                     rows = np.zeros((kb, host.available.shape[1]), np.int32)
-                    rows[:k] = delta[dirty]
+                    rows[:k] = delta_rows
                     avail = _add_rows_donated(
                         avail, jnp.asarray(idx), jnp.asarray(rows)
                     )
+                    # The mirror is pipeline-private: patch the dirty rows
+                    # in place instead of re-materializing the full int64
+                    # view per window.
+                    mirror[dirty] = host.available[dirty]
                     stats["delta_uploads"] += 1
                     stats["delta_rows"] += k
+                    stats["upload_bytes"] += rows.nbytes + idx.nbytes
                     self.last_state_upload = "delta"
                     if self.telemetry is not None:
                         self.telemetry.on_transfer(
                             "h2d", rows.nbytes + idx.nbytes
                         )
+                elif static_plan is not None:
+                    self.last_state_upload = "delta"
                 else:
                     stats["reuse_hits"] += 1
                     self.last_state_upload = "reuse"
-                tensors = dataclasses.replace(p["tensors"], available=avail)
+                tensors = dataclasses.replace(
+                    p["tensors"], available=avail, **static_fields
+                )
                 tensors.host = host
                 p.update(
-                    host=host, tensors=tensors, avail=avail, mirror=cur,
+                    host=host, tensors=tensors, avail=avail,
                     statics_version=statics_version,
                 )
                 return tensors
@@ -1604,11 +1785,16 @@ class PlacementSolver:
         tensors = jax.device_put(host)
         tensors.host = host
         stats["full_uploads"] += 1
+        stats["upload_bytes"] += _tensors_nbytes(host)
         self.last_state_upload = "full"
         # Statics may have changed with this full upload: pool replicas
         # re-upload on their next turn, and the prefilter's rank index
-        # rebuilds (name ranks / roster may have moved under it).
+        # rebuilds (name ranks / roster may have moved under it). The
+        # delta journal cannot bridge a full upload — clearing it forces
+        # every lagging replica onto the full path (the torn-update
+        # contract).
         self._static_epoch += 1
+        self._static_journal.clear()
         if self._rank_index is not None:
             self._rank_index.invalidate()
         if self.telemetry is not None:
@@ -1622,6 +1808,74 @@ class PlacementSolver:
             "statics_version": statics_version,
         }
         return tensors
+
+    def _plan_static_delta(self, prev, host):
+        """(changed field names, dirty rows) when the static drift between
+        two same-shape host views is small enough to ship as a row
+        scatter; None sends the caller to the full-upload/drain path.
+        Called only when at least one static field differs."""
+        n = host.available.shape[0]
+        rows_mask = np.zeros(n, dtype=bool)
+        changed: list[str] = []
+        for f in _STATIC_FIELDS:
+            a = np.asarray(getattr(prev, f))
+            b = np.asarray(getattr(host, f))
+            if a is b:
+                continue
+            neq = a != b
+            if neq.ndim == 2:
+                neq = neq.any(axis=1)
+            if not neq.any():
+                continue
+            changed.append(f)
+            rows_mask |= neq
+        if not changed:
+            return None
+        rows = np.flatnonzero(rows_mask)
+        if len(rows) > max(32, n // 8):
+            return None
+        return changed, rows
+
+    def _apply_static_delta(self, p, host, plan) -> dict:
+        """Scatter the changed static-field rows into the resident device
+        tensors; returns the replaced device fields for
+        dataclasses.replace. Bumps the statics epoch with a JOURNAL entry
+        so pool replicas catch up by scattering the same rows, and
+        re-keys the prefilter's rank index rows in place (instead of the
+        full-upload invalidate)."""
+        changed, rows = plan
+        k = len(rows)
+        # np.resize pads by cycling the dirty rows; duplicate indices then
+        # carry identical values, so .set stays deterministic.
+        idx = np.resize(rows, _bucket(k, 16)).astype(np.int32)
+        idx_dev = jnp.asarray(idx)
+        out = {}
+        nbytes = idx.nbytes
+        for f in changed:
+            vals = np.asarray(getattr(host, f))[idx]
+            out[f] = _scatter_rows(
+                getattr(p["tensors"], f), idx_dev, jnp.asarray(vals)
+            )
+            nbytes += vals.nbytes
+        self._static_epoch += 1
+        self._static_journal[self._static_epoch] = rows
+        while len(self._static_journal) > 64:
+            self._static_journal.pop(next(iter(self._static_journal)))
+        stats = self.device_state_stats
+        stats["static_delta_uploads"] += 1
+        stats["static_delta_rows"] += k
+        stats["upload_bytes"] += nbytes
+        if self.telemetry is not None:
+            self.telemetry.on_transfer("h2d", nbytes)
+        idx2 = self._rank_index
+        if idx2 is not None and idx2.valid:
+            if idx2.order().shape[0] == host.available.shape[0]:
+                idx2.update_rows(
+                    np.asarray(host.available), host.name_rank, rows
+                )
+            else:
+                idx2.invalidate()
+        return out
 
     def _resolve_base(self, p) -> bool:
         """Resolve a pooled window's pending committed-base combine (the
@@ -1697,14 +1951,21 @@ class PlacementSolver:
                 and full_node_list
                 and topo is not None
                 and dirty_hint[0] == self._topo_seen
-                and all(n.name in seen for n in dirty_hint[1])
             ):
-                # Update-only node event with a verified version chain
+                # Update-or-ADD node event with a verified version chain
                 # (the feature store captured exactly what changed since
                 # the version this arena last synced to): upsert just the
-                # changed rows. Known names only, so name ranks stand.
+                # changed rows. New names intern and take a GAPPED name
+                # rank between their lexicographic neighbours
+                # (_NameRankSpace) — the node-ADD path never renumbers or
+                # re-walks the existing roster.
+                new_names = [
+                    n.name for n in dirty_hint[1] if n.name not in seen
+                ]
                 for node in dirty_hint[1]:
                     _upsert(node)
+                if new_names:
+                    self._insert_name_ranks(new_names)
                 self._topo_seen = topo
             else:
                 changed_names = False
@@ -1715,11 +1976,7 @@ class PlacementSolver:
                         changed_names = True
                     _upsert(node)
                 if changed_names or self._rank_epoch < 0:
-                    ordered = sorted(seen)
-                    arena.set_name_ranks(
-                        [self.registry.index_of(name) for name in ordered]
-                    )
-                    self._rank_epoch += 1
+                    self._assign_all_name_ranks()
                 if full_node_list and topo is not None:
                     # Only a full-list walk proves the arena is synced for
                     # this version; a filtered subset must not suppress
@@ -1765,15 +2022,72 @@ class PlacementSolver:
         tensors.valid &= request_mask
         return tensors
 
+    def _assign_all_name_ranks(self) -> None:
+        """Full (re)assignment of the arena's name ranks from the sorted
+        known-name set — the cold path, and the gap-exhaustion fallback."""
+        space = self._rank_space
+        space.assign_all(sorted(self._node_seen))
+        index_of = self.registry.index_of
+        idx = np.fromiter(
+            (index_of(name) for name in space.names),
+            np.int64,
+            count=len(space.names),
+        )
+        self._arena.set_name_ranks(np.empty(0, np.int64))  # reset to INF
+        self._arena.set_name_rank_values(
+            idx, np.asarray(space.ranks, np.int32)
+        )
+        self._rank_epoch += 1
+
+    def _insert_name_ranks(self, names: list[str]) -> None:
+        """O(changed) rank insertion for newly-added names; falls back to
+        the full scatter when a gap exhausts (counted on the space)."""
+        space = self._rank_space
+        renumbered = False
+        for name in names:
+            renumbered = space.insert(name) or renumbered
+        if renumbered:
+            index_of = self.registry.index_of
+            idx = np.fromiter(
+                (index_of(name) for name in space.names),
+                np.int64,
+                count=len(space.names),
+            )
+            self._arena.set_name_ranks(np.empty(0, np.int64))
+            self._arena.set_name_rank_values(
+                idx, np.asarray(space.ranks, np.int32)
+            )
+            # Every row's rank value moved: resident order keys are stale.
+            if self._rank_index is not None:
+                self._rank_index.invalidate()
+        else:
+            index_of = self.registry.index_of
+            self._arena.set_name_rank_values(
+                np.asarray([index_of(n) for n in names], np.int64),
+                np.asarray([space.rank_of(n) for n in names], np.int32),
+            )
+        self._rank_epoch += 1
+
     def _dense_or_scatter(self, mapping, pad: int) -> np.ndarray:
         """[pad, 3] int64: a dense array is padded/truncated in one vectorized
         op (rows past `pad` can only be registry-unused zeros); a map is
         scattered entry-by-entry (the fallback path)."""
-        out = np.zeros((pad, NUM_DIMS), dtype=np.int64)
         if isinstance(mapping, np.ndarray):
+            if (
+                mapping.shape[0] == pad
+                and mapping.dtype == np.int64
+                and mapping.flags.c_contiguous
+            ):
+                # Zero-copy fast path: the feature store's resident dense
+                # aggregates already match the pad bucket in steady state,
+                # and every consumer reads without mutating — copying
+                # [N,3] int64 per window was a measured 1M-tier cost.
+                return mapping
+            out = np.zeros((pad, NUM_DIMS), dtype=np.int64)
             rows = min(pad, mapping.shape[0])
             out[:rows] = mapping[:rows]
             return out
+        out = np.zeros((pad, NUM_DIMS), dtype=np.int64)
         for name, res in mapping.items():
             idx = self.registry.index_of(name)
             if idx is not None and idx < pad:
@@ -2023,10 +2337,26 @@ class PlacementSolver:
             if req.domain_mask is not None:
                 dom = np.asarray(req.domain_mask) & valid_np
             elif req.domain_node_names is not None:
-                key = tuple(req.domain_node_names)
+                dom_names = req.domain_node_names
+                # Domain identity key: a digest ticket (extender
+                # _DomainNames / native ingest) keys O(1); small lists
+                # keep the content tuple (cross-object partition dedup);
+                # a huge plain list keys by object identity — building
+                # and hashing a million-name tuple per request was a
+                # measured per-window host cost, and identity keying only
+                # costs the partition plan on equal-content DISTINCT
+                # objects (decisions unaffected — unkeyed windows solve
+                # whole).
+                digest = getattr(dom_names, "names_digest", None)
+                if digest is not None:
+                    key = ("digest", digest)
+                elif len(dom_names) <= 4096:
+                    key = tuple(dom_names)
+                else:
+                    key = ("id", id(dom_names))
                 dom = dom_memo.get(key)
                 if dom is None:
-                    dom = self.candidate_mask(tensors, req.domain_node_names) & valid_np
+                    dom = self.candidate_mask(tensors, dom_names) & valid_np
                     dom_memo[key] = dom
             else:
                 dom = valid_np
@@ -2307,9 +2637,18 @@ class PlacementSolver:
         for prior in handle.priors:
             if prior.placements is not None:
                 base -= prior.placements
-        decisions, placements = self.fallback.window_decisions(
-            handle.strategy, handle.host_tensors, base, handle.requests
-        )
+        if handle.fallback_reason == "prune-escalation":
+            # Correctness machinery with a healthy device: the sibling
+            # re-solve may ride the scale-tier sharded path. Degraded-mode
+            # serving (fallback_reason None) must stay host-side — the
+            # device is exactly what failed.
+            decisions, placements = self._escalation_decisions(
+                handle.strategy, handle.host_tensors, base, handle.requests
+            )
+        else:
+            decisions, placements = self.fallback.window_decisions(
+                handle.strategy, handle.host_tensors, base, handle.requests
+            )
         handle.placements = placements
         d = self.degraded
         if d is not None and handle.fallback_reason is None:
@@ -2580,14 +2919,142 @@ class PlacementSolver:
         self._device_recovered()
         return decisions
 
+    def _escalation_decisions(self, strategy, host, base, requests):
+        """Exact re-solve of a window from host truth — the escalation
+        path's solver. With `solver.scale-tier` on, the re-solve runs as
+        a NODE-SHARDED device solve over the local mesh (parallel/solve
+        node_sharding): the [N] tensors stream across device slots
+        instead of a host-Python O(N x rows) walk, which is what keeps
+        certificate escalations affordable at the million-node tier.
+        Decisions are byte-identical either way — the device kernels ARE
+        the greedy oracle's semantics (golden-parity pinned), and the
+        escalation-parity test pins this seam. Any device failure falls
+        back to the host greedy oracle. Returns (decisions,
+        placements[N,3] int64); `base` is never mutated."""
+        if self._scale_tier and strategy in BATCHABLE_STRATEGIES:
+            try:
+                out = self._scale_tier_decisions(
+                    strategy, host, base, requests
+                )
+                self.scale_tier_stats["resolves"] += 1
+                return out
+            except Exception:
+                self.scale_tier_stats["fallbacks"] += 1
+        return self.fallback.window_decisions(strategy, host, base, requests)
+
+    def _scale_mesh_for(self, n: int):
+        """The ("nodes",) mesh for scale-tier re-solves, over the largest
+        power-of-two local device count dividing `n` (row counts are
+        power-of-two bucketed, so this is all of them in practice).
+        None = one device (unsharded fast path)."""
+        devs = jax.devices()
+        shards = 1
+        while shards * 2 <= len(devs) and n % (shards * 2) == 0:
+            shards *= 2
+        if shards <= 1:
+            return None
+        cached = self._scale_mesh
+        if cached is not None and cached.devices.size == shards:
+            return cached
+        from jax.sharding import Mesh
+
+        self._scale_mesh = Mesh(np.asarray(devs[:shards]), ("nodes",))
+        return self._scale_mesh
+
+    def _scale_tier_decisions(self, strategy, host, base, requests):
+        """One synchronous node-sharded window solve from the exact host
+        reconstruction (`base` = host view at dispatch minus in-flight
+        priors' placements — precisely what the escalated decisions must
+        be computed against)."""
+        n = host.available.shape[0]
+        valid_np = np.asarray(host.valid)
+        flat_rows: list[tuple] = []
+        commit: list[bool] = []
+        reset: list[bool] = []
+        cand_rows: list[np.ndarray] = []
+        dom_rows: list[np.ndarray] = []
+        for req in requests:
+            cand = self.candidate_mask(host, req.driver_candidate_names)
+            if req.domain_mask is not None:
+                dom = np.asarray(req.domain_mask) & valid_np
+            elif req.domain_node_names is not None:
+                dom = (
+                    self.candidate_mask(host, req.domain_node_names)
+                    & valid_np
+                )
+            else:
+                dom = valid_np
+            for j, row in enumerate(req.rows):
+                flat_rows.append(row)
+                commit.append(j == len(req.rows) - 1)
+                reset.append(j == 0)
+                cand_rows.append(cand)
+                dom_rows.append(dom)
+        b = len(flat_rows)
+        drv_arr = np.stack([r[0].as_array() for r in flat_rows])
+        exc_arr = np.stack([r[1].as_array() for r in flat_rows])
+        counts = np.asarray([r[2] for r in flat_rows], np.int32)
+        skip_arr = np.asarray([bool(r[3]) for r in flat_rows])
+        emax = _bucket(max(int(counts.max()), 1), 8)
+        apps = make_app_batch(
+            drv_arr, exc_arr, counts, skippable=skip_arr,
+            pad_to=_bucket(b, 32),
+            driver_cand=np.stack(cand_rows), domain=np.stack(dom_rows),
+            commit=commit, reset=reset,
+        )
+        avail32 = np.clip(base, -INT32_INF, INT32_INF).astype(np.int32)
+        statics_np = cluster_statics(host)
+        mesh = self._scale_mesh_for(n)
+        if mesh is not None:
+            from spark_scheduler_tpu.parallel.solve import (
+                node_sharding,
+                shard_apps,
+            )
+
+            avail_dev = jax.device_put(
+                jnp.asarray(avail32), node_sharding(mesh, 2)
+            )
+            statics_dev = tuple(
+                jax.device_put(
+                    jnp.asarray(np.asarray(f)),
+                    node_sharding(mesh, np.asarray(f).ndim),
+                )
+                for f in statics_np
+            )
+            apps_dev = shard_apps(apps, mesh)
+            self.scale_tier_stats["sharded"] += 1
+        else:
+            avail_dev = jnp.asarray(avail32)
+            statics_dev = tuple(jnp.asarray(np.asarray(f)) for f in statics_np)
+            apps_dev = apps
+        blob, _after = _window_blob_statics(
+            avail_dev, statics_dev, apps_dev,
+            fill=strategy, emax=emax,
+            num_zones=self._num_zones_bucket(),
+        )
+        blob = np.asarray(jax.device_get(blob))
+        drivers = blob[:, 0].astype(np.int64)
+        admitted = blob[:, 1].astype(bool)
+        packed = blob[:, 2].astype(bool)
+        execs = blob[:, 3:].astype(np.int64)
+        base_thread = np.asarray(base).astype(np.int64).copy()
+        placements = np.zeros_like(base_thread)
+        decisions = self._reconstruct_requests(
+            requests, drivers, admitted, packed, execs,
+            drv_arr.astype(np.int64), exc_arr.astype(np.int64), skip_arr,
+            base_thread, placements, np.asarray(host.schedulable),
+        )
+        return decisions, placements
+
     def _escalate_pruned(self, handle, base, reason) -> "list[WindowDecision]":
-        """Failed certificate: re-solve the whole window host-side via the
-        greedy oracle (slot-for-slot the kernels' semantics — pinned by
-        the golden parity suite), so the escalated decisions equal the
-        full-tensor device solve's byte for byte. The poisoned carry and
-        every window dispatched on it are invalidated by
-        _note_prune_escalation."""
-        decisions, placements = self.fallback.window_decisions(
+        """Failed certificate: re-solve the whole window from host truth —
+        host-side via the greedy oracle (slot-for-slot the kernels'
+        semantics — pinned by the golden parity suite), or, under
+        `solver.scale-tier`, as the node-sharded device re-solve — so the
+        escalated decisions equal the full-tensor device solve's byte for
+        byte. The poisoned carry and every window dispatched on it are
+        invalidated by _note_prune_escalation."""
+        decisions, placements = self._escalation_decisions(
             handle.strategy, handle.host_tensors, base, handle.requests
         )
         handle.placements = placements
@@ -2744,7 +3211,10 @@ class PlacementSolver:
             # submit over the single tunnel link.
             _shim("h2d")
             if idx is None:
-                statics = slot.resident_statics(host, epoch, self._clock, tel)
+                statics = slot.resident_statics(
+                    host, epoch, self._clock, tel,
+                    journal=self._static_journal,
+                )
                 sub_avail = slot.place_avail(base)
             elif prune_plan is not None:
                 # Fresh per-window upload of the small gathered statics:
@@ -3242,7 +3712,7 @@ class PlacementSolver:
                         # exact host reconstruction (other partitions are
                         # row-disjoint and stand), then invalidate the
                         # poisoned carry and the windows dispatched on it.
-                        decs, ppl = self.fallback.window_decisions(
+                        decs, ppl = self._escalation_decisions(
                             handle.strategy, handle.host_tensors, base,
                             part.requests,
                         )
@@ -3313,7 +3783,8 @@ class PlacementSolver:
                 epoch = self._static_epoch
                 if part.idx is None:
                     statics = slot.resident_statics(
-                        host, epoch, self._clock, self.telemetry
+                        host, epoch, self._clock, self.telemetry,
+                        journal=self._static_journal,
                     )
                     avail_rows = base
                 elif part.prune is not None:

@@ -146,6 +146,9 @@ def _bind(lib) -> None:
     ]
     lib.arena_remove.argtypes = [ctypes.c_void_p, i64]
     lib.arena_set_name_ranks.argtypes = [ctypes.c_void_p, p(i64), i64]
+    lib.arena_set_name_rank_values.argtypes = [
+        ctypes.c_void_p, p(i64), p(i32), i64,
+    ]
     lib.arena_snapshot.argtypes = [
         ctypes.c_void_p, i64, p(i64), p(i64), p(i32), p(i32), p(i32), p(i32),
         p(i32), p(i32), p(u8), p(u8), p(u8),
@@ -314,6 +317,16 @@ class ClusterArena:
     def set_name_ranks(self, sorted_indices) -> None:
         buf = np.ascontiguousarray(sorted_indices, dtype=np.int64)
         self._lib.arena_set_name_ranks(self._h, _i64p(buf), len(buf))
+
+    def set_name_rank_values(self, indices, ranks) -> None:
+        """Scatter explicit (gapped) rank VALUES onto slots; unlisted
+        slots keep theirs. The O(changed) twin of set_name_ranks — see
+        arena_set_name_rank_values in native/runtime.cpp."""
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        val = np.ascontiguousarray(ranks, dtype=np.int32)
+        self._lib.arena_set_name_rank_values(
+            self._h, _i64p(idx), _i32p(val), len(idx)
+        )
 
     def capacity(self) -> int:
         return int(self._lib.arena_capacity(self._h))

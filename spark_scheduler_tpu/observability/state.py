@@ -140,4 +140,19 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
             # thread would let a concurrent escalation resize it under
             # this snapshot's JSON serialization.
             out["prune"] = {**prune, "reasons": dict(prune["reasons"])}
+        # Million-node tier (ISSUE 11): device-state upload mix (full vs
+        # availability-delta vs static-row-delta, with total bytes) and
+        # the scale-tier escalation re-solve ledger when engaged.
+        dev_state = getattr(solver, "device_state_stats", None)
+        if dev_state is not None:
+            out["device_state"] = dict(dev_state)
+        scale = getattr(solver, "scale_tier_stats", None)
+        if scale is not None and any(scale.values()):
+            out["scale_tier"] = dict(scale)
+    autoscaler = getattr(app, "autoscaler", None)
+    census = getattr(autoscaler, "_census", None)
+    if census is not None:
+        # Control-loop census: the resident node/busy/reserved mirrors the
+        # autoscaler and drainer read instead of per-pass full walks.
+        out["census"] = census.stats()
     return out

@@ -237,6 +237,8 @@ def build_scheduler_app(
         quarantine_probe_s=config.quarantine_probe_s,
         prune_top_k=config.solver_prune_top_k,
         prune_slack=config.solver_prune_slack,
+        delta_statics=config.solver_delta_statics,
+        scale_tier=config.solver_scale_tier,
     )
     recorder = None
     if config.flight_recorder:
@@ -349,8 +351,23 @@ def build_scheduler_app(
             NodeProvisioner,
             ScaleDownDrainer,
         )
+        from spark_scheduler_tpu.autoscaler.provisioner import (
+            PROVISIONED_BY_LABEL,
+            PROVISIONER_NAME,
+        )
+        from spark_scheduler_tpu.core.census import ClusterCensus
         from spark_scheduler_tpu.models.resources import Resources
 
+        # Event-maintained control-loop census: the autoscaler's cluster
+        # size and the drainer's busy/never-drain sets become resident
+        # O(changed) state instead of per-pass full walks — the control
+        # loops' million-node-tier fix (ROADMAP item 4).
+        census = ClusterCensus(
+            backend,
+            rr_cache,
+            soft_store,
+            eligible_label=(PROVISIONED_BY_LABEL, PROVISIONER_NAME),
+        )
         autoscaler = ElasticAutoscaler(
             backend,
             provisioner=NodeProvisioner(
@@ -371,7 +388,9 @@ def build_scheduler_app(
                 soft_store,
                 idle_ttl_s=config.autoscaler_idle_ttl_s,
                 clock=clock,
+                census=census,
             ),
+            census=census,
             max_cluster_size=config.autoscaler_max_cluster_size,
             poll_interval_s=config.autoscaler_poll_interval_s,
             metrics=AutoscalerMetrics(

@@ -175,6 +175,23 @@ class InstallConfig:
     # 0 (the default) = off: the classic full-tensor paths byte-for-byte.
     solver_prune_top_k: int = 0
     solver_prune_slack: float = 2.0
+    # Delta STATIC uploads (`solver.delta-statics`, ISSUE 11): node events
+    # touching few rows ship a row-scatter of the changed static-field
+    # rows to the resident device state (and lagging pool replicas catch
+    # up from the epoch journal) instead of re-uploading the full
+    # multi-MB statics blob per epoch per slot. ON by default — pinned
+    # byte-identical to the full-upload path by the delta-equivalence
+    # suite; false restores full uploads (and the drain-on-any-statics-
+    # change pipeline contract).
+    solver_delta_statics: bool = True
+    # Million-node scale tier (`solver.scale-tier`): certificate
+    # escalations and cold full-tensor re-solves run as a node-sharded
+    # device solve across the local device mesh (parallel/solve
+    # node_sharding) instead of the host-Python greedy walk. Decisions
+    # byte-identical (same kernels; escalation-parity test pinned); any
+    # device failure falls back to the host greedy oracle. OFF by
+    # default — node-axis sharding wants an ICI-class interconnect.
+    solver_scale_tier: bool = False
     # Fused multi-window device dispatch (`solver.fuse-windows`): when the
     # predicate backlog holds more than one window's worth of requests,
     # the batcher claims up to fuse-windows x predicate-max-window of them
@@ -473,6 +490,12 @@ class InstallConfig:
             ),
             solver_prune_slack=float(
                 block_key(solver_block, "prune-slack", 2.0)
+            ),
+            solver_delta_statics=bool(
+                block_key(solver_block, "delta-statics", True)
+            ),
+            solver_scale_tier=bool(
+                block_key(solver_block, "scale-tier", False)
             ),
             runtime_config_path=raw.get("runtime-config-path"),
             jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),

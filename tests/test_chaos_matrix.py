@@ -24,12 +24,16 @@ import pytest
 from spark_scheduler_tpu.testing.soak import ChaosMatrixSoak
 
 MATRIX_STEPS = int(os.environ.get("CHAOS_MATRIX_STEPS", "120"))
+# Roster size of the matrix legs; CHAOS_MATRIX_NODES=1000000 is the
+# million-node family (ISSUE 11).
+MATRIX_NODES = int(os.environ.get("CHAOS_MATRIX_NODES", "12"))
 
 
 @pytest.mark.parametrize("surface", ChaosMatrixSoak.SURFACES)
 def test_chaos_matrix_surface(surface, tmp_path):
     soak = ChaosMatrixSoak(
-        surface, seed=9, wal_path=str(tmp_path / "wal.log")
+        surface, seed=9, n_nodes=MATRIX_NODES,
+        wal_path=str(tmp_path / "wal.log"),
     )
     verdict = soak.run(MATRIX_STEPS)
     # The run itself asserted the invariants; pin that the plan actually

@@ -24,6 +24,9 @@ import pytest
 from spark_scheduler_tpu.testing.soak import Soak
 
 STEPS = int(os.environ.get("ELASTIC_SOAK_STEPS", "400"))
+# Base (static-fleet) roster size; ELASTIC_SOAK_NODES=1000000 is the
+# million-node family (ISSUE 11) — elastic capacity provisions on top.
+NODES = int(os.environ.get("ELASTIC_SOAK_NODES", "10"))
 
 
 @pytest.mark.parametrize(
@@ -31,7 +34,7 @@ STEPS = int(os.environ.get("ELASTIC_SOAK_STEPS", "400"))
 )
 def test_elastic_soak(strategy):
     soak = Soak(
-        np.random.default_rng(20260803), strategy, n_nodes=10, elastic=True
+        np.random.default_rng(20260803), strategy, n_nodes=NODES, elastic=True
     )
     soak.run(STEPS // 2)
     # The elastic loop actually closed: demands were consumed, nodes were

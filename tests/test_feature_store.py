@@ -94,11 +94,21 @@ def test_budget_10k_nodes_steady_state_featurize_is_o_changed():
     # The snapshots carried the commits: reserved rows are non-zero.
     assert snap.usage.any()
 
-    # A NODE event is the only thing that pays the walk — exactly once.
+    # A node ADD rides the append patch (ISSUE 11): the roster grows
+    # without an O(nodes) re-list/re-intern — the rebuild counter stays
+    # flat and the add-patch counter moves instead.
     backend.add_node(new_node("fs-late", zone="zone0"))
     snap2 = store.snapshot()
-    assert store.roster_rebuilds == rebuilds_before + 1
+    assert store.roster_rebuilds == rebuilds_before
+    assert store.roster_add_patches == 1
     assert len(snap2.nodes) == 10_001
+    assert snap2.by_name["fs-late"] is not None
+    # A node DELETE still pays the full rebuild — the one remaining
+    # O(nodes) node event.
+    backend.delete("nodes", "", "fs-late")
+    snap3 = store.snapshot()
+    assert store.roster_rebuilds == rebuilds_before + 1
+    assert len(snap3.nodes) == 10_000
     # Bumps at least once for the roster walk (the re-masked overhead copy
     # may bump it again) — what matters is that the solver's epoch skip is
     # invalidated.

@@ -13,11 +13,14 @@ import pytest
 from spark_scheduler_tpu.testing.soak import HAChaosSoak
 
 CYCLES = int(os.environ.get("HA_CHAOS_CYCLES", "3"))
+# Roster size of the chaos family; HA_CHAOS_NODES=1000000 is the
+# million-node family (ISSUE 11).
+NODES = int(os.environ.get("HA_CHAOS_NODES", "16"))
 
 
 @pytest.mark.parametrize("strategy", ["tightly-pack", "distribute-evenly"])
 def test_ha_chaos_leader_kill_soak(strategy):
-    soak = HAChaosSoak(strategy=strategy, n_nodes=16, ttl_s=2.0)
+    soak = HAChaosSoak(strategy=strategy, n_nodes=NODES, ttl_s=2.0)
     stats = soak.run(cycles=CYCLES, burst=4)
     assert stats["promotions"] == CYCLES
     assert stats["fenced_drops"] >= CYCLES  # every cycle fenced its orphan

@@ -16,6 +16,10 @@ import pytest
 from spark_scheduler_tpu.testing.soak import Soak
 
 STEPS = int(os.environ.get("SOAK_STEPS", "2000"))
+# Roster size of the soak family (ISSUE 11): the default stays tiny for
+# tier-1; the scale-tier CI leg and out-of-band million-node runs raise it
+# (SOAK_NODES=1000000 SOAK_STEPS=60 is the 1M family).
+NODES = int(os.environ.get("SOAK_NODES", "12"))
 
 
 @pytest.mark.parametrize(
@@ -30,7 +34,7 @@ def test_invariant_soak(strategy):
     bench's on-silicon soak). STEPS ops total, invariants swept every
     soak.CHECK_EVERY."""
     rng = np.random.default_rng(20260731)
-    soak = Soak(rng, strategy)
+    soak = Soak(rng, strategy, n_nodes=NODES)
     # Split the budget across the matrix so the default CI run totals
     # ~SOAK_STEPS ops.
     soak.run(STEPS // 3)

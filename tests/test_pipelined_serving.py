@@ -183,15 +183,77 @@ def test_reservation_failure_restores_device_capacity():
     assert not r2[1].node_names  # the node holds exactly one gang
 
 
-def test_topology_change_mid_flight_raises_drain():
-    """Adding a node while a window is un-fetched makes the next pipelined
-    build raise PipelineDrainRequired; after completing the pending window
-    the dispatch succeeds and sees the new node."""
+def test_node_add_mid_flight_rides_the_static_delta():
+    """Adding one node while a window is un-fetched no longer drains the
+    pipeline (ISSUE 11): the changed static rows ship as a row-scatter
+    delta, the in-flight window completes on its dispatch-time view, and
+    the next dispatch sees the new node."""
+    h, node_names = _mk_harness(n_nodes=4)
+    ext = h.extender
+    solver = h.app.solver
+    w1 = [_driver_args(h, f"dr-{i}", 2, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in w1])
+    assert t1.handle is not None
+    h.add_nodes(new_node("late-node", zone="zone0"))
+    w2 = [
+        _driver_args(h, f"dr2-{i}", 2, node_names + ["late-node"])
+        for i in range(2)
+    ]
+    before = solver.device_state_stats["static_delta_uploads"]
+    t2 = ext.predicate_window_dispatch([a for _, a in w2])
+    assert solver.device_state_stats["static_delta_uploads"] > before
+    r1 = ext.predicate_window_complete(t1)
+    assert all(res.node_names for res in r1)
+    r2 = ext.predicate_window_complete(t2)
+    assert all(res.node_names for res in r2)
+    # The new node is genuinely live on the resident state: fill it.
+    _, late_args = _driver_args(h, "on-late", 7, ["late-node"])
+    t3 = ext.predicate_window_dispatch([late_args])
+    r3 = ext.predicate_window_complete(t3)
+    assert r3[0].node_names == ["late-node"]
+
+
+def test_topology_change_mid_flight_raises_drain_when_not_deltable():
+    """A topology change the delta protocol cannot express — here the pad
+    bucket growing, which changes every resident shape (and, with
+    delta-statics disabled, ANY statics change) — still raises
+    PipelineDrainRequired while a window is in flight; after completing
+    the pending window the dispatch succeeds and sees the new nodes."""
     h, node_names = _mk_harness(n_nodes=4)
     ext = h.extender
     w1 = [_driver_args(h, f"dr-{i}", 2, node_names) for i in range(2)]
     t1 = ext.predicate_window_dispatch([a for _, a in w1])
     assert t1.handle is not None
+    # Cross the pad bucket (8): registry grows 4 -> 9 rows, shapes change.
+    late = [new_node(f"late-{j}", zone="zone0") for j in range(5)]
+    h.add_nodes(*late)
+    w2 = [
+        _driver_args(
+            h, f"dr2-{i}", 2, node_names + [n.name for n in late]
+        )
+        for i in range(2)
+    ]
+    try:
+        ext.predicate_window_dispatch([a for _, a in w2])
+        raised = False
+    except PipelineDrainRequired:
+        raised = True
+    assert raised
+    r1 = ext.predicate_window_complete(t1)
+    assert all(res.node_names for res in r1)
+    t2 = ext.predicate_window_dispatch([a for _, a in w2])
+    r2 = ext.predicate_window_complete(t2)
+    assert all(res.node_names for res in r2)
+
+
+def test_statics_change_mid_flight_drains_with_delta_statics_off():
+    """solver.delta-statics=false restores the pre-ISSUE-11 contract:
+    every mid-flight statics change drains."""
+    h, node_names = _mk_harness(n_nodes=4)
+    ext = h.extender
+    h.app.solver._delta_statics = False
+    w1 = [_driver_args(h, f"dr-{i}", 2, node_names) for i in range(2)]
+    t1 = ext.predicate_window_dispatch([a for _, a in w1])
     h.add_nodes(new_node("late-node", zone="zone0"))
     w2 = [
         _driver_args(h, f"dr2-{i}", 2, node_names + ["late-node"])

@@ -1,0 +1,552 @@
+"""The million-node tier (ISSUE 11): O(changed) host paths, delta static
+uploads, and the scale-tier escalation re-solve.
+
+Pinned here:
+
+  - SoftReservationStore.used_soft_reservation_resources() is a memoized
+    IMMUTABLE view maintained incrementally — equal to the reference's
+    per-call walk under churn, same object while nothing changed, and
+    mutation raises (the PR 5 FrozenResources contract);
+  - node-ADD budget: N adds pay ZERO full roster rebuilds (the add-patch
+    path), and the patched roster/tensors equal a from-scratch rebuild —
+    name ranks compared by ORDER (the gapped-rank scheme's only contract);
+  - delta-vs-full static upload equivalence: randomized node churn
+    (add / update / delete) x device pool {1, 2} x {pruned, unpruned},
+    asserting byte-identical decisions AND resident-tensor == host-truth
+    equality after every event;
+  - torn update: a pool replica whose missed epochs left the journal must
+    full re-upload, never scatter against a stale epoch;
+  - ClusterCensus == from-scratch walks under churn, and the census-backed
+    drainer keeps the reservation-refusal rule;
+  - scale-tier escalation re-solve == the host greedy escalation, byte
+    for byte, with the sharded path actually exercised.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.core.census import ClusterCensus
+from spark_scheduler_tpu.core.soft_reservations import SoftReservationStore
+from spark_scheduler_tpu.core.solver import PlacementSolver, WindowRequest
+from spark_scheduler_tpu.models.kube import Node, ZONE_LABEL
+from spark_scheduler_tpu.models.reservations import Reservation
+from spark_scheduler_tpu.models.resources import Resources
+from spark_scheduler_tpu.store.backend import InMemoryBackend
+from spark_scheduler_tpu.store.cache import ResourceReservationCache
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+ONE = Resources.from_quantities("1", "1Gi")
+TWO = Resources.from_quantities("2", "2Gi")
+
+
+# ------------------------------------------------- soft-usage memoized view
+
+
+def _soft_walk_oracle(store: SoftReservationStore) -> dict:
+    out: dict[str, Resources] = {}
+    for sr in store.get_all_copy().values():
+        for r in sr.reservations.values():
+            out.setdefault(r.node, Resources.zero()).add(r.resources)
+    return out
+
+
+def test_soft_usage_view_memoized_immutable():
+    store = SoftReservationStore()
+    store.create_soft_reservation_if_not_exists("app-a")
+    store.add_reservation_for_pod("app-a", "e1", Reservation("n1", ONE.copy()))
+    store.add_reservation_for_pod("app-a", "e2", Reservation("n1", TWO.copy()))
+    view = store.used_soft_reservation_resources()
+    assert dict(view) == _soft_walk_oracle(store)
+    # Memoized: no mutation => the SAME object (zero work per call).
+    assert store.used_soft_reservation_resources() is view
+    # Immutable: the mapping and its values both refuse mutation.
+    with pytest.raises(TypeError):
+        view["n9"] = ONE
+    with pytest.raises(TypeError):
+        view["n1"].add(ONE)
+    with pytest.raises(TypeError):
+        view["n1"].cpu_milli = 0
+    # A mutation invalidates the memo and the new view reflects it.
+    store.remove_executor_reservation("app-a", "e1")
+    view2 = store.used_soft_reservation_resources()
+    assert view2 is not view
+    assert dict(view2) == _soft_walk_oracle(store)
+
+
+def test_soft_usage_view_matches_walk_under_churn():
+    rng = np.random.default_rng(7)
+    store = SoftReservationStore()
+    apps = [f"app-{i}" for i in range(4)]
+    for a in apps:
+        store.create_soft_reservation_if_not_exists(a)
+    live: list[tuple[str, str]] = []
+    for step in range(200):
+        op = rng.random()
+        if op < 0.55 or not live:
+            a = apps[int(rng.integers(0, len(apps)))]
+            pod = f"p{step}"
+            res = Resources(
+                int(rng.integers(0, 4)) * 500, int(rng.integers(1, 4)), 0
+            )
+            store.add_reservation_for_pod(a, pod, Reservation(
+                f"n{int(rng.integers(0, 6))}", res
+            ))
+            live.append((a, pod))
+        elif op < 0.9:
+            a, pod = live.pop(int(rng.integers(0, len(live))))
+            store.remove_executor_reservation(a, pod)
+        else:
+            a = apps[int(rng.integers(0, len(apps)))]
+            store.remove_driver_reservation(a)
+            live = [(x, p) for x, p in live if x != a]
+            store.create_soft_reservation_if_not_exists(a)
+        assert dict(store.used_soft_reservation_resources()) == (
+            _soft_walk_oracle(store)
+        ), f"diverged at step {step}"
+    # A node whose reservations all vanished must drop out of the view —
+    # including the zero-resource ones the refcount (not the sum) tracks.
+    for a, pod in list(live):
+        store.remove_executor_reservation(a, pod)
+    assert dict(store.used_soft_reservation_resources()) == {}
+
+
+# ------------------------------------------------------- node-ADD budget
+
+
+def test_node_add_budget_zero_roster_rebuilds():
+    """N node ADDs after the cold build pay ZERO full roster rebuilds
+    (counter-pinned, the tier-1 budget contract), and the patched state
+    equals a from-scratch rebuild — tensors compared field-exact with
+    name ranks by ORDER."""
+    h = Harness(binpack_algo="tightly-pack", fifo=False)
+    base_nodes = [new_node(f"a{i:03d}", zone=f"zone{i % 2}") for i in range(32)]
+    h.add_nodes(*base_nodes)
+    store = h.app.extender.features
+    store.snapshot()
+    rebuilds_cold = store.roster_rebuilds
+    added = [new_node(f"late{j:02d}", zone=f"zone{j % 2}") for j in range(24)]
+    for j, node in enumerate(added):
+        h.add_nodes(node)
+        snap = store.snapshot()
+        assert len(snap.nodes) == 32 + j + 1
+    assert store.roster_rebuilds == rebuilds_cold, (
+        "a node ADD paid the full roster rebuild"
+    )
+    assert store.roster_add_patches >= 1
+
+    # From-scratch twin on the same backend state: the patched roster and
+    # the rebuilt roster must agree, and both solvers' tensors must match.
+    twin = Harness(
+        binpack_algo="tightly-pack", fifo=False, backend=h.backend
+    )
+    snap_fresh = twin.app.extender.features.snapshot()
+    snap_patched = store.snapshot()
+    assert [n.name for n in snap_patched.nodes] == sorted(
+        (n.name for n in snap_fresh.nodes),
+        key=[n.name for n in snap_patched.nodes].index,
+    )
+    assert set(n.name for n in snap_patched.nodes) == set(
+        n.name for n in snap_fresh.nodes
+    )
+
+    def tensors_of(app, snap):
+        return app.solver.build_tensors(
+            snap.nodes, {}, {}, full_node_list=True,
+            topo_version=snap.nodes_version, roster_rows=snap.roster_rows,
+        )
+
+    ta = tensors_of(h.app, snap_patched)
+    tb = tensors_of(twin.app, snap_fresh)
+    va, vb = np.asarray(ta.valid), np.asarray(tb.valid)
+    # Same live set by NAME (registry row assignment may differ).
+    names_a = {h.app.solver.registry.name_of(i) for i in np.flatnonzero(va)}
+    names_b = {twin.app.solver.registry.name_of(i) for i in np.flatnonzero(vb)}
+    assert names_a == names_b
+    # Per-name field equality + name-rank ORDER equality.
+    rows_a = {h.app.solver.registry.name_of(i): i for i in np.flatnonzero(va)}
+    rows_b = {twin.app.solver.registry.name_of(i): i for i in np.flatnonzero(vb)}
+    for field in ("available", "schedulable", "zone_id", "unschedulable",
+                  "ready"):
+        fa, fb = np.asarray(getattr(ta, field)), np.asarray(getattr(tb, field))
+        for name in names_a:
+            assert np.array_equal(fa[rows_a[name]], fb[rows_b[name]]), (
+                field, name,
+            )
+    ranks_a = np.asarray(ta.name_rank)
+    ranks_b = np.asarray(tb.name_rank)
+    order_a = sorted(names_a, key=lambda n: int(ranks_a[rows_a[n]]))
+    order_b = sorted(names_b, key=lambda n: int(ranks_b[rows_b[n]]))
+    assert order_a == order_b == sorted(names_a)
+
+    # The added capacity is real: a gang lands on a late node.
+    driver = static_allocation_spark_pods("late-gang", 2)[0]
+    h.add_pods(driver)
+    res = h.schedule(driver, ["late23"])
+    assert res.node_names == ["late23"], res
+    h.app.stop()
+    twin.app.stop()
+
+
+# ----------------------------------- delta-vs-full static upload equivalence
+
+
+def _mk_churn_harness(pool, prune, delta):
+    kw = dict(
+        binpack_algo="tightly-pack",
+        fifo=False,
+        solver_delta_statics=delta,
+    )
+    if pool > 1:
+        kw["solver_device_pool"] = pool
+    if prune:
+        kw["solver_prune_top_k"] = prune
+        kw["solver_prune_slack"] = 0.75
+    return Harness(**kw)
+
+
+def _apply_event(h, rng, spare_names, live):
+    """One randomized node event applied to harness `h`; mirrors exactly
+    by seeding both harnesses identically."""
+    op = rng.random()
+    if op < 0.4 and spare_names:
+        name = spare_names.pop()
+        h.add_nodes(new_node(name, zone=f"zone{len(live) % 2}"))
+        live.append(name)
+        return ("add", name)
+    if op < 0.8 and live:
+        name = live[int(rng.integers(0, len(live)))]
+        cur = h.backend.get_node(name)
+        h.backend.update(
+            "nodes",
+            dataclasses.replace(cur, unschedulable=not cur.unschedulable),
+        )
+        return ("update", name)
+    if live:
+        name = live.pop(int(rng.integers(0, len(live))))
+        h.backend.delete("nodes", "", name)
+        return ("delete", name)
+    return ("noop", None)
+
+
+@pytest.mark.parametrize("pool,prune", [(1, 0), (1, 4), (2, 0), (2, 4)])
+def test_delta_vs_full_static_uploads_equivalent(pool, prune):
+    """Randomized node churn x {pool 1,2} x {pruned, unpruned}: the
+    delta-statics solver's decisions are byte-identical to the
+    full-upload solver's after every event, and its resident device
+    tensors equal its own host truth (the mirror invariant delta uploads
+    must preserve)."""
+    n0 = 16
+    h_delta = _mk_churn_harness(pool, prune, True)
+    h_full = _mk_churn_harness(pool, prune, False)
+    for h in (h_delta, h_full):
+        h.add_nodes(*[new_node(f"n{i:02d}", zone=f"zone{i % 2}")
+                      for i in range(n0)])
+    live_d = [f"n{i:02d}" for i in range(n0)]
+    live_f = list(live_d)
+    spare_d = [f"x{j:02d}" for j in range(40, 20, -1)]
+    spare_f = list(spare_d)
+    rng_d = np.random.default_rng(123)
+    rng_f = np.random.default_rng(123)
+    app_seq = iter(range(10_000))
+
+    def serve(h, live):
+        from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+        names = list(live)
+        drivers = []
+        for _ in range(2):
+            d = static_allocation_spark_pods(
+                f"churn-{next(app_seq)}", 2
+            )[0]
+            h.add_pods(d)
+            drivers.append(d)
+        t = h.extender.predicate_window_dispatch(
+            [ExtenderArgs(pod=d, node_names=names) for d in drivers]
+        )
+        return [tuple(r.node_names) for r in
+                h.extender.predicate_window_complete(t)]
+
+    for step in range(14):
+        ev_d = _apply_event(h_delta, rng_d, spare_d, live_d)
+        ev_f = _apply_event(h_full, rng_f, spare_f, live_f)
+        assert ev_d == ev_f  # identical seeded streams
+        # Window IDs must match across harnesses: reset the shared counter
+        # per-step by construction (same sequence consumed on both).
+        app_seq_start = next(app_seq)
+        a = serve(h_delta, live_d)
+        b = serve(h_full, live_f)
+        assert a == b, f"step {step} ({ev_d}): {a} vs {b}"
+        # Resident-tensor == host-truth equality on the delta solver.
+        p = h_delta.app.solver._pipe
+        if p is not None:
+            host = p["host"]
+            from spark_scheduler_tpu.models.cluster import cluster_statics
+
+            for host_f, dev_f in zip(
+                cluster_statics(host), cluster_statics(p["tensors"])
+            ):
+                assert np.array_equal(
+                    np.asarray(host_f), np.asarray(dev_f)
+                ), f"resident statics diverged from host truth at {step}"
+        _ = app_seq_start
+    # The delta path must actually have been exercised.
+    stats = h_delta.app.solver.device_state_stats
+    assert stats["static_delta_uploads"] > 0, stats
+    if pool > 1 and not prune:
+        # With pruning on, eligible windows gather fresh per-window
+        # statics and never touch the resident replica — only the
+        # unpruned pool arm exercises the slot-level delta catch-up.
+        slot_stats = h_delta.app.solver.device_pool_stats()
+        assert any(v.get("delta", 0) > 0 for v in slot_stats.values()), (
+            slot_stats
+        )
+    h_delta.app.stop()
+    h_full.app.stop()
+
+
+def test_torn_static_delta_forces_full_reupload():
+    """A pool replica whose missed epochs are NOT all in the journal must
+    take the full re-upload — a delta applied against a stale epoch would
+    silently skew the resident statics."""
+    import jax
+
+    from spark_scheduler_tpu.core.solver import _PoolSlot
+    from spark_scheduler_tpu.models.cluster import (
+        build_cluster_tensors,
+        cluster_statics,
+        NodeRegistry,
+    )
+
+    reg = NodeRegistry()
+    nodes = [
+        Node(
+            name=f"n{i}",
+            allocatable=Resources.from_quantities("8", "8Gi", "1",
+                                                  round_up=False),
+            labels={ZONE_LABEL: "z0"},
+        )
+        for i in range(8)
+    ]
+    host1 = build_cluster_tensors(nodes, {}, {}, reg, pad_to=8)
+    slot = _PoolSlot(jax.devices()[0])
+    clock = lambda: 0.0  # noqa: E731
+    slot.resident_statics(host1, 1, clock, None)
+    assert slot.uploads == {"full": 1, "delta": 0, "reuse": 0}
+
+    # Epoch 2's rows present in the journal: delta catch-up, and the
+    # resident replica equals the new host statics exactly.
+    nodes2 = [dataclasses.replace(n) for n in nodes]
+    nodes2[3] = dataclasses.replace(nodes2[3], unschedulable=True)
+    host2 = build_cluster_tensors(nodes2, {}, {}, reg, pad_to=8)
+    journal = {2: np.asarray([3])}
+    statics = slot.resident_statics(host2, 2, clock, None, journal=journal)
+    assert slot.uploads["delta"] == 1
+    for host_f, dev_f in zip(cluster_statics(host2), statics):
+        assert np.array_equal(np.asarray(host_f), np.asarray(dev_f))
+
+    # Epoch 3 evicted from the journal (only 4 present): the slot is TORN
+    # — it must full re-upload, not scatter epoch 4 alone.
+    nodes3 = list(nodes2)
+    nodes3[5] = dataclasses.replace(nodes3[5], unschedulable=True)
+    host3 = build_cluster_tensors(nodes3, {}, {}, reg, pad_to=8)
+    statics = slot.resident_statics(
+        host3, 4, clock, None, journal={4: np.asarray([5])}
+    )
+    assert slot.uploads["full"] == 2, slot.uploads
+    for host_f, dev_f in zip(cluster_statics(host3), statics):
+        assert np.array_equal(np.asarray(host_f), np.asarray(dev_f))
+
+
+# --------------------------------------------------------------- census
+
+
+def test_census_matches_walk_oracle_under_churn():
+    rng = np.random.default_rng(31)
+    backend = InMemoryBackend()
+    rr_cache = ResourceReservationCache(backend, sync_writes=True)
+    soft = SoftReservationStore(backend)
+    census = ClusterCensus(backend, rr_cache, soft)
+    from spark_scheduler_tpu.models.reservations import (
+        new_resource_reservation,
+    )
+
+    node_names = []
+    rrs = []
+    for step in range(120):
+        op = rng.random()
+        if op < 0.35:
+            name = f"c{step}"
+            backend.add_node(new_node(name))
+            node_names.append(name)
+        elif op < 0.5 and node_names:
+            backend.delete(
+                "nodes", "",
+                node_names.pop(int(rng.integers(0, len(node_names)))),
+            )
+        elif op < 0.7 and node_names:
+            driver = static_allocation_spark_pods(f"capp-{step}", 1)[0]
+            target = node_names[int(rng.integers(0, len(node_names)))]
+            rr = new_resource_reservation(
+                target, [target], driver, ONE, ONE
+            )
+            if rr_cache.create(rr):
+                rrs.append(rr)
+        elif op < 0.85 and rrs:
+            rr = rrs.pop(int(rng.integers(0, len(rrs))))
+            rr_cache.delete(rr.namespace, rr.name)
+        elif node_names:
+            soft.create_soft_reservation_if_not_exists(f"sapp-{step}")
+            soft.add_reservation_for_pod(
+                f"sapp-{step}", f"sp-{step}",
+                Reservation(
+                    node_names[int(rng.integers(0, len(node_names)))],
+                    ONE.copy(),
+                ),
+            )
+        oracle = ClusterCensus(backend, rr_cache, soft)
+        assert census.node_count() == oracle.node_count(), step
+        assert census.reserved_node_names() == (
+            oracle.reserved_node_names()
+        ), step
+        for name in node_names:
+            assert census.is_busy(name) == oracle.is_busy(name), (
+                step, name,
+            )
+
+
+def test_census_backed_drainer_refuses_reserved_nodes():
+    """The absolute refusal rule survives the census: a node a
+    reservation names is never cordoned, an idle provisioned node drains
+    after a full TTL."""
+    from spark_scheduler_tpu.autoscaler.drainer import ScaleDownDrainer
+    from spark_scheduler_tpu.autoscaler.provisioner import (
+        PROVISIONED_BY_LABEL,
+        PROVISIONER_NAME,
+    )
+    from spark_scheduler_tpu.models.reservations import (
+        new_resource_reservation,
+    )
+
+    backend = InMemoryBackend()
+    rr_cache = ResourceReservationCache(backend, sync_writes=True)
+    soft = SoftReservationStore(backend)
+    census = ClusterCensus(
+        backend, rr_cache, soft,
+        eligible_label=(PROVISIONED_BY_LABEL, PROVISIONER_NAME),
+    )
+    for name in ("idle-1", "busy-1"):
+        n = new_node(name)
+        n.labels[PROVISIONED_BY_LABEL] = PROVISIONER_NAME
+        backend.add_node(n)
+    backend.add_node(new_node("static-1"))  # not provisioned: untouchable
+    driver = static_allocation_spark_pods("keeper", 1)[0]
+    rr_cache.create(
+        new_resource_reservation("busy-1", ["busy-1"], driver, ONE, ONE)
+    )
+    t = [0.0]
+    drainer = ScaleDownDrainer(
+        backend, rr_cache, soft, idle_ttl_s=10.0,
+        clock=lambda: t[0], census=census,
+    )
+    drainer.run_once()  # starts the idle clock
+    t[0] = 11.0
+    drainer.run_once()  # cordons idle-1 only
+    assert backend.get_node("idle-1").unschedulable
+    assert not backend.get_node("busy-1").unschedulable
+    assert not backend.get_node("static-1").unschedulable
+    t[0] = 12.0
+    drained = drainer.run_once()
+    assert drained == ["idle-1"]
+    assert backend.get_node("busy-1") is not None
+    assert backend.get_node("static-1") is not None
+
+
+# ------------------------------------------------ scale-tier escalation
+
+
+def _esc_nodes(n, zones=3):
+    return [
+        Node(
+            name=f"n{i:03d}",
+            allocatable=Resources.from_quantities("8", "8Gi", "1",
+                                                  round_up=False),
+            labels={ZONE_LABEL: f"z{i % zones}"},
+        )
+        for i in range(n)
+    ]
+
+
+def _esc_windows(rng, nodes, k, per):
+    names = [n.name for n in nodes]
+    windows = []
+    for _ in range(k):
+        reqs = []
+        for _ in range(per):
+            rows = []
+            for _ in range(int(rng.integers(0, 3))):
+                rows.append(
+                    (ONE, ONE, int(rng.integers(1, 3)),
+                     bool(rng.random() < 0.5))
+                )
+            res = TWO if rng.random() < 0.3 else ONE
+            rows.append((res, ONE, int(rng.integers(1, 4)), False))
+            reqs.append(
+                WindowRequest(rows=rows, driver_candidate_names=names)
+            )
+        windows.append(reqs)
+    return windows
+
+
+def _esc_run(solver, nodes, batches, usages, strategy):
+    out = []
+    for usage, wins in zip(usages, batches):
+        handles = []
+        for w in wins:
+            t = solver.build_tensors_pipelined(nodes, usage, {})
+            handles.append(solver.pack_window_dispatch(strategy, t, w))
+        for hd in handles:
+            out.extend(solver.pack_window_fetch(hd))
+    return out
+
+
+def test_scale_tier_escalation_matches_host_resolve():
+    """Tight-K pruning forces certificate escalations; with
+    solver.scale-tier the escalated windows re-solve on the node-sharded
+    device path and must equal the host greedy re-solve byte for byte."""
+    rng = np.random.default_rng(9)
+    nodes = _esc_nodes(128)
+    n_batches = 3
+    batches = [_esc_windows(rng, nodes, 2, 4) for _ in range(n_batches)]
+    usages = [{}] * n_batches
+    host_esc = PlacementSolver(
+        use_native=False, prune_top_k=1, prune_slack=0.01
+    )
+    a = _esc_run(host_esc, nodes, batches, usages, "tightly-pack")
+    sharded_esc = PlacementSolver(
+        use_native=False, prune_top_k=1, prune_slack=0.01, scale_tier=True
+    )
+    b = _esc_run(sharded_esc, nodes, batches, usages, "tightly-pack")
+    assert host_esc.prune_stats["escalations"] > 0
+    assert sharded_esc.prune_stats["escalations"] > 0
+    assert a == b
+    assert sharded_esc.scale_tier_stats["resolves"] > 0, (
+        sharded_esc.scale_tier_stats
+    )
+    assert sharded_esc.scale_tier_stats["fallbacks"] == 0, (
+        sharded_esc.scale_tier_stats
+    )
+    # On the 8-device CPU mesh the re-solve really shards the node axis.
+    assert sharded_esc.scale_tier_stats["sharded"] > 0
+
+    # And the full unpruned solve agrees with both (the usual bar).
+    full = _esc_run(
+        PlacementSolver(use_native=False, prune_top_k=0),
+        nodes, batches, usages, "tightly-pack",
+    )
+    assert full == a
