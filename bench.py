@@ -493,15 +493,32 @@ _RTT_FLOOR: dict = {}
 
 
 def _prune_fields(app):
-    """`pruned` + `prune_escalations` on every serving JSON line (ISSUE 10):
-    whether the two-tier solve served any window of this section, and how
-    many windows its soundness certificate escalated to the full re-solve.
-    Default-off configs report {False, 0} — the prune A/B arms live in the
+    """`pruned` + `prune_escalations` on every serving JSON line (ISSUE 10),
+    plus the O(K + changed) planner evidence (ISSUE 12): per-window prune
+    phase means (plan / gather / offset ms), the plan/gather reuse hits,
+    and the planner's rows-scanned ledger. Default-off configs report
+    {False, 0, ...zeros} — the prune A/B arms live in the
     candidate_pruning section (hack/prune_bench.py)."""
     st = getattr(app.solver, "prune_stats", None) or {}
+    windows = max(int(st.get("windows", 0)), 1)
     return {
         "pruned": bool(st.get("windows")),
         "prune_escalations": int(st.get("escalations", 0)),
+        "prune_plan_ms_mean": round(st.get("plan_ms", 0.0) / windows, 4),
+        "prune_gather_ms_mean": round(
+            st.get("gather_ms", 0.0) / windows, 4
+        ),
+        "prune_offset_ms_mean": round(
+            st.get("offset_ms", 0.0) / windows, 4
+        ),
+        "prune_plan_reuse": int(st.get("plan_reuse", 0)),
+        "prune_gather_reuse": int(st.get("gather_reuse", 0)),
+        "prune_planner_rows_scanned": int(
+            st.get("planner_rows_scanned", 0)
+        ),
+        "prune_planner_sweep_rows": int(
+            st.get("planner_sweep_rows", 0)
+        ),
     }
 
 

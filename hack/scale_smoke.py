@@ -6,11 +6,16 @@ one warm-up window to force the cold featurize + full upload, then applies
 a handful of node events and asserts the O(changed) invariants as
 COUNTERS, not timings (no hot-loop timing flakiness):
 
-  - zero full roster rebuilds across the event phase (adds ride the
-    append patch, updates the patch path);
+  - zero full roster rebuilds across the event phase — adds ride the
+    append patch, updates the patch path, AND deletes the tombstone
+    patch (ISSUE 12: `roster_delete_patches` moves, rebuilds do not);
   - per-event state-upload bytes under a fixed ceiling (64 KiB — a full
     1M-node upload is ~40 MB, so an accidental O(N) regression misses the
     ceiling by three orders of magnitude);
+  - the prune planner never sweeps: after the one cold build,
+    `planner_rows_scanned` stays O(K) (zero here — event churn lands on
+    kept rows or merges exactly) and `planner_sweep_rows` stays 0 while
+    every window reuses the plan/gather caches (ISSUE 12);
   - boot (roster ingest + cold featurize + first served window) under a
     wall-clock budget (SCALE_SMOKE_BUDGET_S, default 600 — generous: the
     budget catches quadratic boot regressions, not jitter).
@@ -68,13 +73,28 @@ def main() -> None:
 
     # One warm-up window (in process — the leg smokes the host paths, not
     # HTTP throughput) to force cold featurize + the one full upload.
-    names = [f"s{i:07d}" for i in range(min(N_NODES, 512))]
+    # Candidate names ride ONE identity-keyed ticket (the in-process
+    # analog of the native ingest digest ticket): the full-roster domain
+    # keeps its digest across windows, so the solver's candidate-mask LRU
+    # and the planner's full-domain memo both hit — the serving windows
+    # exercise the O(K + changed) pruned path this smoke pins.
+    class NameTicket(list):
+        __hash__ = object.__hash__
+
+        def __eq__(self, other):
+            return self is other
+
+        @property
+        def names_digest(self):
+            return id(self)
+
+    names = NameTicket(f"s{i:07d}" for i in range(N_NODES))
 
     def serve_one(tag: str) -> None:
         d = static_allocation_spark_pods(f"smoke-{tag}", 2)[0]
         backend.add_pod(d)
         tok = ext.predicate_window_dispatch(
-            [ExtenderArgs(pod=d, node_names=list(names))]
+            [ExtenderArgs(pod=d, node_names=names)]
         )
         res = ext.predicate_window_complete(tok)
         assert res[0].node_names, f"window {tag} failed to place"
@@ -87,6 +107,7 @@ def main() -> None:
 
     store = ext.features
     stats = app.solver.device_state_stats
+    prune = app.solver.prune_stats
     rebuilds_before = store.stats()["roster_rebuilds"]
     bytes_before = stats["upload_bytes"]
     events_before = (
@@ -94,10 +115,21 @@ def main() -> None:
         + stats["delta_uploads"]
         + stats["static_delta_uploads"]
     )
+    # A couple of warm windows so the planner's cold build is behind us,
+    # then pin the O(K) planning claim as counters over the event phase.
+    serve_one("warm0")
+    serve_one("warm1")
+    scanned_before = prune["planner_rows_scanned"]
+    cold_before = prune["planner_cold_rows"]
 
-    # Event phase: 4 adds + 4 updates, one served window each.
+    # Event phase: 4 adds + 4 updates + 4 deletes, one served window
+    # each. Added/deleted/updated nodes all sort OUTSIDE every kept set
+    # (names after the roster's, high indices), so the planner absorbs
+    # them as exact merges/static dirt without a zone re-scan — an add
+    # whose name ranked INSIDE the kept boundary would instead pay one
+    # O(zone) re-scan by design (the kept set must admit it).
     for j in range(4):
-        backend.add_node(new_node(f"late{j:03d}", zone="zone0"))
+        backend.add_node(new_node(f"zlate{j:03d}", zone="zone0"))
         serve_one(f"add{j}")
     for j in range(4):
         name = f"s{N_NODES - 1 - j:07d}"
@@ -107,6 +139,9 @@ def main() -> None:
             dataclasses.replace(cur, unschedulable=not cur.unschedulable),
         )
         serve_one(f"upd{j}")
+    for j in range(4):
+        backend.delete("nodes", "", f"s{N_NODES - 5 - j:07d}")
+        serve_one(f"del{j}")
 
     fs = store.stats()
     assert fs["roster_rebuilds"] == rebuilds_before, (
@@ -114,6 +149,7 @@ def main() -> None:
         "roster rebuilds (O(N) regression)"
     )
     assert fs["roster_add_patches"] >= 4, fs
+    assert fs["roster_delete_patches"] >= 4, fs
     events = (
         stats["full_uploads"]
         + stats["delta_uploads"]
@@ -125,6 +161,22 @@ def main() -> None:
         f"{per_event:.0f} upload bytes/event >= ceiling "
         f"{EVENT_BYTES_CEILING} (O(N) upload regression)"
     )
+    # Planner O(K) invariants (ISSUE 12): no legacy sweep ever ran, the
+    # cold build happened exactly once (before the event phase), and the
+    # event-phase windows re-scanned at most a K-bounded row count —
+    # zero in this synthetic roster: every change merges or is benign.
+    scanned = prune["planner_rows_scanned"] - scanned_before
+    assert prune["planner_sweep_rows"] == 0, prune
+    assert prune["planner_cold_rows"] == cold_before, (
+        "planner re-ran its cold build during the event phase", prune,
+    )
+    rows_budget = 64 * max(prune["windows"], 1)  # O(K), K = top-k bucket
+    assert scanned <= rows_budget, (
+        f"planner scanned {scanned} rows across the event phase "
+        f"(> O(K) budget {rows_budget}: an O(N) sweep regressed in)",
+        prune,
+    )
+    assert prune["plan_reuse"] > 0 and prune["gather_reuse"] > 0, prune
 
     print(
         json.dumps(
@@ -134,6 +186,17 @@ def main() -> None:
                 "boot_s": round(boot_s, 1),
                 "upload_bytes_per_event": round(per_event, 1),
                 "roster_add_patches": fs["roster_add_patches"],
+                "roster_delete_patches": fs["roster_delete_patches"],
+                "planner_rows_scanned_events": scanned,
+                "planner": {
+                    k: prune[k]
+                    for k in (
+                        "windows", "plan_reuse", "gather_reuse",
+                        "planner_rows_scanned", "planner_cold_rows",
+                        "planner_sweep_rows", "planner_zone_rescans",
+                        "planner_merges",
+                    )
+                },
                 "device_state": dict(stats),
             }
         ),

@@ -782,6 +782,16 @@ class SparkSchedulerExtender:
                             if n.name in name_set
                             and not pod_matches_node(pod, n)
                         }
+                        # Deleted nodes (hint[2], ISSUE 12): drop them
+                        # from the cached membership — a delete no longer
+                        # rebuilds the domain cache wholesale.
+                        removed |= {
+                            nm
+                            for nm in (
+                                hint[2] if len(hint) > 2 else ()
+                            )
+                            if nm in name_set
+                        }
                         if added or removed:
                             if removed:
                                 names = _DomainNames(

@@ -74,75 +74,117 @@ class FeatureSnapshot(NamedTuple):
 
 
 class RankIndex:
-    """Incrementally-maintained node priority ordering for the candidate
-    prefilter (core/prune.py — the two-tier solve's tier 1).
+    """Incrementally-maintained PER-ZONE node priority ordering for the
+    candidate prefilter (core/prune.py — the two-tier solve's tier 1).
 
     Keeps every row of the registry index space sorted by the solver's
     within-zone placement key — (available memory asc, cpu asc, name rank,
     row index) — exactly the per-node components of ops/sorting.
-    priority_order. Per-group (per-domain) orderings are served by
-    filtering this global order through the group's row mask: subsetting
-    preserves relative order, so one resident index covers every instance
-    group.
+    priority_order. Since ISSUE 12 the resident structure is one order PER
+    ZONE (zone_id is a static field): the planner's head-walk takes a
+    zone's top-K fitting rows straight off that zone's order head, and a
+    churn-dirty zone re-scans only its own rows instead of re-ranking all
+    N per window. Per-group (per-domain) orderings are served by filtering
+    a zone order through the group's row mask — subsetting preserves
+    relative order.
 
     Maintenance is O(changed) key math like the rest of the store: a
     window's availability deltas touch a handful of rows, which are
-    removed, re-keyed, binary-searched (vectorized lexicographic bisect)
-    and merged back in two linear memcpys — versus a full O(N log N)
-    re-sort per window. Only a roster/statics change (full upload) pays a
-    rebuild.
+    removed from their zone's order, re-keyed, binary-searched (vectorized
+    lexicographic bisect) and merged back in linear memcpys over that
+    zone's rows — versus a full O(N log N) re-sort per window. Only a
+    roster/statics change (full upload) pays a rebuild.
     """
 
     __slots__ = (
-        "_order", "_pos", "_mem", "_cpu", "_name",
-        "rebuilds", "incremental_updates",
+        "_zorders", "_pos", "_zone", "_mem", "_cpu", "_name",
+        "num_zones", "rebuilds", "incremental_updates",
     )
 
     def __init__(self):
-        self._order: np.ndarray | None = None  # [N] int32
-        self._pos: np.ndarray | None = None  # [N] int32 inverse
+        self._zorders: list | None = None  # [Zb] of [n_z] int32 row arrays
+        self._pos: np.ndarray | None = None  # [N] int32 pos within zone order
+        self._zone: np.ndarray | None = None  # [N] int32
         self._mem: np.ndarray | None = None  # [N] int64 key snapshots
         self._cpu: np.ndarray | None = None
         self._name: np.ndarray | None = None
+        self.num_zones = 0
         self.rebuilds = 0
         self.incremental_updates = 0
 
     def invalidate(self) -> None:
-        self._order = None
+        self._zorders = None
 
     @property
     def valid(self) -> bool:
-        return self._order is not None
+        return self._zorders is not None
 
-    def rebuild(self, avail: np.ndarray, name_rank: np.ndarray) -> None:
+    @property
+    def rows(self) -> int:
+        return 0 if self._mem is None or not self.valid else int(
+            self._mem.shape[0]
+        )
+
+    def rebuild(
+        self,
+        avail: np.ndarray,
+        name_rank: np.ndarray,
+        zone_id: np.ndarray,
+        num_zones: int,
+    ) -> None:
         n = avail.shape[0]
         self._mem = avail[:, 1].astype(np.int64)  # MEM_DIM
         self._cpu = avail[:, 0].astype(np.int64)  # CPU_DIM
         self._name = np.asarray(name_rank).astype(np.int64)
+        self._zone = np.asarray(zone_id).astype(np.int32)
+        self.num_zones = int(num_zones)
         rows = np.arange(n)
-        self._order = np.lexsort(
+        order = np.lexsort(
             (rows, self._name, self._cpu, self._mem)
         ).astype(np.int32)
+        # Split the global order by zone (stable: relative order within a
+        # zone is the zone's priority order) and invert to per-zone
+        # positions in one pass.
+        zo = self._zone[order]
+        self._zorders = [
+            order[zo == z] for z in range(self.num_zones)
+        ]
         self._pos = np.empty(n, np.int32)
-        self._pos[self._order] = np.arange(n, dtype=np.int32)
+        for zorder in self._zorders:
+            self._pos[zorder] = np.arange(len(zorder), dtype=np.int32)
         self.rebuilds += 1
 
     def update_rows(
-        self, avail: np.ndarray, name_rank: np.ndarray, dirty: np.ndarray
+        self, avail: np.ndarray, name_rank: np.ndarray, dirty: np.ndarray,
+        zone_id: np.ndarray | None = None,
     ) -> None:
-        """Re-key `dirty` rows against the new availability and merge them
-        back into the resident order. Callers guarantee the static fields
-        (name ranks, roster) are unchanged — the pipelined builder's delta
-        path proves exactly that before calling."""
-        if self._order is None or self._order.shape[0] != avail.shape[0]:
-            self.rebuild(avail, name_rank)
-            return
+        """Re-key `dirty` rows against the new availability (and zone, when
+        a statics row-delta moved one) and merge them back into their
+        zones' resident orders. Cost: O(changed + affected-zone memcpy)."""
+        if (
+            self._zorders is None
+            or self._mem.shape[0] != avail.shape[0]
+        ):
+            raise RuntimeError("update_rows on an invalid index")
         d = np.unique(np.asarray(dirty))
         if d.size == 0:
             return
-        keep = np.ones(self._order.shape[0], bool)
-        keep[self._pos[d]] = False
-        clean = self._order[keep]
+        new_zone = (
+            self._zone[d]
+            if zone_id is None
+            else np.asarray(zone_id)[d].astype(np.int32)
+        )
+        old_zone = self._zone[d]
+        touched = np.unique(np.concatenate([old_zone, new_zone]))
+        # Remove the dirty rows from their OLD zones' orders.
+        for z in touched:
+            zorder = self._zorders[z]
+            rm = d[old_zone == z]
+            if rm.size:
+                keep = np.ones(len(zorder), bool)
+                keep[self._pos[rm]] = False
+                self._zorders[z] = zorder[keep]
+        # Re-key.
         self._mem[d] = avail[d, 1]
         self._cpu[d] = avail[d, 0]
         # Re-key the name component too: a statics row-delta (node ADD
@@ -150,12 +192,19 @@ class RankIndex:
         # ranks without a roster rebuild — unchanged rows re-assign
         # their existing value (a no-op).
         self._name[d] = np.asarray(name_rank)[d]
-        ds = d[np.lexsort((d, self._name[d], self._cpu[d], self._mem[d]))]
-        pos = self._bisect(clean, ds)
-        self._order = np.insert(clean, pos, ds)
-        self._pos[self._order] = np.arange(
-            self._order.shape[0], dtype=np.int32
-        )
+        self._zone[d] = new_zone
+        # Merge into the NEW zones' orders and re-number their positions.
+        for z in touched:
+            ins = d[new_zone == z]
+            clean = self._zorders[z]
+            if ins.size:
+                ds = ins[np.lexsort(
+                    (ins, self._name[ins], self._cpu[ins], self._mem[ins])
+                )]
+                pos = self._bisect(clean, ds)
+                clean = np.insert(clean, pos, ds)
+                self._zorders[z] = clean
+            self._pos[clean] = np.arange(len(clean), dtype=np.int32)
         self.incremental_updates += 1
 
     def _bisect(self, clean: np.ndarray, rows: np.ndarray) -> np.ndarray:
@@ -166,6 +215,8 @@ class RankIndex:
         mem, cpu, name = self._mem, self._cpu, self._name
         rm, rc, rn = mem[rows], cpu[rows], name[rows]
         n = clean.shape[0]
+        if n == 0:
+            return np.zeros(rows.shape[0], np.int64)
         lo = np.zeros(rows.shape[0], np.int64)
         hi = np.full(rows.shape[0], n, np.int64)
         # Classic lower-bound bisection, all lanes in lockstep; log2(n)+1
@@ -173,7 +224,7 @@ class RankIndex:
         for _ in range(max(1, int(np.ceil(np.log2(n + 1))) + 1)):
             active = lo < hi
             mid = (lo + hi) // 2
-            m = clean[np.minimum(mid, n - 1)]
+            m = clean[np.minimum(mid, max(n - 1, 0))]
             less = (mem[m] < rm) | (
                 (mem[m] == rm)
                 & (
@@ -188,15 +239,30 @@ class RankIndex:
             hi = np.where(active & ~less, mid, hi)
         return lo
 
+    def zone_order(self, z: int) -> np.ndarray:
+        """Zone z's rows in priority order (treat as read-only)."""
+        return self._zorders[z]
+
     def order(self) -> np.ndarray:
-        """The resident global order (treat as read-only)."""
-        return self._order
+        """The GLOBAL priority order, merged from the zone orders — an
+        O(N log N) reconstruction for oracles/tests; the serving planner
+        only ever walks zone orders."""
+        parts = [z for z in self._zorders if len(z)]
+        if not parts:
+            return np.empty(0, np.int32)
+        rows = np.concatenate(parts)
+        return rows[np.lexsort(
+            (rows, self._name[rows], self._cpu[rows], self._mem[rows])
+        )].astype(np.int32)
 
     def stats(self) -> dict:
         return {
             "rebuilds": self.rebuilds,
             "incremental_updates": self.incremental_updates,
-            "rows": 0 if self._order is None else int(self._order.shape[0]),
+            "rows": self.rows,
+            "zones": 0 if not self.valid else sum(
+                1 for z in self._zorders if len(z)
+            ),
         }
 
 
@@ -212,11 +278,19 @@ class HostFeatureStore:
         self._node_pos: dict[str, int] = {}  # name -> position in _nodes
         self._roster_topo: Optional[int] = None
         self._roster_dirty = True
-        # Delete (or racy) events force the full O(nodes) rebuild;
-        # update-only and add-only bursts ride the patch paths below.
+        # Racy/unknown-name events force the full O(nodes) rebuild;
+        # update, add AND delete bursts ride the patch paths below
+        # (deletes since ISSUE 12: swap-remove + live-mask clear +
+        # registry-row tombstone instead of the full re-list).
         self._dirty_full = True
         self._dirty_updates: dict[str, Any] = {}  # name -> newest Node
         self._dirty_adds: dict[str, Any] = {}  # name -> added Node
+        self._dirty_deletes: dict[str, Any] = {}  # name -> deleted Node
+        # Deleted-but-still-interned registry rows (the solver recycles
+        # them through its tombstone release once their usage drains);
+        # past the ratio threshold ONE full rebuild re-compacts the
+        # roster structures.
+        self._tombstones = 0
         self._roster_rows: Optional[np.ndarray] = None
         self._dirty_hint: Optional[tuple] = None
         self._statics_epoch = 0
@@ -237,6 +311,7 @@ class HostFeatureStore:
         self.roster_rebuilds = 0
         self.roster_patches = 0
         self.roster_add_patches = 0
+        self.roster_delete_patches = 0
         self.usage_refreshes = 0
         self.overhead_refreshes = 0
         overhead_computer.attach_registry(registry)
@@ -253,10 +328,31 @@ class HostFeatureStore:
 
     # -- events ---------------------------------------------------------------
 
-    def _on_node_delete(self, *_args) -> None:
+    def _on_node_delete(self, node=None, *_args) -> None:
+        """Node DELETEs ride the patch path too (ISSUE 12 satellite: a
+        single deleted node used to trigger the full re-list + re-intern
+        + arena walk): the deleted Node is captured here, and the next
+        snapshot swap-removes it from the roster structures and clears
+        its live-mask row in O(changed) — the registry row tombstones
+        (the solver recycles it via the delta-statics journal once its
+        usage drains). Unknown names are racy: full rebuild."""
         with self._lock:
             self._roster_dirty = True
-            self._dirty_full = True
+            if self._dirty_full:
+                return
+            name = getattr(node, "name", None)
+            if name is None:
+                self._dirty_full = True
+            elif name in self._dirty_adds:
+                # Added then deleted within one burst: net no-op.
+                del self._dirty_adds[name]
+            elif name in self._dirty_deletes:
+                pass  # duplicate delivery of a pending delete: no-op
+            elif name in self._node_pos:
+                self._dirty_updates.pop(name, None)
+                self._dirty_deletes[name] = node
+            else:
+                self._dirty_full = True
 
     def _on_node_add(self, new) -> None:
         """Node ADDs ride their own patch path (ISSUE 11 satellite: a
@@ -277,7 +373,11 @@ class HostFeatureStore:
         with self._lock:
             self._roster_dirty = True
             if not self._dirty_full:
-                if new.name in self._dirty_adds:
+                if new.name in self._dirty_deletes:
+                    # Deleted then touched again within one burst: racy
+                    # replay — rebuild.
+                    self._dirty_full = True
+                elif new.name in self._dirty_adds:
                     # Added then updated within one burst: the add entry
                     # carries the newest object.
                     self._dirty_adds[new.name] = new
@@ -331,9 +431,21 @@ class HostFeatureStore:
             self._roster_dirty or topo is None or topo != self._roster_topo
         ):
             return
+        if self._dirty_deletes and self._tombstones >= max(
+            64, len(self._nodes) // 8
+        ):
+            # Tombstone-ratio threshold: too many deleted-but-interned
+            # rows accumulated — pay ONE full rebuild to re-compact the
+            # roster structures instead of patching forever.
+            self._dirty_full = True
+            self._tombstones = 0
         can_patch = (
             not self._dirty_full
-            and (self._dirty_updates or self._dirty_adds)
+            and (
+                self._dirty_updates
+                or self._dirty_adds
+                or self._dirty_deletes
+            )
             and topo is not None
             and self._roster_topo is not None
         )
@@ -341,14 +453,46 @@ class HostFeatureStore:
             prev = self._roster_topo
             updates = self._dirty_updates
             adds = self._dirty_adds
+            deletes = self._dirty_deletes
             self._dirty_updates = {}
             self._dirty_adds = {}
+            self._dirty_deletes = {}
             nodes = list(self._nodes)
             by_name = dict(self._by_name)
             pos = self._node_pos
             for name, node in updates.items():
                 nodes[pos[name]] = node
                 by_name[name] = node
+            if deletes:
+                # DELETE patch (ISSUE 12, O(changed)): swap-remove each
+                # deleted node (the last roster entry fills its hole, so
+                # only ONE position shifts per delete), clear its
+                # live-mask row (the overhead copy re-masks on its next
+                # refresh), and drop its registry row from roster_rows —
+                # the row itself stays interned as a TOMBSTONE until the
+                # solver recycles it. The existing roster is never
+                # re-listed or re-interned.
+                rows_arr = np.array(self._roster_rows)
+                mask = self._roster_mask
+                for name in deletes:
+                    i = pos.pop(name)
+                    by_name.pop(name, None)
+                    last = len(nodes) - 1
+                    row = rows_arr[i]
+                    if i != last:
+                        nodes[i] = nodes[last]
+                        rows_arr[i] = rows_arr[last]
+                        pos[nodes[i].name] = i
+                    nodes.pop()
+                    rows_arr = rows_arr[:last]
+                    if mask is not None and 0 <= row < mask.shape[0]:
+                        mask[row] = False
+                rows_arr = rows_arr.copy()
+                rows_arr.flags.writeable = False
+                self._roster_rows = rows_arr
+                self._overhead_version = None  # re-mask on next refresh
+                self._tombstones += len(deletes)
+                self.roster_delete_patches += 1
             if adds:
                 # APPEND path (node-ADD, O(changed)): new names intern in
                 # one bulk call, the registry-row array and live-row mask
@@ -380,8 +524,13 @@ class HostFeatureStore:
             self._by_name = by_name
             self._roster_topo = topo
             self._roster_dirty = False
+            # 3-tuple since ISSUE 12: (base version, changed Nodes,
+            # deleted names) — consumers that predate deletes index [0]
+            # and [1] unchanged.
             self._dirty_hint = (
-                prev, tuple(updates.values()) + tuple(adds.values()),
+                prev,
+                tuple(updates.values()) + tuple(adds.values()),
+                tuple(deletes),
             )
             self._statics_epoch += 1
             self._epoch += 1
@@ -398,6 +547,8 @@ class HostFeatureStore:
         self._dirty_full = raced
         self._dirty_updates = {}
         self._dirty_adds = {}
+        self._dirty_deletes = {}
+        self._tombstones = 0
         self._dirty_hint = None
         # Rebuild the live-row mask (we are already on the O(nodes) path)
         # and force the overhead copy to re-mask against it. One bulk
@@ -460,6 +611,8 @@ class HostFeatureStore:
                 "roster_rebuilds": self.roster_rebuilds,
                 "roster_patches": self.roster_patches,
                 "roster_add_patches": self.roster_add_patches,
+                "roster_delete_patches": self.roster_delete_patches,
+                "tombstones": self._tombstones,
                 "usage_refreshes": self.usage_refreshes,
                 "overhead_refreshes": self.overhead_refreshes,
                 "nodes": len(self._nodes),

@@ -7,10 +7,11 @@ solve makes the device program O(K):
   Tier 1 (host prefilter, this module): rank the window domain's nodes by
   the solver's own placement key — the priority order the kernels sort by,
   (zone rank, available mem asc, cpu asc, name rank) — riding the
-  feature-rank index's resident order (core/feature_store.RankIndex), and
-  gather the top-K candidate rows per zone, K sized from the window's
-  aggregate demand x `solver.prune-slack`. The device then solves a [K,3]
-  gathered sub-cluster with one small h2d instead of shipping [B,N] masks.
+  feature-rank index's resident PER-ZONE orders (core/feature_store.
+  RankIndex), and gather the top-K candidate rows per zone, K sized from
+  the window's aggregate demand x `solver.prune-slack`. The device then
+  solves a [K,3] gathered sub-cluster with one small h2d instead of
+  shipping [B,N] masks.
 
   Tier 2 (the certificate, also this module): soundness is ENFORCED, not
   assumed. After the pruned solve, `certify_window` replays the window's
@@ -44,6 +45,31 @@ excluded rows overestimate fit, candidate masks are ignored for excluded
 driver checks, and any uncertainty (a prior window's placement landing on
 an excluded row, a non-kept index in the blob) escalates outright.
 
+O(K + changed) planning (ISSUE 12). The planner used to pay O(N) host
+sweeps per window (per-zone bincounts, excluded-row sums, per-zone maxima
+over N−K rows) even when nothing outside the kept rows moved between
+windows. `PrunePlanner` retires them:
+
+  - per-zone availability TOTALS live in resident, event-maintained
+    aggregates (core/zone_aggregates.ZoneAggregates — the census/
+    soft-mirror pattern), so a window's `zone_base` excluded sums derive
+    as `total − Σ kept` in O(K);
+  - the top-K kept rows, the excluded lexmin keys and the excluded
+    per-dim maxima are CACHED per zone and reused while the zone's
+    excluded rows are untouched. The cache is sound by construction:
+    every certificate input about excluded rows depends only on excluded
+    rows, so churn confined to the kept rows (gang placements — the
+    steady serving case) reuses the entry verbatim; a newly-valid row
+    (node ADD) merges in exactly (min/max/flag updates are exact for a
+    set gaining a member); ANY other change touching a zone's excluded
+    rows re-scans just that zone's order (O(zone), counted);
+  - consequently a no-churn window re-serves the identical kept row set
+    (`plan_reuse`), which is what keys the solver's statics-gather reuse.
+
+Subset-domain windows (a shared non-default domain) take the legacy
+vectorized sweep (`sweep_rows` counts them); the pooled partition path
+prunes per-partition the same way.
+
 Gating (checked by the solver before planning): plain fills only (the
 single-AZ wrappers score zones by subset-dependent efficiencies), no
 configured label priorities (the keys above assume the label rank is
@@ -55,6 +81,7 @@ is uniform by construction).
 from __future__ import annotations
 
 import dataclasses
+import time as _time
 
 import numpy as np
 
@@ -65,6 +92,7 @@ PLAIN_FILLS = frozenset(
 )
 
 _I64_MAX = np.iinfo(np.int64).max
+_I64_MIN = np.iinfo(np.int64).min
 
 
 def _bucket(n: int, minimum: int) -> int:
@@ -77,7 +105,9 @@ def _bucket(n: int, minimum: int) -> int:
 def _zone_sum(zones: np.ndarray, vals: np.ndarray, zb: int) -> np.ndarray:
     """Exact per-zone int64 sums. bincount accumulates in float64 —
     exact while |sum| < 2^53, guaranteed for < 2^22 int32 rows (2^22 x
-    2^31/2 = 2^52); larger row sets take the exact-but-slow np.add.at."""
+    2^31/2 = 2^52); larger row sets take the exact-but-slow np.add.at.
+    (The resident-aggregate fast path never calls this — only the
+    subset-domain sweep does.)"""
     if vals.size >= (1 << 22):
         out = np.zeros(zb, np.int64)
         np.add.at(out, zones, vals.astype(np.int64))
@@ -134,8 +164,9 @@ class PrunePlan:
     device zone-sum offsets, and the excluded-row summaries the
     certificate tests against. All arrays are host numpy."""
 
-    keep: np.ndarray  # [Kp] int32 — kept global rows, real first, padded
-    k_real: int  # number of real kept rows (padding repeats keep[0])
+    keep: np.ndarray  # [Kp] int32 — kept global rows, real part SORTED
+    #                     ascending, padding repeats keep[0]
+    k_real: int  # number of real kept rows
     kept_mask: np.ndarray  # [N] bool
     dom_mask: np.ndarray  # [N] bool — window domain & valid
     num_zones: int  # the solver's zone bucket Zb
@@ -150,6 +181,8 @@ class PrunePlan:
     # Excluded-row summaries, per zone, over rows RELEVANT to this window
     # (rows fitting the window's per-dim minimum demand; rows that fit no
     # request are provably transparent — zero capacity, no driver fit).
+    # e_cnt_* is consumed as a PRESENCE flag (> 0) by the certificate; the
+    # resident-cache fast path stores 0/1.
     e_cnt_exec: np.ndarray  # [Zb] int64 — relevant excluded exec-eligible
     e_max_exec: np.ndarray  # [Zb,3] int64 — per-dim avail max (conservative fit)
     e_key_exec: np.ndarray  # [Zb,3] int64 — lexmin (mem,cpu,name), I64_MAX pad
@@ -159,151 +192,686 @@ class PrunePlan:
     # Per-request driver candidate masks gathered onto the kept rows.
     cand_kept: list  # [B_req] of [Kp] bool
     dom_rows: int  # |domain| (stats)
+    # True when the kept row set (`keep` array object) was re-served from
+    # the per-zone cache unchanged — the key for the solver's
+    # statics-gather reuse.
+    reused: bool = False
+    plan_ms: float = 0.0  # prefilter planning wall time
+    offset_ms: float = 0.0  # zone_base offset derivation wall time
 
 
-def plan_window_prune(
-    host,
-    *,
-    order: np.ndarray,  # RankIndex order: all rows sorted by (mem,cpu,name)
-    dom_mask: np.ndarray,  # [N] bool — shared window domain, already & valid
-    cand_per_req: list,  # per-request [N] bool driver candidate masks
-    drv_arr: np.ndarray,  # [B,3] i32 — per flat row
-    exc_arr: np.ndarray,  # [B,3] i32
-    counts: np.ndarray,  # [B] i32
-    num_zones: int,
-    top_k: int,
-    slack: float,
-) -> PrunePlan | None:
-    """Build the window's pruning plan, or None when pruning cannot help
-    (the kept set would cover most of the domain anyway)."""
-    avail = np.asarray(host.available)
-    zone_id = np.asarray(host.zone_id)
-    n = avail.shape[0]
+class _ZoneEntry:
+    """Cached per-zone prefilter state: the kept rows and the excluded-row
+    summaries for one zone. An excluded-row change keeps the entry SOUND
+    by merging the row's new state (exact-direction: min/max/presence
+    can only extend) while the old contribution lingers as a
+    conservative leftover; `stale` counts those leftovers so the zone
+    re-scans before conservatism drifts into spurious escalations."""
 
-    # Per-dim minimum demand over every flat row (hypotheticals included):
-    # a row that cannot fit this vector cannot host any driver/executor of
-    # the window, so it is provably transparent to every choice the kernel
-    # makes (zero capacity for every request, driver fit false) — only its
-    # zone-sum contribution matters, and that ships as the device offset.
-    min_dr = drv_arr.min(axis=0)
-    min_er = exc_arr.min(axis=0)
-
-    exec_elig = (
-        dom_mask
-        & ~np.asarray(host.unschedulable, bool)
-        & np.asarray(host.ready, bool)
+    __slots__ = (
+        "kept_e", "kept_d", "keep", "has_e", "has_d",
+        "key_e", "key_d", "max_e", "max_d", "stale", "depleted",
+        "last_key_e", "last_key_d",
     )
-    fit_e = (avail >= min_er[None, :]).all(axis=1) & exec_elig
-    fit_d = (avail >= min_dr[None, :]).all(axis=1) & dom_mask
 
-    b = drv_arr.shape[0]
-    demand = int(counts.sum()) + b
-    k_per_zone = max(int(top_k), int(np.ceil(demand * slack)))
+    def __init__(self, kept_e, kept_d, has_e, has_d, key_e, key_d,
+                 max_e, max_d, last_key_e=None, last_key_d=None):
+        self.kept_e = kept_e
+        self.kept_d = kept_d
+        self.keep = np.unique(np.concatenate([kept_e, kept_d]))
+        self.has_e = has_e
+        self.has_d = has_d
+        self.key_e = key_e  # int64[3] lexmin (mem, cpu, name) or I64_MAX
+        self.key_d = key_d
+        self.max_e = max_e  # int64[3] per-dim max or I64_MIN
+        self.max_d = max_d
+        self.stale = 0
+        # Kept rows whose availability dropped below the window minima:
+        # still sound to keep (the kernel just skips them), but a zone
+        # whose kept set depletes while fresh excluded capacity sits
+        # outside WILL eventually fail the certificate (the full solve
+        # would place there) — refresh the entry before that costs an
+        # escalation.
+        self.depleted = 0
+        # Key of the K-th (worst) kept row per class at build time — the
+        # kept-set BOUNDARY. A merged row whose key beats it would have
+        # been kept by a fresh selection (e.g. a node ADD whose name
+        # sorts before the roster's): the entry re-scans instead of
+        # parking a top-K row in the excluded summaries, where the next
+        # placement in the zone would escalate. None = the zone kept
+        # every fitting row, so ANY new fitting row belongs in the set.
+        self.last_key_e = last_key_e
+        self.last_key_d = last_key_d
 
-    # Top-K PER ZONE of the priority order, separately for executor-capable
-    # and driver-capable rows: a per-zone prefix stays a prefix under any
-    # zone-rank permutation, so mid-window zone-rank drift cannot promote
-    # an excluded row past a kept one within its zone.
-    fo = order[fit_e[order]]
-    do = order[fit_d[order]]
-    # Per-zone domain counts via bincount (zone ids are < num_zones by
-    # construction): np.unique sorts N values — a measured per-window
-    # host cost at the million-node tier.
-    zb = num_zones
-    dom_zcnt = (
-        np.bincount(zone_id[dom_mask], minlength=zb)
-        if dom_mask.any()
-        else np.zeros(zb, np.int64)
-    )
-    zids = np.flatnonzero(dom_zcnt)
-    sel: list[np.ndarray] = []
-    for z in zids:
-        sel.append(fo[zone_id[fo] == z][:k_per_zone])
-        sel.append(do[zone_id[do] == z][:k_per_zone])
-    kept_mask = np.zeros(n, dtype=bool)
-    if sel:
-        kept_mask[np.concatenate(sel)] = True
-    keep = np.flatnonzero(kept_mask).astype(np.int32)
-    k_real = len(keep)
-    dom_rows = int(dom_mask.sum())
-    if k_real == 0 or k_real >= 0.7 * dom_rows:
-        return None  # pruning buys nothing on this window
 
-    excl = dom_mask & ~kept_mask
-    e_rows = np.flatnonzero(excl)
-    e_zone = zone_id[e_rows]
+def _key_lt(a, b) -> bool:
+    """Lexicographic (mem, cpu, name) triple compare."""
+    for x, y in zip(a, b):
+        if x != y:
+            return x < y
+    return False
 
-    # Device zone-sum offsets: ALL excluded domain rows (relevant or not).
-    # bincount-with-weights accumulates in float64 — exact for |sum| <
-    # 2^53, i.e. any cluster under ~4M int32 rows (guarded); np.add.at is
-    # an order of magnitude slower at 1M rows.
-    s_mem = _zone_sum(e_zone, avail[e_rows, MEM_DIM], zb)
-    s_cpu = _zone_sum(e_zone, avail[e_rows, CPU_DIM], zb)
-    present = dom_zcnt > 0
 
-    # Whole-domain dispatch sums = kept sums + excluded sums.
-    zone_mem = s_mem.copy()
-    zone_cpu = s_cpu.copy()
-    kept_avail = avail[keep].astype(np.int64)
-    kept_zone = zone_id[keep]
-    np.add.at(zone_mem, kept_zone, kept_avail[:, MEM_DIM])
-    np.add.at(zone_cpu, kept_zone, kept_avail[:, CPU_DIM])
+class PrunePlanner:
+    """O(K + changed) window planning over resident per-zone state.
 
-    name_rank = np.asarray(host.name_rank).astype(np.int64)
+    Owns the per-zone RankIndex (priority orders), the ZoneAggregates
+    (availability totals) and the per-zone plan cache. The solver feeds it
+    the EXACT changed rows it already knows (pipelined-build delta rows,
+    static row-deltas, fetched placement rows); a serving path that cannot
+    name its rows marks the planner UNKNOWN and the next sync pays one
+    vectorized snapshot compare instead.
+    """
 
-    def _summaries(rel_mask: np.ndarray):
-        rows = np.flatnonzero(rel_mask & excl)
-        rz = zone_id[rows]
-        cnt = np.bincount(rz, minlength=zb).astype(np.int64)
-        mx = np.full((zb, avail.shape[1]), np.iinfo(np.int64).min, np.int64)
-        # Per-zone maxima: one vectorized pass per present zone (zones
-        # are few) instead of np.maximum.at's per-element inner loop.
+    def __init__(self, stats: dict | None = None):
+        from spark_scheduler_tpu.core.feature_store import RankIndex
+        from spark_scheduler_tpu.core.zone_aggregates import ZoneAggregates
+
+        self.index = RankIndex()
+        self.agg = ZoneAggregates()
+        self._entries: dict[int, _ZoneEntry] = {}
+        self._min_dr: np.ndarray | None = None  # int64[3] at last full build
+        self._min_er: np.ndarray | None = None
+        self._k = 0
+        self._keep: np.ndarray | None = None  # assembled padded keep
+        self._keep_real = 0
+        # Pending change feed (drained at sync): explicit dirty rows,
+        # static-delta rows, or None = unknown (snapshot compare).
+        self._dirty: list | None = []
+        self._static: list = []
+        self.stats = stats if stats is not None else {}
+        for key in (
+            "planner_rows_scanned", "planner_cold_rows",
+            "planner_sweep_rows", "planner_resync_rows",
+            "planner_zone_rescans", "planner_merges", "plan_reuse",
+        ):
+            self.stats.setdefault(key, 0)
+
+    # -- change feed ---------------------------------------------------------
+
+    def invalidate(self) -> None:
+        self.index.invalidate()
+        self.agg.invalidate()
+        self._entries.clear()
+        self._keep = None
+        self._min_dr = None  # next build is COLD (counter attribution)
+        self._min_er = None
+        self._k = 0
+        self._dirty = []
+        self._static = []
+
+    def note_dirty(self, rows) -> None:
+        """Rows whose availability changed (exact — pipelined build deltas,
+        fetched placement rows)."""
+        if self._dirty is not None and len(rows):
+            self._dirty.append(np.asarray(rows))
+
+    def note_static(self, rows) -> None:
+        """Rows whose STATIC fields changed (static row-delta: validity,
+        zone, name rank, eligibility flags)."""
+        if len(rows):
+            self._static.append(np.asarray(rows))
+
+    def mark_unknown(self) -> None:
+        """A serving path touched availability without naming rows (dense
+        unpruned fetch): the next sync diff-scans the snapshots."""
+        self._dirty = None
+
+    # -- sync ----------------------------------------------------------------
+
+    def sync(self, host, num_zones: int) -> None:
+        """Bring the resident index/aggregates/cache up to the CURRENT
+        host view, in O(changed) when the change feed is exact."""
+        avail = np.asarray(host.available)
+        zid = np.asarray(host.zone_id)
+        valid = np.asarray(host.valid)
+        name_rank = np.asarray(host.name_rank)
+        n = avail.shape[0]
+        if (
+            not self.index.valid
+            or not self.agg.valid
+            or self.index.rows != n
+            or self.index.num_zones != num_zones
+        ):
+            self._rebuild(avail, name_rank, zid, valid, num_zones)
+            return
+        if self._dirty is None:
+            dirty = self.agg.diff_rows(avail)
+            self.stats["planner_resync_rows"] += n
+        else:
+            dirty = (
+                np.unique(np.concatenate(self._dirty))
+                if self._dirty
+                else np.empty(0, np.int64)
+            )
+        static = (
+            np.unique(np.concatenate(self._static))
+            if self._static
+            else np.empty(0, np.int64)
+        )
+        self._dirty = []
+        self._static = []
+        if dirty.size == 0 and static.size == 0:
+            return
+        all_dirty = (
+            np.union1d(dirty, static) if static.size else dirty
+        )
+        if all_dirty.size > max(1024, n // 4):
+            self._rebuild(avail, name_rank, zid, valid, num_zones)
+            return
+        self._classify(all_dirty, static, avail, zid, valid, host)
+        self.index.update_rows(avail, name_rank, all_dirty, zone_id=zid)
+        self.agg.update_rows(avail, zid, valid, all_dirty)
+
+    def _rebuild(self, avail, name_rank, zid, valid, num_zones) -> None:
+        self.index.rebuild(avail, name_rank, zid, num_zones)
+        self.agg.rebuild(avail, zid, valid, num_zones)
+        self._entries.clear()
+        self._keep = None
+        self._dirty = []
+        self._static = []
+
+    # Conservative-leftover budget per zone entry: each absorbed
+    # excluded-row change leaves the row's OLD contribution behind in the
+    # per-zone summaries (sound, but it can only over-approximate); past
+    # this many leftovers the zone re-scans to restore exactness before
+    # the drift causes spurious escalations.
+    _STALE_BUDGET = 32
+
+    def _classify(self, all_dirty, static, avail, zid, valid, host) -> None:
+        """Absorb the changed rows into the per-zone cache, BEFORE the
+        snapshots move:
+
+          benign  — a non-static change to a KEPT row: the excluded-row
+                    summaries depend only on excluded rows, so the entry
+                    stands verbatim (the steady-serving case: gang
+                    placements land on kept rows);
+          merge   — any change to a NON-KEPT row (node add/update/delete,
+                    external usage churn, eligibility flips): the row's
+                    NEW state merges exactly (joining a summary can only
+                    extend min/max/presence), while its old contribution
+                    lingers as a conservative leftover — sound by the
+                    certificate's over-approximation contract. Leftovers
+                    are budgeted (`_STALE_BUDGET`) per zone;
+          rescan  — a STATIC flip on a kept row (validity/zone/rank of a
+                    kept row breaks the `total − kept` offset identity)
+                    or an exhausted leftover budget: drop the zone's
+                    entry; the next plan re-scans just that zone.
+        """
+        if not self._entries:
+            return
+        if all_dirty.size > 4096:
+            # A bulk churn burst (resync after a dense fetch, a huge
+            # delta): dropping every entry is cheaper and exact — the
+            # next plan re-scans the zones it needs.
+            self._entries.clear()
+            self._keep = None
+            return
+        old_zone = self.agg.zone_of(all_dirty)
+        new_zone = zid[all_dirty].astype(np.int32)
+        was_valid = self.agg.valid_of(all_dirty)
+        is_static = (
+            np.isin(all_dirty, static) if static.size else
+            np.zeros(all_dirty.shape[0], bool)
+        )
+        unsched = np.asarray(host.unschedulable, bool)
+        ready = np.asarray(host.ready, bool)
+        name_rank = np.asarray(host.name_rank)
+        for i, r in enumerate(all_dirty):
+            oz, nz = int(old_zone[i]), int(new_zone[i])
+            entry = self._entries.get(nz)
+            in_keep = False
+            if entry is not None and entry.keep.size:
+                p = np.searchsorted(entry.keep, r)
+                in_keep = bool(
+                    p < entry.keep.size and entry.keep[p] == r
+                )
+            if in_keep:
+                if not is_static[i]:
+                    # Benign: kept-row value churn. But track DEPLETION —
+                    # a kept row that no longer fits either class minimum
+                    # is dead weight, and a zone serving mostly-depleted
+                    # kept rows while fresh excluded capacity exists will
+                    # fail its certificate; refresh first.
+                    av = avail[r]
+                    if self._min_dr is not None and not (
+                        (av >= self._min_dr).all()
+                        or (av >= self._min_er).all()
+                    ):
+                        entry.depleted += 1
+                        # Aggressive on purpose: a zone serving depleted
+                        # kept rows ranks FIRST (lowest totals), so the
+                        # full solve would reach for its excluded rows
+                        # almost immediately — one O(zone) re-scan is
+                        # far cheaper than the escalation it prevents.
+                        if entry.depleted > max(1, self._k // 8):
+                            self._entries.pop(nz, None)
+                            self._keep = None
+                    continue
+                # Static flip (validity/zone/rank) of a KEPT row: the
+                # offset identity needs every kept row live — re-scan.
+                self._entries.pop(nz, None)
+                self._keep = None
+                continue
+            # Non-kept row: merge its new state (exact direction), note
+            # the leftover. A zone move leaves its old zone's summaries
+            # as leftovers too.
+            if oz != nz:
+                old_entry = self._entries.get(oz)
+                if old_entry is not None:
+                    kp = old_entry.keep
+                    p = np.searchsorted(kp, r) if kp.size else 0
+                    if kp.size and p < kp.size and kp[p] == r:
+                        # The moved row was KEPT under its old zone: the
+                        # old entry's offset identity is broken — re-scan.
+                        self._entries.pop(oz, None)
+                        self._keep = None
+                    else:
+                        old_entry.stale += 1
+                        if old_entry.stale > self._STALE_BUDGET:
+                            self._entries.pop(oz, None)
+                            self._keep = None
+            if entry is None:
+                continue
+            if bool(valid[r]) and self._merge_row(
+                entry, int(r), avail, unsched, ready, name_rank
+            ):
+                # The row beats the kept boundary: a fresh selection
+                # would keep it — re-scan the zone.
+                self._entries.pop(nz, None)
+                self._keep = None
+                continue
+            if not was_valid[i]:
+                # A brand-new valid row (node ADD) merged EXACTLY — it
+                # has no old contribution, so no leftover to budget.
+                continue
+            entry.stale += 1
+            if entry.stale > self._STALE_BUDGET:
+                self._entries.pop(nz, None)
+                self._keep = None
+
+    def _merge_row(
+        self, entry, r, avail, unsched, ready, name_rank
+    ) -> bool:
+        """Merge one non-kept row's NEW state into the zone entry.
+        Returns True when the row BEATS the kept-set boundary — a fresh
+        selection would have kept it, so the caller must drop the entry
+        (re-scan) instead of parking a top-K row among the excluded."""
+        av = avail[r].astype(np.int64)
+        key = (
+            int(avail[r, MEM_DIM]),
+            int(avail[r, CPU_DIM]),
+            int(name_rank[r]),
+        )
+        if (av >= self._min_dr).all():
+            if entry.last_key_d is None or _key_lt(key, entry.last_key_d):
+                return True
+            entry.has_d = True
+            if _key_lt(key, entry.key_d):
+                entry.key_d = key
+            entry.max_d = np.maximum(entry.max_d, av)
+        if (av >= self._min_er).all() and not unsched[r] and ready[r]:
+            if entry.last_key_e is None or _key_lt(key, entry.last_key_e):
+                return True
+            entry.has_e = True
+            if _key_lt(key, entry.key_e):
+                entry.key_e = key
+            entry.max_e = np.maximum(entry.max_e, av)
+        self.stats["planner_merges"] += 1
+        return False
+
+    # -- planning ------------------------------------------------------------
+
+    def plan_full_domain(
+        self, host, *, cand_per_req, drv_arr, exc_arr, counts,
+        num_zones, top_k, slack,
+    ) -> PrunePlan | None:
+        """O(K + changed) plan for a window whose shared domain is the
+        full valid mask (the resident aggregates' coverage)."""
+        t0 = _time.perf_counter()
+        avail = np.asarray(host.available)
+        valid = np.asarray(host.valid)
+        zid = np.asarray(host.zone_id)
+        b = drv_arr.shape[0]
+        min_dr = drv_arr.min(axis=0).astype(np.int64)
+        min_er = exc_arr.min(axis=0).astype(np.int64)
+        demand = int(counts.sum()) + b
+        # Power-of-two bucketed K: keeps the per-zone cache (and the kept
+        # row set) stable across window-demand jitter at the cost of at
+        # most 2x extra kept rows.
+        k = _bucket(max(int(top_k), int(np.ceil(demand * slack))), 1)
+        agg = self.agg
+        # Cache-key drift: a LOWER per-dim minimum demand or a LARGER K
+        # widens the relevant-row sets, which the cached excluded
+        # summaries cannot soundly describe — full re-scan.
+        # COLD = building from nothing (first plan, or right after an
+        # invalidate — invalidate() resets the cached minima). Everything
+        # else (K/minima widening, churn-dropped entries) counts as rows
+        # SCANNED, so the CI O(K) assertion sees every incremental sweep.
+        cold = self._min_dr is None
+        if cold or (
+            k > self._k
+            or (min_dr < self._min_dr).any()
+            or (min_er < self._min_er).any()
+        ):
+            self._entries.clear()
+            self._keep = None
+            self._min_dr = min_dr
+            self._min_er = min_er
+            self._k = k
+        counter = "planner_cold_rows" if cold else "planner_rows_scanned"
+        unsched = np.asarray(host.unschedulable, bool)
+        ready = np.asarray(host.ready, bool)
+        name_rank = np.asarray(host.name_rank)
+        zones = np.flatnonzero(agg.cnt > 0)
+        changed = self._keep is None
+        for z in zones:
+            if int(z) not in self._entries:
+                self._rescan_zone(
+                    int(z), avail, valid, unsched, ready, name_rank,
+                    counter,
+                )
+                changed = True
+        dom_rows = int(agg.cnt.sum())
+        if changed:
+            keeps = [
+                self._entries[int(z)].keep
+                for z in zones
+                if int(z) in self._entries
+            ]
+            keep_real = (
+                np.sort(np.concatenate(keeps)).astype(np.int32)
+                if keeps
+                else np.empty(0, np.int32)
+            )
+            k_real = int(keep_real.shape[0])
+            if k_real == 0 or k_real >= 0.7 * dom_rows:
+                self._keep = None
+                return None
+            kp = _bucket(k_real, 64)
+            keep_padded = np.full(kp, keep_real[0], np.int32)
+            keep_padded[:k_real] = keep_real
+            self._keep = keep_padded
+            self._keep_real = k_real
+        else:
+            keep_padded = self._keep
+            k_real = self._keep_real
+            if k_real == 0 or k_real >= 0.7 * dom_rows:
+                return None
+            self.stats["plan_reuse"] += 1
+        keep_real_v = keep_padded[:k_real]
+
+        # Assemble the certificate's per-zone summary arrays from the
+        # entries (Zb is small).
+        zb = num_zones
+        e_cnt_e = np.zeros(zb, np.int64)
+        e_cnt_d = np.zeros(zb, np.int64)
+        e_max_e = np.full((zb, avail.shape[1]), _I64_MIN, np.int64)
+        e_max_d = np.full((zb, avail.shape[1]), _I64_MIN, np.int64)
+        e_key_e = np.full((zb, 3), _I64_MAX, np.int64)
+        e_key_d = np.full((zb, 3), _I64_MAX, np.int64)
+        for z in zones:
+            entry = self._entries.get(int(z))
+            if entry is None:
+                continue
+            if entry.has_e:
+                e_cnt_e[z] = 1
+                e_max_e[z] = entry.max_e
+                e_key_e[z] = entry.key_e
+            if entry.has_d:
+                e_cnt_d[z] = 1
+                e_max_d[z] = entry.max_d
+                e_key_d[z] = entry.key_d
+
+        # Offsets: excluded sums = resident totals − Σ kept, O(K).
+        t1 = _time.perf_counter()
+        kept_avail = avail[keep_real_v].astype(np.int64)
+        kz = zid[keep_real_v]
+        kept_mem = np.zeros(zb, np.int64)
+        kept_cpu = np.zeros(zb, np.int64)
+        np.add.at(kept_mem, kz, kept_avail[:, MEM_DIM])
+        np.add.at(kept_cpu, kz, kept_avail[:, CPU_DIM])
+        s_mem = agg.mem - kept_mem
+        s_cpu = agg.cpu - kept_cpu
+        present = agg.cnt > 0
+        mem_hi, mem_lo = split_zone_sums(s_mem)
+        cpu_hi, cpu_lo = split_zone_sums(s_cpu)
+        t2 = _time.perf_counter()
+
+        kept_mask = np.zeros(avail.shape[0], dtype=bool)
+        kept_mask[keep_real_v] = True
+        return PrunePlan(
+            keep=keep_padded,
+            k_real=k_real,
+            kept_mask=kept_mask,
+            dom_mask=valid,
+            num_zones=zb,
+            zone_base=(mem_hi, mem_lo, cpu_hi, cpu_lo, present),
+            zone_mem=agg.mem.copy(),
+            zone_cpu=agg.cpu.copy(),
+            present=present,
+            e_cnt_exec=e_cnt_e,
+            e_max_exec=e_max_e,
+            e_key_exec=e_key_e,
+            e_cnt_drv=e_cnt_d,
+            e_max_drv=e_max_d,
+            e_key_drv=e_key_d,
+            cand_kept=[np.asarray(c)[keep_padded] for c in cand_per_req],
+            dom_rows=dom_rows,
+            reused=not changed,
+            plan_ms=(t2 - t0) * 1e3,
+            offset_ms=(t2 - t1) * 1e3,
+        )
+
+    def _rescan_zone(
+        self, z, avail, valid, unsched, ready, name_rank, counter,
+    ) -> None:
+        """Exact per-zone prefilter state from the zone's resident order:
+        first K fitting rows per class, the first fitting row beyond them
+        (the excluded lexmin by construction — the order IS sorted by the
+        key), and the per-dim maxima over the rest."""
+        zo = self.index.zone_order(z)
+        self.stats[counter] += int(zo.shape[0])
+        self.stats["planner_zone_rescans"] += 1
+        rows = zo[valid[zo]]
+        k = self._k
+        if not rows.size:
+            self._entries[z] = _ZoneEntry(
+                np.empty(0, np.int32), np.empty(0, np.int32),
+                False, False,
+                (_I64_MAX,) * 3, (_I64_MAX,) * 3,
+                np.full(avail.shape[1], _I64_MIN, np.int64),
+                np.full(avail.shape[1], _I64_MIN, np.int64),
+            )
+            return
         av = avail[rows]
-        for z in np.flatnonzero(cnt):
-            mx[z] = av[rz == z].max(axis=0)
-        # The priority order IS sorted by (mem, cpu, name): the first
-        # relevant excluded row of each zone in order is that zone's lexmin
-        # key — no per-window sort. First-occurrence per zone via argmax
-        # on the present zones (np.unique sorts N values — measured at
-        # the 1M tier); zones are few.
-        key = np.full((zb, 3), _I64_MAX, np.int64)
-        ro = order[(rel_mask & excl)[order]]
-        rzo = zone_id[ro]
-        for z in np.flatnonzero(cnt):
-            fr = ro[int(np.argmax(rzo == z))]
-            key[z, 0] = avail[fr, MEM_DIM]
-            key[z, 1] = avail[fr, CPU_DIM]
-            key[z, 2] = name_rank[fr]
-        return cnt, mx, key
+        fit_d = (av >= self._min_dr).all(axis=1)
+        fit_e = (
+            (av >= self._min_er).all(axis=1)
+            & ~unsched[rows]
+            & ready[rows]
+        )
+        sel_e = np.flatnonzero(fit_e)
+        sel_d = np.flatnonzero(fit_d)
+        kept_e = rows[sel_e[:k]].astype(np.int32)
+        kept_d = rows[sel_d[:k]].astype(np.int32)
+        # Excluded = fitting rows beyond the UNION of both classes' kept
+        # prefixes (a row kept for the exec class is kept, full stop —
+        # the legacy sweep's excl semantics, which the exactness oracle
+        # pins): the first such row in order is the class's lexmin key.
+        un = np.zeros(rows.shape[0], bool)
+        un[sel_e[:k]] = True
+        un[sel_d[:k]] = True
 
-    e_cnt_exec, e_max_exec, e_key_exec = _summaries(fit_e)
-    e_cnt_drv, e_max_drv, e_key_drv = _summaries(fit_d)
+        def _class(sel):
+            rel = sel[~un[sel]]
+            if rel.size:
+                first = rows[rel[0]]
+                key = (
+                    int(avail[first, MEM_DIM]),
+                    int(avail[first, CPU_DIM]),
+                    int(name_rank[first]),
+                )
+                mx = av[rel].max(axis=0).astype(np.int64)
+                return True, key, mx
+            return (
+                False, (_I64_MAX,) * 3,
+                np.full(avail.shape[1], _I64_MIN, np.int64),
+            )
 
-    kp = _bucket(k_real, 64)
-    keep_padded = np.full(kp, keep[0], np.int32)
-    keep_padded[:k_real] = keep
+        has_e, key_e, max_e = _class(sel_e)
+        has_d, key_d, max_d = _class(sel_d)
 
-    mem_hi, mem_lo = split_zone_sums(s_mem)
-    cpu_hi, cpu_lo = split_zone_sums(s_cpu)
-    return PrunePlan(
-        keep=keep_padded,
-        k_real=k_real,
-        kept_mask=kept_mask,
-        dom_mask=dom_mask,
-        num_zones=zb,
-        zone_base=(mem_hi, mem_lo, cpu_hi, cpu_lo, present),
-        zone_mem=zone_mem,
-        zone_cpu=zone_cpu,
-        present=present,
-        e_cnt_exec=e_cnt_exec,
-        e_max_exec=e_max_exec,
-        e_key_exec=e_key_exec,
-        e_cnt_drv=e_cnt_drv,
-        e_max_drv=e_max_drv,
-        e_key_drv=e_key_drv,
-        cand_kept=[np.asarray(c)[keep_padded] for c in cand_per_req],
-        dom_rows=dom_rows,
-    )
+        def _last_key(sel):
+            if sel.size < k:
+                return None  # every fitting row kept: new rows belong in
+            last = rows[sel[k - 1]]
+            return (
+                int(avail[last, MEM_DIM]),
+                int(avail[last, CPU_DIM]),
+                int(name_rank[last]),
+            )
+
+        self._entries[z] = _ZoneEntry(
+            kept_e, kept_d, has_e, has_d, key_e, key_d, max_e, max_d,
+            last_key_e=_last_key(sel_e), last_key_d=_last_key(sel_d),
+        )
+
+    # -- subset domains (legacy sweep) --------------------------------------
+
+    def plan_with_masks(
+        self, host, *, dom_mask, cand_per_req, drv_arr, exc_arr, counts,
+        num_zones, top_k, slack,
+    ) -> PrunePlan | None:
+        """The pre-ISSUE-12 vectorized O(N) planner, kept for windows whose
+        shared domain is a SUBSET of the cluster (instance-group pinned
+        domains): the resident aggregates cover the full valid mask only.
+        Counted in `planner_sweep_rows`."""
+        t0 = _time.perf_counter()
+        avail = np.asarray(host.available)
+        zone_id = np.asarray(host.zone_id)
+        n = avail.shape[0]
+        self.stats["planner_sweep_rows"] += n
+
+        min_dr = drv_arr.min(axis=0)
+        min_er = exc_arr.min(axis=0)
+        exec_elig = (
+            dom_mask
+            & ~np.asarray(host.unschedulable, bool)
+            & np.asarray(host.ready, bool)
+        )
+        fit_e = (avail >= min_er[None, :]).all(axis=1) & exec_elig
+        fit_d = (avail >= min_dr[None, :]).all(axis=1) & dom_mask
+
+        b = drv_arr.shape[0]
+        demand = int(counts.sum()) + b
+        k_per_zone = max(int(top_k), int(np.ceil(demand * slack)))
+
+        zb = num_zones
+        dom_zcnt = (
+            np.bincount(zone_id[dom_mask], minlength=zb)
+            if dom_mask.any()
+            else np.zeros(zb, np.int64)
+        )
+        zids = np.flatnonzero(dom_zcnt)
+        name_rank = np.asarray(host.name_rank)
+        # Per-zone top-K off the zone's resident order, separately for
+        # executor-capable and driver-capable rows: a per-zone prefix
+        # stays a prefix under any zone-rank permutation, so mid-window
+        # zone-rank drift cannot promote an excluded row past a kept one
+        # within its zone.
+        sel: list[np.ndarray] = []
+        per_zone: dict[int, tuple] = {}
+        for z in zids:
+            zo = self.index.zone_order(int(z))
+            fo = zo[fit_e[zo]]
+            do = zo[fit_d[zo]]
+            per_zone[int(z)] = (fo, do)
+            sel.append(fo[:k_per_zone])
+            sel.append(do[:k_per_zone])
+        kept_mask = np.zeros(n, dtype=bool)
+        if sel:
+            kept_mask[np.concatenate(sel)] = True
+        keep = np.flatnonzero(kept_mask).astype(np.int32)
+        k_real = len(keep)
+        dom_rows = int(dom_mask.sum())
+        if k_real == 0 or k_real >= 0.7 * dom_rows:
+            return None  # pruning buys nothing on this window
+
+        excl = dom_mask & ~kept_mask
+        e_rows = np.flatnonzero(excl)
+        e_zone = zone_id[e_rows]
+
+        # Device zone-sum offsets: ALL excluded domain rows.
+        s_mem = _zone_sum(e_zone, avail[e_rows, MEM_DIM], zb)
+        s_cpu = _zone_sum(e_zone, avail[e_rows, CPU_DIM], zb)
+        present = dom_zcnt > 0
+
+        # Whole-domain dispatch sums = kept sums + excluded sums.
+        zone_mem = s_mem.copy()
+        zone_cpu = s_cpu.copy()
+        kept_avail = avail[keep].astype(np.int64)
+        kept_zone = zone_id[keep]
+        np.add.at(zone_mem, kept_zone, kept_avail[:, MEM_DIM])
+        np.add.at(zone_cpu, kept_zone, kept_avail[:, CPU_DIM])
+
+        def _summaries(which: int):
+            cnt = np.zeros(zb, np.int64)
+            mx = np.full((zb, avail.shape[1]), _I64_MIN, np.int64)
+            key = np.full((zb, 3), _I64_MAX, np.int64)
+            for z, orders in per_zone.items():
+                zo = orders[which]
+                rel = zo[excl[zo]]  # relevant excluded, in priority order
+                if not rel.size:
+                    continue
+                cnt[z] = rel.size
+                mx[z] = avail[rel].max(axis=0)
+                fr = rel[0]  # first in order == the zone's lexmin key
+                key[z, 0] = avail[fr, MEM_DIM]
+                key[z, 1] = avail[fr, CPU_DIM]
+                key[z, 2] = name_rank[fr]
+            return cnt, mx, key
+
+        e_cnt_exec, e_max_exec, e_key_exec = _summaries(0)
+        e_cnt_drv, e_max_drv, e_key_drv = _summaries(1)
+
+        kp = _bucket(k_real, 64)
+        keep_padded = np.full(kp, keep[0], np.int32)
+        keep_padded[:k_real] = keep
+
+        t1 = _time.perf_counter()
+        mem_hi, mem_lo = split_zone_sums(s_mem)
+        cpu_hi, cpu_lo = split_zone_sums(s_cpu)
+        t2 = _time.perf_counter()
+        return PrunePlan(
+            keep=keep_padded,
+            k_real=k_real,
+            kept_mask=kept_mask,
+            dom_mask=dom_mask,
+            num_zones=zb,
+            zone_base=(mem_hi, mem_lo, cpu_hi, cpu_lo, present),
+            zone_mem=zone_mem,
+            zone_cpu=zone_cpu,
+            present=present,
+            e_cnt_exec=e_cnt_exec,
+            e_max_exec=e_max_exec,
+            e_key_exec=e_key_exec,
+            e_cnt_drv=e_cnt_drv,
+            e_max_drv=e_max_drv,
+            e_key_drv=e_key_drv,
+            cand_kept=[np.asarray(c)[keep_padded] for c in cand_per_req],
+            dom_rows=dom_rows,
+            reused=False,
+            plan_ms=(t2 - t0) * 1e3,
+            offset_ms=(t2 - t1) * 1e3,
+        )
+
+    def index_stats(self) -> dict:
+        return {
+            "index": self.index.stats(),
+            "aggregates": self.agg.stats(),
+            "cached_zones": len(self._entries),
+        }
 
 
 def certify_window(
@@ -317,45 +885,66 @@ def certify_window(
     execs: np.ndarray,  # [B, Emax] int64 GLOBAL indices
     drv64: np.ndarray,  # [B, 3] int64 per-row driver request
     exc64: np.ndarray,  # [B, 3] int64 per-row executor request
-    base: np.ndarray,  # [N, 3] int64 — EXACT dispatch base (host view minus
-    #                     in-flight priors' placements); NOT mutated
+    base_kept: np.ndarray,  # [k_real, 3] int64 — EXACT dispatch base on the
+    #                     kept rows (host view minus in-flight priors'
+    #                     placements); OWNED by the certificate (mutated)
     host,  # host ClusterTensors view at dispatch
     prior_rows: np.ndarray,  # rows any in-flight prior placed on (global)
+    prior_deltas: np.ndarray,  # [len(prior_rows), 3] int64 — the priors'
+    #                     summed placements on those rows
 ) -> tuple[bool, str | None]:
     """Replay the window's availability thread and certify that the pruned
     solve's decisions equal the full solve's. Returns (ok, reason) —
-    reason names the first failed test (telemetry label)."""
+    reason names the first failed test (telemetry label).
+
+    O(K + rows) since ISSUE 12: every input is either per-kept-row or
+    per-zone — the [N]-shaped lut/base of the original implementation is
+    gone (the caller gathers `base_kept` on the kept rows)."""
     # The device offsets assumed excluded rows kept their host-view
     # availability; a prior window's placement on an excluded row breaks
     # that (the plan was built before the prior's placements were known).
     # Rows outside the window domain are transparent to every choice
     # (masked from eligibility and zone sums alike), so only domain rows
     # are tested.
-    prior_rows = prior_rows[plan.dom_mask[prior_rows]]
+    in_dom = plan.dom_mask[prior_rows]
+    prior_rows = prior_rows[in_dom]
+    prior_deltas = prior_deltas[in_dom]
     if prior_rows.size and not plan.kept_mask[prior_rows].all():
         return False, "prior-placed-excluded"
 
     zone_id = np.asarray(host.zone_id)
-    name_rank = np.asarray(host.name_rank).astype(np.int64)
-    keep = plan.keep[: plan.k_real]
-    lut = np.full(zone_id.shape[0], -1, np.int32)
-    lut[keep] = np.arange(plan.k_real, dtype=np.int32)
+    name_rank = np.asarray(host.name_rank)
+    keep = plan.keep[: plan.k_real]  # sorted ascending
+
+    def to_local(g: np.ndarray) -> np.ndarray:
+        """Global rows -> kept-local indices, -1 for non-kept."""
+        p = np.searchsorted(keep, g)
+        pc = np.clip(p, 0, keep.size - 1)
+        return np.where(
+            (g >= 0) & (keep[pc] == g), pc, -1
+        ).astype(np.int64)
+
+    # Hoisted once for the whole window: the per-row loop below only
+    # indexes into these (the old [N] lut without the [N] allocation).
+    drivers_local = to_local(drivers)
+    execs_local = to_local(execs)
 
     k_zone = zone_id[keep]
-    k_name = name_rank[keep]
-    base_kept = base[keep].copy()  # threaded across segments (commits only)
+    k_name = name_rank[keep].astype(np.int64)
     zs_mem = plan.zone_mem.copy()
     zs_cpu = plan.zone_cpu.copy()
     # Priors placed only on kept rows (verified above): fold their
     # placements out of the dispatch sums to reach the true base sums.
     # base == host view - priors, and plan sums were over the host view.
     if prior_rows.size:
-        delta = np.asarray(host.available).astype(np.int64)[prior_rows] - base[prior_rows]
-        np.add.at(zs_mem, zone_id[prior_rows], -delta[:, MEM_DIM])
-        np.add.at(zs_cpu, zone_id[prior_rows], -delta[:, CPU_DIM])
+        np.add.at(
+            zs_mem, zone_id[prior_rows], -prior_deltas[:, MEM_DIM]
+        )
+        np.add.at(
+            zs_cpu, zone_id[prior_rows], -prior_deltas[:, CPU_DIM]
+        )
 
     # Per-row conservative excluded-fit tables, vectorized across the batch.
-    b = drv64.shape[0]
     fit_e_zb = (
         (plan.e_max_exec[None, :, :] >= exc64[:, None, :]).all(axis=2)
         & (plan.e_cnt_exec > 0)[None, :]
@@ -405,9 +994,10 @@ def certify_window(
                     # capacity can reorder it regardless of priority rank.
                     return False, "minfrag-excluded-capacity"
                 d = int(drivers[r])
-                dl = lut[d] if d >= 0 else -1
-                ev = execs[r][execs[r] >= 0]
-                el = lut[ev] if ev.size else ev.astype(np.int32)
+                dl = int(drivers_local[r])
+                sel = execs[r] >= 0
+                ev = execs[r][sel]
+                el = execs_local[r][sel]
                 if d < 0 or dl < 0 or (ev.size and (el < 0).any()):
                     return False, "non-kept-choice"  # cannot happen; belt+braces
                 key_d = (k_az[dl], k_mem[dl], k_cpu[dl], k_name[dl])

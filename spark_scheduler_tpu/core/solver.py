@@ -819,6 +819,17 @@ class _NameRankSpace:
         self.ranks.insert(i, (lo + hi) // 2)
         return False
 
+    def remove(self, name: str) -> None:
+        """Drop one name (node DELETE tombstone): its rank value simply
+        leaves the space — neighbours keep their values, and the freed
+        gap makes future inserts cheaper. Never renumbers."""
+        import bisect as _bisect
+
+        i = _bisect.bisect_left(self.names, name)
+        if i < len(self.names) and self.names[i] == name:
+            self.names.pop(i)
+            self.ranks.pop(i)
+
     def rank_of(self, name: str) -> int:
         import bisect as _bisect
 
@@ -876,7 +887,8 @@ class WindowHandle:
 
     __slots__ = (
         "strategy", "blob", "blob_future", "requests", "flat_rows",
-        "host_avail", "host_schedulable", "priors", "placements", "n",
+        "host_avail", "host_avail32", "host_schedulable", "priors",
+        "placements", "placement_rows", "n",
         "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
         "info", "parts", "request_device", "dispatch_id", "dispatched_at",
         "fused_decisions", "released", "host_tensors", "use_fallback",
@@ -904,11 +916,18 @@ class WindowHandle:
         self.flat_rows = flat_rows
         # Host availability view at dispatch (int64 [N,3]); the device base
         # additionally lacks the placements of `priors` (windows dispatched
-        # earlier but un-fetched at this dispatch).
+        # earlier but un-fetched at this dispatch). Pruned windows skip the
+        # per-dispatch int64 materialization: host_avail is None and
+        # host_avail32 references the int32 host view (ISSUE 12 —
+        # _dense_base materializes lazily on the rare dense paths).
         self.host_avail = host_avail
+        self.host_avail32 = None
         self.host_schedulable = host_schedulable
         self.priors = priors  # tuple[WindowHandle] — fetched before this one
         self.placements = None  # int64 [N,3], filled at fetch
+        # Rows `placements` is non-zero on (pruned fetches fill this) —
+        # lets later windows subtract priors sparsely at the 1M tier.
+        self.placement_rows = None
         self.n = n
         self.row_driver_req = None  # int64 [B,3], set after dispatch
         self.row_exec_req = None
@@ -1083,7 +1102,16 @@ class PlacementSolver:
         # classic full-tensor paths byte-for-byte.
         self._prune_top_k = int(prune_top_k)
         self._prune_slack = float(prune_slack)
-        self._rank_index = None  # lazy core/feature_store.RankIndex
+        self._planner = None  # lazy core/prune.PrunePlanner
+        # Statics-gather reuse (ISSUE 12 tentpole (c)): the last pruned
+        # window's gathered statics sub-blob + device buffers, re-served
+        # while the kept row set is identical (plan reuse) and no static
+        # row-delta touched a kept row.
+        self._prune_gather_cache: dict | None = None
+        # (domain key, epochs) -> "is the full valid mask" memo — gates
+        # the planner's resident-aggregate path for named full-roster
+        # domains without an O(N) compare per window.
+        self._full_dom_memo: dict = {}
         self.prune_stats = {
             "windows": 0,
             "escalations": 0,
@@ -1091,6 +1119,21 @@ class PlacementSolver:
             "window_rows": 0,
             "candidate_rows": 0,
             "reasons": {},
+            # O(K + changed) planning evidence (ISSUE 12): rows the
+            # planner actually examined (zone re-scans), the cold-build
+            # rows, the legacy subset-domain sweeps, resync compares,
+            # cache activity, and the per-phase wall-time accumulators.
+            "planner_rows_scanned": 0,
+            "planner_cold_rows": 0,
+            "planner_sweep_rows": 0,
+            "planner_resync_rows": 0,
+            "planner_zone_rescans": 0,
+            "planner_merges": 0,
+            "plan_reuse": 0,
+            "gather_reuse": 0,
+            "plan_ms": 0.0,
+            "gather_ms": 0.0,
+            "offset_ms": 0.0,
         }
         # Multi-device window-solve engine (`solver.device-pool` /
         # `solver.mesh` install keys): `mesh=(groups, node_shards)` builds
@@ -1153,6 +1196,13 @@ class PlacementSolver:
         self._arena = None
         self._node_seen: dict[str, Node] = {}
         self._rank_epoch = -1
+        # Deleted-node registry rows awaiting recycling (ISSUE 12): a
+        # tombstoned row re-enters the registry free list only once its
+        # reservation usage/overhead drained to zero AND no window is in
+        # flight that could still name it — until then it stays parked
+        # (masked invalid) and is retried every build.
+        self._pending_tombstones: set[str] = set()
+        self.tombstones_recycled = 0
         # Gapped name-rank order (see _NameRankSpace): a node ADD inserts
         # one rank value instead of renumbering every slot.
         self._rank_space = _NameRankSpace()
@@ -1244,57 +1294,119 @@ class PlacementSolver:
             and self._executor_label_priority is None
         )
 
-    def _rank_order(self, host) -> np.ndarray:
-        """The resident priority order for the prefilter, synced to the
-        current host availability (O(changed) merge; full rebuild only
-        after a topology/statics change invalidated it)."""
-        if self._rank_index is None:
-            from spark_scheduler_tpu.core.feature_store import RankIndex
+    def _prune_planner(self):
+        """The lazy PrunePlanner (resident per-zone rank index + zone
+        aggregates + plan cache, core/prune.py)."""
+        if self._planner is None:
+            from spark_scheduler_tpu.core.prune import PrunePlanner
 
-            self._rank_index = RankIndex()
-        idx = self._rank_index
-        avail = np.asarray(host.available)
-        if not idx.valid or idx.order().shape[0] != avail.shape[0]:
-            idx.rebuild(avail, host.name_rank)
-        else:
-            dirty = np.flatnonzero(
-                (idx._mem != avail[:, 1]) | (idx._cpu != avail[:, 0])
-            )
-            if dirty.size > avail.shape[0] // 4:
-                idx.rebuild(avail, host.name_rank)
-            elif dirty.size:
-                idx.update_rows(avail, host.name_rank, dirty)
-        return idx.order()
+            self._planner = PrunePlanner(self.prune_stats)
+        return self._planner
+
+    def _prune_invalidate(self) -> None:
+        """Drop every resident prefilter artifact (planner state + the
+        statics-gather cache's device buffers) — the full-upload /
+        topology-change contract."""
+        if self._planner is not None:
+            self._planner.invalidate()
+        self._prune_gather_cache = None
+
+    def _prune_note_rows(self, rows) -> None:
+        """Feed EXACT changed rows to the planner (O(changed) sync)."""
+        if self._planner is not None and len(rows):
+            self._planner.note_dirty(rows)
+
+    def _prune_mark_unknown(self) -> None:
+        """A path that cannot name its changed rows touched availability:
+        the planner's next sync diff-scans the snapshots instead."""
+        if self._planner is not None:
+            self._planner.mark_unknown()
 
     def _plan_prune(
-        self, host, dom_mask, cand_per_req, drv_arr, exc_arr, counts
+        self, host, dom_mask, cand_per_req, drv_arr, exc_arr, counts,
+        dom_key=None, dom_ref=None,
     ):
-        """Build a PrunePlan for one window/partition, or None."""
-        from spark_scheduler_tpu.core.prune import plan_window_prune
+        """Build a PrunePlan for one window/partition, or None.
 
-        return plan_window_prune(
-            host,
-            order=self._rank_order(host),
-            dom_mask=np.asarray(dom_mask, bool),
-            cand_per_req=cand_per_req,
-            drv_arr=drv_arr,
-            exc_arr=exc_arr,
-            counts=counts,
-            num_zones=self._num_zones_bucket(),
-            top_k=self._prune_top_k,
-            slack=self._prune_slack,
-        )
+        A full-valid-mask domain — by identity (no names pinned) or by
+        memoized content equality (a named domain enumerating the whole
+        roster) — takes the O(K + changed) resident-aggregate path;
+        genuine subset domains take the counted legacy sweep."""
+        planner = self._prune_planner()
+        planner.sync(host, self._num_zones_bucket())
+        if self._is_full_domain(
+            dom_mask, np.asarray(host.valid), dom_key, dom_ref
+        ):
+            plan = planner.plan_full_domain(
+                host,
+                cand_per_req=cand_per_req,
+                drv_arr=drv_arr,
+                exc_arr=exc_arr,
+                counts=counts,
+                num_zones=self._num_zones_bucket(),
+                top_k=self._prune_top_k,
+                slack=self._prune_slack,
+            )
+        else:
+            plan = planner.plan_with_masks(
+                host,
+                dom_mask=np.asarray(dom_mask, bool),
+                cand_per_req=cand_per_req,
+                drv_arr=drv_arr,
+                exc_arr=exc_arr,
+                counts=counts,
+                num_zones=self._num_zones_bucket(),
+                top_k=self._prune_top_k,
+                slack=self._prune_slack,
+            )
+        if plan is not None:
+            st = self.prune_stats
+            st["plan_ms"] += plan.plan_ms
+            st["offset_ms"] += plan.offset_ms
+        return plan
 
     def _shared_prune_domain(self, requests, dom_keys, dom_per_req):
-        """The single shared window domain, or None when requests pin
-        distinct domains (the pooled partition path prunes per-partition
-        instead; a mixed single-device window solves full)."""
+        """(domain mask, domain key) of the single shared window domain,
+        or (None, None) when requests pin distinct domains (the pooled
+        partition path prunes per-partition instead; a mixed single-device
+        window solves full)."""
         if any(r.domain_mask is not None for r in requests):
-            return None
+            return None, None
         keys = set(dom_keys)
         if len(keys) != 1:
-            return None
-        return dom_per_req[0]
+            return None, None
+        return dom_per_req[0], dom_keys[0]
+
+    def _is_full_domain(self, dom, valid_np, dom_key, dom_ref) -> bool:
+        """Whether a window's shared domain covers the ENTIRE valid mask —
+        the gate for the planner's O(K + changed) resident-aggregate path.
+        The default (no names pinned) is the valid mask by identity; a
+        named domain that happens to enumerate the whole roster (the
+        common serving request carries the full node list as its
+        instance-group domain) is detected by ONE content compare memoized
+        on (domain key, registry epoch, statics epoch) — both epochs pin
+        the compared arrays' content, so the O(N) compare runs once per
+        roster generation, not per window. `dom_ref` (the names object
+        behind the key) is held ALIVE by the memo entry: identity-derived
+        keys (digest tickets, huge plain lists) must never be re-matched
+        after their object's id is recycled — a subset domain
+        misclassified as full would desynchronize the certificate from
+        the kernel's domain mask."""
+        if dom is valid_np:
+            return True
+        if dom_key is None:
+            return False
+        memo_key = (
+            dom_key, self.registry.epoch, self._static_epoch,
+            valid_np.shape[0],
+        )
+        hit = self._full_dom_memo.get(memo_key)
+        if hit is None:
+            if len(self._full_dom_memo) > 16:
+                self._full_dom_memo.clear()
+            hit = (dom_ref, bool(np.array_equal(dom, valid_np)))
+            self._full_dom_memo[memo_key] = hit
+        return hit[1]
 
     def _note_prune_dispatch(self, plan, window_rows: int) -> None:
         st = self.prune_stats
@@ -1326,19 +1438,54 @@ class PlacementSolver:
                 h.fallback_reason = "prune-escalation"
             self._pipe = None
 
-    def _prior_placement_rows(self, handle) -> np.ndarray:
-        """Global rows any still-relevant prior window placed on — the
-        certificate's excluded-row-integrity input. A prior with unknown
-        placements (failed fetch) poisons certifiability outright, which
-        the caller maps to an escalation."""
-        rows: list[np.ndarray] = []
+    def _prior_sparse(self, handle):
+        """(rows, summed deltas) of every still-relevant prior window's
+        placements — the certificate's excluded-row-integrity input in
+        sparse form (pruned priors carry their placement rows, so this is
+        O(placed), not O(N)). None when a prior's placements are unknown
+        (failed fetch), which the caller maps to an escalation."""
+        rows_list: list[np.ndarray] = []
+        deltas_list: list[np.ndarray] = []
         for prior in handle.priors:
             if prior.placements is None:
                 return None
-            rows.append(np.flatnonzero(prior.placements.any(axis=1)))
-        if not rows:
-            return np.empty(0, np.int64)
-        return np.unique(np.concatenate(rows))
+            pr = prior.placement_rows
+            if pr is None:
+                pr = np.flatnonzero(prior.placements.any(axis=1))
+            rows_list.append(pr)
+            deltas_list.append(prior.placements[pr])
+        if not rows_list:
+            return (
+                np.empty(0, np.int64),
+                np.empty((0, NUM_DIMS), np.int64),
+            )
+        rows = np.concatenate(rows_list)
+        deltas = np.concatenate(deltas_list)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        out = np.zeros((uniq.size, deltas.shape[1]), np.int64)
+        np.add.at(out, inv, deltas)
+        return uniq.astype(np.int64), out
+
+    def _dense_base(self, handle) -> np.ndarray:
+        """The dense [N,3] int64 fetch-side base reconstruction (host view
+        at dispatch minus in-flight priors' placements). Pruned handles
+        skip the per-dispatch int64 materialization and pay it only here
+        (escalations, fallback re-solves, dense fetch paths); priors with
+        known placement rows subtract sparsely."""
+        if handle.host_avail is not None:
+            base = handle.host_avail.copy()
+        else:
+            base = handle.host_avail32.astype(np.int64)
+        for prior in handle.priors:
+            if prior.placements is None:
+                continue
+            pr = prior.placement_rows
+            if pr is not None:
+                if pr.size:
+                    base[pr] -= prior.placements[pr]
+            else:
+                base -= prior.placements
+        return base
 
     def device_health(self) -> dict:
         """{slots, healthy, quarantined: [labels]} — /debug/state and the
@@ -1600,6 +1747,7 @@ class PlacementSolver:
         self._inflight_futures.clear()
         self._pipe = None
         self._dev = None
+        self._prune_gather_cache = None  # release cached device statics
         self._release_fused()
         self._release_pool()
 
@@ -1624,6 +1772,7 @@ class PlacementSolver:
         keeping the [K, ...] device blobs alive through parked view
         handles would be a restart-shaped leak."""
         self._pipe = None
+        self._prune_gather_cache = None  # release cached device statics
         self._release_fused()
         self._release_pool()
         if self.telemetry is not None:
@@ -1737,6 +1886,9 @@ class PlacementSolver:
                         p, host, static_plan
                     )
                 if k:
+                    # The prune planner's O(changed) sync rides exactly
+                    # this dirty set (plus fetched placement rows).
+                    self._prune_note_rows(dirty)
                     # Pad with a repeated index but ZERO delta rows: .add
                     # is cumulative, so padding must contribute nothing.
                     # The base is DONATED into the add — committed-base
@@ -1795,8 +1947,7 @@ class PlacementSolver:
         # contract).
         self._static_epoch += 1
         self._static_journal.clear()
-        if self._rank_index is not None:
-            self._rank_index.invalidate()
+        self._prune_invalidate()
         if self.telemetry is not None:
             self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
         self._pipe = {
@@ -1867,14 +2018,18 @@ class PlacementSolver:
         stats["upload_bytes"] += nbytes
         if self.telemetry is not None:
             self.telemetry.on_transfer("h2d", nbytes)
-        idx2 = self._rank_index
-        if idx2 is not None and idx2.valid:
-            if idx2.order().shape[0] == host.available.shape[0]:
-                idx2.update_rows(
-                    np.asarray(host.available), host.name_rank, rows
-                )
-            else:
-                idx2.invalidate()
+        if self._planner is not None:
+            # Static row-deltas (validity/zone/name-rank/eligibility
+            # flips) feed the planner as STATIC dirt: a kept row's static
+            # flip re-scans its zone, a new row merges exactly.
+            self._planner.note_static(rows)
+        cache = self._prune_gather_cache
+        if cache is not None and np.isin(rows, cache["keep"]).any():
+            # The cached statics sub-blob gathered rows that just
+            # changed: drop it (the kept set itself usually changes too,
+            # but a static flip on a kept row with an unchanged keep must
+            # still force a re-gather).
+            self._prune_gather_cache = None
         return out
 
     def _resolve_base(self, p) -> bool:
@@ -1934,6 +2089,11 @@ class PlacementSolver:
 
         def _upsert(node) -> None:
             seen[node.name] = node
+            # A deleted-then-re-added name is LIVE again: its parked
+            # tombstone must not release the row out from under it (a
+            # deferred _release_tombstones would unmap a live node and
+            # hand its registry row to the free list).
+            self._pending_tombstones.discard(node.name)
             idx = self.registry.intern(node.name)
             arena.upsert(
                 idx,
@@ -1952,13 +2112,15 @@ class PlacementSolver:
                 and topo is not None
                 and dirty_hint[0] == self._topo_seen
             ):
-                # Update-or-ADD node event with a verified version chain
-                # (the feature store captured exactly what changed since
-                # the version this arena last synced to): upsert just the
-                # changed rows. New names intern and take a GAPPED name
-                # rank between their lexicographic neighbours
-                # (_NameRankSpace) — the node-ADD path never renumbers or
-                # re-walks the existing roster.
+                # Update/ADD/DELETE node event with a verified version
+                # chain (the feature store captured exactly what changed
+                # since the version this arena last synced to): upsert
+                # just the changed rows. New names intern and take a
+                # GAPPED name rank between their lexicographic neighbours
+                # (_NameRankSpace); deleted names tombstone — their rows
+                # are masked out by the roster-row request mask and
+                # recycled by _release_tombstones once their usage
+                # drains. The existing roster is never re-walked.
                 new_names = [
                     n.name for n in dirty_hint[1] if n.name not in seen
                 ]
@@ -1966,6 +2128,13 @@ class PlacementSolver:
                     _upsert(node)
                 if new_names:
                     self._insert_name_ranks(new_names)
+                for name in (
+                    dirty_hint[2] if len(dirty_hint) > 2 else ()
+                ):
+                    if name in seen:
+                        seen.pop(name, None)
+                        self._rank_space.remove(name)
+                        self._pending_tombstones.add(name)
                 self._topo_seen = topo
             else:
                 changed_names = False
@@ -1986,6 +2155,8 @@ class PlacementSolver:
 
         usage_t = self._dense_or_scatter(usage, pad)
         overhead_t = self._dense_or_scatter(overhead, pad)
+        if self._pending_tombstones:
+            self._release_tombstones(usage_t, overhead_t)
 
         fields = arena.snapshot(pad, usage_t, overhead_t)
         tensors = ClusterTensors(*fields)
@@ -2021,6 +2192,36 @@ class PlacementSolver:
                 )
         tensors.valid &= request_mask
         return tensors
+
+    def _release_tombstones(self, usage_t, overhead_t) -> None:
+        """Recycle deleted nodes' registry rows (the delete-patch
+        satellite's second half): a tombstoned row re-enters the
+        registry's free list — a future node ADD reuses the index, whose
+        fresh statics then ship as an ordinary delta-statics journal row.
+        A row with residual reservation usage or schedulable overhead
+        stays parked (recycling it would graft the leftovers onto the
+        next node) and is retried every build; so does everything while
+        a dispatched window is in flight (its fetch may still resolve
+        the row's name)."""
+        p = self._pipe
+        if p is not None and p["unfetched"]:
+            return
+        still = set()
+        for name in self._pending_tombstones:
+            row = self.registry.index_of(name)
+            if row is None:
+                continue
+            if (
+                row < usage_t.shape[0]
+                and row < overhead_t.shape[0]
+                and not usage_t[row].any()
+                and not overhead_t[row].any()
+            ):
+                self.registry.remove(name)
+                self.tombstones_recycled += 1
+            else:
+                still.add(name)
+        self._pending_tombstones = still
 
     def _assign_all_name_ranks(self) -> None:
         """Full (re)assignment of the arena's name ranks from the sorted
@@ -2058,8 +2259,7 @@ class PlacementSolver:
                 idx, np.asarray(space.ranks, np.int32)
             )
             # Every row's rank value moved: resident order keys are stale.
-            if self._rank_index is not None:
-                self._rank_index.invalidate()
+            self._prune_invalidate()
         else:
             index_of = self.registry.index_of
             self._arena.set_name_rank_values(
@@ -2408,7 +2608,7 @@ class PlacementSolver:
             # candidate rows out of the resident carry and solve a [K,3]
             # sub-cluster instead of [N,3]; decisions are certified at
             # fetch and escalate to the exact host re-solve on failure.
-            dom_shared = self._shared_prune_domain(
+            dom_shared, dom_key = self._shared_prune_domain(
                 requests, dom_keys, dom_per_req
             )
             if dom_shared is not None:
@@ -2417,7 +2617,7 @@ class PlacementSolver:
                     drv_arr=drv_arr, exc_arr=exc_arr, counts=counts,
                     skip_arr=skip_arr, emax=emax, cand_rows=cand_rows,
                     commit=commit, reset=reset, dom_shared=dom_shared,
-                    cand_per_req=cand_per_req,
+                    cand_per_req=cand_per_req, dom_key=dom_key,
                 )
                 if handle is not None:
                     return handle
@@ -2633,10 +2833,7 @@ class PlacementSolver:
         minus the placements of windows that were still in flight then),
         so degraded decisions see exactly the availability a device solve
         would have."""
-        base = handle.host_avail.copy()
-        for prior in handle.priors:
-            if prior.placements is not None:
-                base -= prior.placements
+        base = self._dense_base(handle)
         if handle.fallback_reason == "prune-escalation":
             # Correctness machinery with a healthy device: the sibling
             # re-solve may ride the scale-tier sharded path. Degraded-mode
@@ -2660,6 +2857,7 @@ class PlacementSolver:
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
             p["mirror"] -= placements
+        self._prune_mark_unknown()
         self._note_dispatch_complete(handle)
         return decisions
 
@@ -2727,6 +2925,7 @@ class PlacementSolver:
     def _dispatch_pruned(
         self, strategy, requests, *, host, p, n, drv_arr, exc_arr, counts,
         skip_arr, emax, cand_rows, commit, reset, dom_shared, cand_per_req,
+        dom_key=None,
     ) -> "WindowHandle | None":
         """Tier-1 dispatch of the two-tier solve (single-device pipelined
         path): the prefilter's kept rows gather out of the resident device
@@ -2739,7 +2938,8 @@ class PlacementSolver:
         from spark_scheduler_tpu.tracing import tracer
 
         plan = self._plan_prune(
-            host, dom_shared, cand_per_req, drv_arr, exc_arr, counts
+            host, dom_shared, cand_per_req, drv_arr, exc_arr, counts,
+            dom_key=dom_key, dom_ref=requests[0].domain_node_names,
         )
         if plan is None:
             return None
@@ -2747,7 +2947,24 @@ class PlacementSolver:
         tel = self.telemetry
         compiles_before = tel.compile_count() if tel is not None else None
         keep = plan.keep
-        statics_np = _gather_statics_host(host, keep, plan.k_real)
+        t_gather = self._clock()
+        # Statics-gather reuse (ISSUE 12 tentpole (c) + the repeat-window
+        # bugfix): an unchanged kept row set (the planner re-served the
+        # SAME keep array) whose gathered rows saw no static row-delta
+        # re-serves the host gather AND the resident device sub-blob —
+        # zero host-array touches, zero re-upload. The cache is dropped by
+        # _apply_static_delta (rows ∩ keep), full uploads, and close().
+        cache = self._prune_gather_cache
+        gather_reused = (
+            cache is not None
+            and plan.reused
+            and cache["keep"] is keep
+        )
+        if gather_reused:
+            statics_np = cache["statics_np"]
+            self.prune_stats["gather_reuse"] += 1
+        else:
+            statics_np = _gather_statics_host(host, keep, plan.k_real)
         cand_sub = np.stack([c[keep] for c in cand_rows])
         dom_sub = np.broadcast_to(
             np.asarray(dom_shared)[keep], (b, len(keep))
@@ -2759,11 +2976,21 @@ class PlacementSolver:
                 path="xla-pruned",
             ):
                 _shim("h2d")
-                idx_dev = jnp.asarray(keep)
+                if gather_reused:
+                    idx_dev = cache["idx_dev"]
+                    statics_dev = cache["statics_dev"]
+                else:
+                    idx_dev = jnp.asarray(keep)
+                    statics_dev = tuple(
+                        jax.device_put(f) for f in statics_np
+                    )
+                    self._prune_gather_cache = {
+                        "keep": keep,
+                        "statics_np": statics_np,
+                        "statics_dev": statics_dev,
+                        "idx_dev": idx_dev,
+                    }
                 sub_avail = _take_rows(p["avail"], idx_dev)
-                statics_dev = tuple(
-                    jax.device_put(f) for f in statics_np
-                )
                 zone_base_dev = tuple(
                     jnp.asarray(a) for a in plan.zone_base
                 )
@@ -2795,6 +3022,8 @@ class PlacementSolver:
                 strategy, requests, host, n, priors
             )
 
+        gather_ms = (self._clock() - t_gather) * 1e3
+        self.prune_stats["gather_ms"] += gather_ms
         self.window_path_counts["xla-pruned"] = (
             self.window_path_counts.get("xla-pruned", 0) + 1
         )
@@ -2816,6 +3045,7 @@ class PlacementSolver:
             "pruned": True,
             "kept_rows": plan.k_real,
             "candidate_rows": plan.dom_rows,
+            "gather_reused": gather_reused,
         }
         self.last_solve_info = info
         self._note_prune_dispatch(plan, b)
@@ -2823,29 +3053,36 @@ class PlacementSolver:
             tel.on_window_dispatch(
                 "xla-pruned", nodes=n, rows=b, row_bucket=row_bucket,
             )
+            tel.on_prune_phases(plan.plan_ms, gather_ms, plan.offset_ms)
+            if gather_reused:
+                tel.on_prune_gather_reuse()
             # What the pruned dispatch actually ships: gathered statics +
             # app arrays + [B,K] masks + the zone offsets — the O(N) blob
-            # (and the [B,N] masks) never leave the host.
+            # (and the [B,N] masks) never leave the host, and a reused
+            # gather re-serves the resident statics sub-blob without
+            # re-uploading it.
             tel.on_transfer(
                 "h2d",
-                sum(f.nbytes for f in statics_np)
+                (
+                    0
+                    if gather_reused
+                    else sum(f.nbytes for f in statics_np) + keep.nbytes
+                )
                 + drv_arr.nbytes + exc_arr.nbytes + counts.nbytes
                 + skip_arr.nbytes + cand_sub.nbytes + dom_sub.nbytes
-                + sum(a.nbytes for a in plan.zone_base)
-                + keep.nbytes,
+                + sum(a.nbytes for a in plan.zone_base),
             )
         handle = WindowHandle(
             strategy=strategy,
             blob=blob,
             requests=tuple(requests),
             flat_rows=[],
-            host_avail=np.array(
-                np.asarray(host.available), dtype=np.int64
-            ),
+            host_avail=None,
             host_schedulable=np.asarray(host.schedulable),
             priors=tuple(p["unfetched"]),
             n=n,
         )
+        handle.host_avail32 = np.asarray(host.available)
         handle.row_driver_req = drv_arr.astype(np.int64)
         handle.row_exec_req = exc_arr.astype(np.int64)
         handle.row_skippable = skip_arr
@@ -2862,15 +3099,22 @@ class PlacementSolver:
         return handle
 
     def _fetch_pruned(self, handle: "WindowHandle", blob) -> "list[WindowDecision]":
-        """Tier 2 of the two-tier solve: map the fetched blob's sub-cluster
-        indices back to global rows, run the soundness certificate against
-        the exact host reconstruction, and either apply the decisions (the
-        normal path) or escalate the window to the exact host re-solve."""
+        """Tier 2 of the two-tier solve: run the soundness certificate
+        against the exact host reconstruction and either apply the
+        decisions (the normal path) or escalate the window to the exact
+        host re-solve.
+
+        O(K + rows) host work since ISSUE 12: the certificate and the
+        decision reconstruction both operate on the KEPT rows (base and
+        placements gathered to [K,3]); nothing on this path copies,
+        compares, or subtracts an [N,3] array — the dense `placements`
+        tensor is a lazily-zeroed scatter target for downstream priors."""
         from spark_scheduler_tpu.core.prune import certify_window
 
         plan = handle.prune
         blob = np.asarray(blob)
         gmap = plan.keep.astype(np.int64)
+        keep_real = plan.keep[: plan.k_real]
         drivers_l = blob[:, 0].astype(np.int64)
         admitted = blob[:, 1].astype(bool)
         packed = blob[:, 2].astype(bool)
@@ -2879,14 +3123,19 @@ class PlacementSolver:
             drivers_l >= 0, gmap[np.clip(drivers_l, 0, None)], -1
         )
         execs = np.where(execs_l >= 0, gmap[np.clip(execs_l, 0, None)], -1)
-        base = handle.host_avail.copy()
-        for prior in handle.priors:
-            if prior.placements is not None:
-                base -= prior.placements
-        prior_rows = self._prior_placement_rows(handle)
-        if prior_rows is None:
+        host_avail32 = handle.host_avail32
+        ps = self._prior_sparse(handle)
+        if ps is None:
             ok, reason = False, "prior-unknown"
         else:
+            prior_rows, prior_deltas = ps
+            base_kept = host_avail32[keep_real].astype(np.int64)
+            if prior_rows.size:
+                loc = np.searchsorted(keep_real, prior_rows)
+                locc = np.clip(loc, 0, keep_real.size - 1)
+                on_kept = keep_real[locc] == prior_rows
+                if on_kept.any():
+                    base_kept[locc[on_kept]] -= prior_deltas[on_kept]
             ok, reason = certify_window(
                 plan,
                 strategy=handle.strategy,
@@ -2897,24 +3146,42 @@ class PlacementSolver:
                 execs=execs,
                 drv64=handle.row_driver_req,
                 exc64=handle.row_exec_req,
-                base=base,
+                base_kept=base_kept.copy(),  # certify threads commits
                 host=handle.host_tensors,
                 prior_rows=prior_rows,
+                prior_deltas=prior_deltas,
             )
         if not ok:
-            return self._escalate_pruned(handle, base, reason)
-        placements = np.zeros_like(base)
+            return self._escalate_pruned(
+                handle, self._dense_base(handle), reason
+            )
+        # Compact reconstruction over the kept rows: base/placements are
+        # [Kp,3], decision indices stay LOCAL, and gmap resolves names.
+        kp = plan.keep.shape[0]
+        base_loc = np.zeros((kp, host_avail32.shape[1]), np.int64)
+        base_loc[: plan.k_real] = base_kept
+        placements_loc = np.zeros_like(base_loc)
+        sched_kept = np.asarray(handle.host_schedulable)[plan.keep]
         decisions = self._reconstruct_requests(
-            handle.requests, drivers, admitted, packed, execs,
+            handle.requests, drivers_l, admitted, packed, execs_l,
             handle.row_driver_req, handle.row_exec_req,
-            handle.row_skippable, base, placements,
-            handle.host_schedulable,
+            handle.row_skippable, base_loc, placements_loc,
+            sched_kept, row_map=gmap,
         )
+        n_rows = host_avail32.shape[0]
+        placements = np.zeros((n_rows, host_avail32.shape[1]), np.int64)
+        np.add.at(placements, gmap, placements_loc)
+        prows = np.unique(gmap[placements_loc.any(axis=1)])
         handle.placements = placements
+        handle.placement_rows = prows
         p = self._pipe
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
-            p["mirror"] -= placements
+            if prows.size:
+                p["mirror"][prows] -= placements[prows]
+        # The placed rows are availability churn the planner can absorb
+        # exactly (they are kept rows by construction).
+        self._prune_note_rows(prows)
         self._note_dispatch_complete(handle)
         self._device_recovered()
         return decisions
@@ -3150,10 +3417,10 @@ class PlacementSolver:
         # rows — the sub-cluster solve machinery is identical, only the
         # index set shrinks and the committed rows scatter back as deltas.
         try_prune = self._prune_eligible(strategy)
-        shared_dom = (
+        shared_dom, shared_key = (
             self._shared_prune_domain(requests, dom_keys, dom_per_req)
             if try_prune
-            else None
+            else (None, None)
         )
 
         def submit_part(slot, req_ids, idx_key, idx):
@@ -3168,11 +3435,17 @@ class PlacementSolver:
                     dom_per_req[req_ids[0]] if idx is not None
                     else shared_dom
                 )
+                part_key = (
+                    dom_keys[req_ids[0]] if idx is not None
+                    else shared_key
+                )
                 if part_dom is not None:
                     prune_plan = self._plan_prune(
                         host, part_dom,
                         [cand_per_req[r] for r in req_ids],
                         drv_g, exc_g, cnt_g,
+                        dom_key=part_key,
+                        dom_ref=requests[req_ids[0]].domain_node_names,
                     )
                 if prune_plan is not None:
                     # The pruned gather REPLACES the domain gather: padded
@@ -3530,10 +3803,7 @@ class PlacementSolver:
         packed = blob[:, 2].astype(bool)
         execs = blob[:, 3:]
 
-        base = handle.host_avail.copy()
-        for prior in handle.priors:
-            if prior.placements is not None:
-                base -= prior.placements
+        base = self._dense_base(handle)
         placements = np.zeros_like(base)
         decisions = self._reconstruct_requests(
             requests, drivers, admitted, packed, execs,
@@ -3552,6 +3822,7 @@ class PlacementSolver:
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
             p["mirror"] -= placements
+        self._prune_mark_unknown()
         self._note_dispatch_complete(handle)
         self._device_recovered()
         return decisions
@@ -3580,10 +3851,7 @@ class PlacementSolver:
         requests, n = handle.requests, handle.n
         tel = self.telemetry
         results: list = [None] * len(requests)
-        base = handle.host_avail.copy()
-        for prior in handle.priors:
-            if prior.placements is not None:
-                base -= prior.placements
+        base = self._dense_base(handle)
         placements = np.zeros_like(base)
         with tracer().span(
             "solve", strategy=handle.strategy, nodes=n,
@@ -3689,10 +3957,12 @@ class PlacementSolver:
                         certify_window,
                     )
 
-                    prior_rows = self._prior_placement_rows(handle)
-                    if prior_rows is None:
+                    ps = self._prior_sparse(handle)
+                    if ps is None:
                         cert_ok, reason = False, "prior-unknown"
                     else:
+                        prior_rows, prior_deltas = ps
+                        keep_real = part.prune.keep[: part.prune.k_real]
                         cert_ok, reason = certify_window(
                             part.prune,
                             strategy=handle.strategy,
@@ -3703,9 +3973,10 @@ class PlacementSolver:
                             execs=execs,
                             drv64=part.row_drv,
                             exc64=part.row_exc,
-                            base=base,
+                            base_kept=base[keep_real].copy(),
                             host=handle.host_tensors,
                             prior_rows=prior_rows,
+                            prior_deltas=prior_deltas,
                         )
                     if not cert_ok:
                         # Escalate just this partition: re-solve it on the
@@ -3734,6 +4005,7 @@ class PlacementSolver:
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
             p["mirror"] -= placements
+        self._prune_mark_unknown()
         self._note_dispatch_complete(handle)
         self._device_recovered()
         return results
@@ -3861,6 +4133,7 @@ class PlacementSolver:
     def _reconstruct_requests(
         self, requests, drivers, admitted, packed, execs,
         drv64, exc64, skip, base, placements, host_schedulable,
+        row_map=None,
     ) -> list[WindowDecision]:
         """Host-side reconstruction for per-request packing efficiency: the
         availability each admitted request's final pack saw = the
@@ -3871,7 +4144,18 @@ class PlacementSolver:
         rows (a FIFO window carries O(requests x pending) hypothetical
         rows — per-row Python was the serving loop's hot spot). Mutates
         `base` and `placements` in place (the pooled fetch threads ONE
-        base through every partition)."""
+        base through every partition).
+
+        `row_map` (pruned fetches): decision indices, `base` and
+        `placements` live in a COMPACT kept-row space; row_map maps a
+        local index to its global registry row for name resolution — the
+        whole reconstruction then costs O(K) instead of O(N) (the
+        per-request `base.copy()` below was a measured [N,3] cost per
+        admitted request at the million-node tier)."""
+        if row_map is not None:
+            name_of = lambda i: self.registry.name_of(int(row_map[i]))  # noqa: E731
+        else:
+            name_of = lambda i: self.registry.name_of(int(i))  # noqa: E731
         decisions: list[WindowDecision] = []
         row = 0
         for r, req in enumerate(requests):
@@ -3923,12 +4207,12 @@ class PlacementSolver:
                 WindowDecision(
                     packing=HostPacking(
                         driver_node=(
-                            self.registry.name_of(int(drivers[real]))
+                            name_of(drivers[real])
                             if drivers[real] >= 0
                             else None
                         ),
                         executor_nodes=[
-                            self.registry.name_of(x) for x in exec_idx
+                            name_of(x) for x in exec_idx
                         ],
                         has_capacity=bool(packed[real]),
                         efficiency_max=float(eff.max) if eff else 0.0,

@@ -133,13 +133,23 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
         out["faults"] = faults
         prune = getattr(solver, "prune_stats", None)
         if prune is not None and prune.get("windows"):
-            # Two-tier solve: pruned-window volume, kept-row ratio, and
-            # the certificate-escalation ledger by reason — the evidence
-            # that pruning is both engaged and sound, live. Deep-copy the
-            # nested reasons ledger: sharing the live dict with the solve
+            # Two-tier solve: pruned-window volume, kept-row ratio, the
+            # certificate-escalation ledger by reason, and (ISSUE 12) the
+            # O(K + changed) planner evidence — phase-time means, reuse
+            # hits and the rows-scanned ledger. Deep-copy the nested
+            # reasons ledger: sharing the live dict with the solve
             # thread would let a concurrent escalation resize it under
             # this snapshot's JSON serialization.
-            out["prune"] = {**prune, "reasons": dict(prune["reasons"])}
+            windows = max(int(prune.get("windows", 0)), 1)
+            block = {**prune, "reasons": dict(prune["reasons"])}
+            for phase in ("plan", "gather", "offset"):
+                block[f"{phase}_ms_mean"] = round(
+                    prune.get(f"{phase}_ms", 0.0) / windows, 4
+                )
+            planner = getattr(solver, "_planner", None)
+            if planner is not None:
+                block["planner"] = planner.index_stats()
+            out["prune"] = block
         # Million-node tier (ISSUE 11): device-state upload mix (full vs
         # availability-delta vs static-row-delta, with total bytes) and
         # the scale-tier escalation re-solve ledger when engaged.

@@ -67,6 +67,13 @@ PRUNE_WINDOWS = "foundry.spark.scheduler.solver.prune.windows"
 PRUNE_ESCALATIONS = "foundry.spark.scheduler.solver.prune.escalations"
 PRUNE_KEPT_ROWS = "foundry.spark.scheduler.solver.prune.kept.rows"
 PRUNE_KEPT_RATIO = "foundry.spark.scheduler.solver.prune.kept.ratio"
+# O(K + changed) planning (ISSUE 12): per-window prune phase wall times
+# (prefilter plan / statics+mask gather / zone-sum offset derivation) and
+# the statics-gather reuse hits that skip the host gather + re-upload.
+PRUNE_PLAN_MS = "foundry.spark.scheduler.solver.prune.plan.ms"
+PRUNE_GATHER_MS = "foundry.spark.scheduler.solver.prune.gather.ms"
+PRUNE_OFFSET_MS = "foundry.spark.scheduler.solver.prune.offset.ms"
+PRUNE_GATHER_REUSE = "foundry.spark.scheduler.solver.prune.gather.reuse"
 DISPATCH_FUSED_K = "foundry.spark.scheduler.solver.dispatch.fused.k"
 DISPATCH_AMORTIZED_RTT_MS = (
     "foundry.spark.scheduler.solver.dispatch.amortized.rtt.ms"
@@ -297,6 +304,22 @@ class SolverTelemetry:
         exact full path. Labeled by the first failed test so a hot
         escalation reason is visible."""
         self.registry.counter(PRUNE_ESCALATIONS, reason=reason).inc()
+
+    def on_prune_phases(
+        self, plan_ms: float, gather_ms: float, offset_ms: float
+    ) -> None:
+        """One pruned window's host-side phase split: prefilter planning,
+        statics/mask gather (+ upload staging), and the zone-sum offset
+        derivation — the O(K + changed) claim as wall times."""
+        self.registry.histogram(PRUNE_PLAN_MS).update(round(plan_ms, 4))
+        self.registry.histogram(PRUNE_GATHER_MS).update(round(gather_ms, 4))
+        self.registry.histogram(PRUNE_OFFSET_MS).update(round(offset_ms, 4))
+
+    def on_prune_gather_reuse(self) -> None:
+        """A pruned window re-served the previous window's gathered
+        statics sub-blob (kept rows and their static fields unchanged):
+        no host gather, no h2d re-upload."""
+        self.registry.counter(PRUNE_GATHER_REUSE).inc()
 
     # -- pipeline ------------------------------------------------------------
 

@@ -45,7 +45,16 @@ def zone_ranks(
     each int64 sum S split into int32 limbs hi = S >> 24, lo = S & 0xFFFFFF
     (exact for |S| < 2^55, i.e. any 100k-node cluster of int32 rows). The
     offsets stay constant across the window's scan because a certified pruned
-    solve never places on an excluded row."""
+    solve never places on an excluded row.
+
+    Offset DERIVATION contract (ISSUE 12): the host derives each excluded
+    sum as `zone total − Σ kept rows` from resident, event-maintained
+    per-zone totals (core/zone_aggregates.ZoneAggregates) — exact int64
+    integer sums, never a per-window O(N) re-aggregation — so the identity
+    `chunks(kept) + limbs(total − kept) ≡ chunks(full domain)` holds in the
+    carry-normal form this kernel compares (the subset-domain sweep derives
+    the same limbs by direct summation; both are pinned by the planner
+    exactness oracle and the offset-identity test)."""
     if available is None:
         available = cluster.available
     mask = domain_mask & cluster.valid

@@ -103,12 +103,15 @@ def test_budget_10k_nodes_steady_state_featurize_is_o_changed():
     assert store.roster_add_patches == 1
     assert len(snap2.nodes) == 10_001
     assert snap2.by_name["fs-late"] is not None
-    # A node DELETE still pays the full rebuild — the one remaining
-    # O(nodes) node event.
+    # A node DELETE rides the tombstone patch (ISSUE 12): swap-remove +
+    # live-mask clear, no O(nodes) re-list — the rebuild counter stays
+    # flat and the delete-patch counter moves instead.
     backend.delete("nodes", "", "fs-late")
     snap3 = store.snapshot()
-    assert store.roster_rebuilds == rebuilds_before + 1
+    assert store.roster_rebuilds == rebuilds_before
+    assert store.roster_delete_patches == 1
     assert len(snap3.nodes) == 10_000
+    assert "fs-late" not in snap3.by_name
     # Bumps at least once for the roster walk (the re-masked overhead copy
     # may bump it again) — what matters is that the solver's epoch skip is
     # invalidated.
@@ -363,4 +366,168 @@ def test_overhead_change_invalidates_statics_epoch():
     dev_sched = np.asarray(t2.schedulable)
     assert np.array_equal(dev_sched[idx], host_sched[idx])
     assert dev_sched[idx][0] == 8000 - 500  # allocatable - overhead
+    app.stop()
+
+
+# ------------------------------------------------- node DELETE patch (ISSUE 12)
+
+
+def test_delete_patch_matches_fresh_rebuild():
+    """A node DELETE swap-removes through the patch path: the patched
+    roster must equal a from-scratch rebuild as a SET (swap-remove
+    permutes positions), the live-row mask must drop the deleted row,
+    and the dirty hint must carry the deleted name for the solver's
+    tombstone path."""
+    backend, app, names = _app_with_nodes(12)
+    store = app.extender.features
+    store.snapshot()
+    rebuilds = store.roster_rebuilds
+
+    backend.delete("nodes", "", names[3])
+    snap = store.snapshot()
+    assert store.roster_rebuilds == rebuilds
+    assert store.roster_delete_patches == 1
+    assert {n.name for n in snap.nodes} == set(names) - {names[3]}
+    assert names[3] not in snap.by_name
+    assert len(snap.roster_rows) == len(snap.nodes)
+    # roster_rows still names each node's registry row.
+    reg = app.solver.registry
+    for node, row in zip(snap.nodes, snap.roster_rows):
+        assert reg.index_of(node.name) == row
+    # The deleted row left the live mask (the overhead re-mask input).
+    deleted_row = reg.index_of(names[3])
+    assert not store._roster_mask[deleted_row]
+    # Dirty hint carries the delete.
+    assert snap.dirty_hint is not None and names[3] in snap.dirty_hint[2]
+    app.stop()
+
+
+def test_delete_then_serve_recycles_registry_row():
+    """End-to-end delete satellite: serving across a DELETE takes the
+    patch path on both layers (no roster rebuild, no arena re-walk), the
+    tombstoned registry row recycles once nothing references it, and a
+    later ADD reuses the freed index — the registry capacity does not
+    grow past the high-water mark."""
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+    backend, app, names = _app_with_nodes(16)
+    ext = app.extender
+    ext._last_request = float("inf")
+    store = ext.features
+
+    def serve(tag):
+        d = static_allocation_spark_pods(f"del-{tag}", 1)[0]
+        backend.add_pod(d)
+        tok = ext.predicate_window_dispatch(
+            [ExtenderArgs(pod=d, node_names=list(names))]
+        )
+        return ext.predicate_window_complete(tok)
+
+    serve("warm")
+    rebuilds = store.roster_rebuilds
+    # Delete an idle node (no reservations landed on it yet).
+    victim = names[-1]
+    backend.delete("nodes", "", victim)
+    serve("after-del")
+    assert store.roster_rebuilds == rebuilds
+    assert store.roster_delete_patches == 1
+    serve("drain")  # tombstone released once no window is in flight
+    assert app.solver.tombstones_recycled >= 1
+    assert app.solver.registry.index_of(victim) is None
+    cap_before = app.solver.registry.capacity
+    # A new node reuses the freed registry row: capacity stays flat.
+    backend.add_node(new_node("del-reborn", zone="zone0"))
+    serve("after-add")
+    assert app.solver.registry.capacity == cap_before
+    assert store.roster_rebuilds == rebuilds
+    app.stop()
+
+
+# ----------------------------------- per-zone head-walk property (ISSUE 12)
+
+
+def test_rank_headwalk_topk_matches_full_sort_under_churn():
+    """Property test: the planner's head-walk top-K — the first K valid
+    fitting rows of a zone's resident order — must equal the top-K of a
+    from-scratch full sort, per zone, under randomized add/update/delete
+    churn. Keys are drawn from a tiny value set so tie GROUPS straddle
+    the K boundary (the order's row-index tiebreak must keep the
+    incremental and rebuilt orders identical)."""
+    from spark_scheduler_tpu.core.feature_store import RankIndex
+
+    rng = np.random.default_rng(77)
+    n, zb, k = 400, 4, 6
+    avail = (rng.integers(0, 4, size=(n, 3)) * 8).astype(np.int32)
+    name_rank = rng.permutation(n).astype(np.int32)
+    zone_id = rng.integers(0, 3, size=n).astype(np.int32)
+    valid = rng.random(n) < 0.9
+    min_req = np.asarray([8, 8, 0], np.int32)
+
+    idx = RankIndex()
+    idx.rebuild(avail, name_rank, zone_id, zb)
+    for step in range(40):
+        op = int(rng.integers(0, 3))
+        rows = rng.choice(n, size=int(rng.integers(1, 10)), replace=False)
+        if op == 0:  # availability churn
+            avail[rows] = (rng.integers(0, 4, size=(len(rows), 3)) * 8)
+        elif op == 1:  # delete
+            valid[rows] = False
+        else:  # add / revive
+            valid[rows] = True
+            avail[rows] = (rng.integers(0, 4, size=(len(rows), 3)) * 8)
+        idx.update_rows(avail, name_rank, rows)
+        for z in range(zb):
+            zo = idx.zone_order(z)
+            zrows = zo[valid[zo]]
+            fit = (avail[zrows] >= min_req).all(axis=1)
+            head = zrows[fit][:k]
+            cand = np.flatnonzero(
+                valid
+                & (zone_id == z)
+                & (avail >= min_req).all(axis=1)
+            )
+            full = cand[np.lexsort((
+                cand,
+                name_rank[cand].astype(np.int64),
+                avail[cand, 0].astype(np.int64),
+                avail[cand, 1].astype(np.int64),
+            ))]
+            assert np.array_equal(head, full[:k]), (step, z)
+
+
+def test_delete_then_readd_does_not_release_live_row():
+    """Review regression: a node deleted while a window was in flight
+    (release deferred) and then RE-ADDED must cancel its parked
+    tombstone — releasing the row later would unmap a live node and
+    hand its registry index to the free list."""
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+
+    backend, app, names = _app_with_nodes(12)
+    ext = app.extender
+    ext._last_request = float("inf")
+
+    def serve(tag):
+        d = static_allocation_spark_pods(f"readd-{tag}", 1)[0]
+        backend.add_pod(d)
+        tok = ext.predicate_window_dispatch(
+            [ExtenderArgs(pod=d, node_names=list(names))]
+        )
+        return ext.predicate_window_complete(tok)
+
+    serve("warm")
+    victim = names[-1]
+    row = app.solver.registry.index_of(victim)
+    # Delete + serve (the window in flight at build time defers release),
+    # then re-add the SAME name and keep serving.
+    backend.delete("nodes", "", victim)
+    serve("deleted")
+    backend.add_node(new_node(victim, zone="zone0"))
+    serve("readded")
+    serve("drain")
+    assert app.solver.registry.index_of(victim) == row, (
+        "live re-added node lost its registry row to a stale tombstone"
+    )
+    assert victim not in app.solver._pending_tombstones
+    res = serve("place")
+    assert res[0].node_names
     app.stop()
