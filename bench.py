@@ -522,6 +522,22 @@ def _prune_fields(app):
     }
 
 
+def _build_fields(app) -> dict:
+    """`build_ms` + the mirror-sync row ledgers on every serving JSON line
+    (ISSUE 13): the per-window tensor-build wall time, rows the DENSE
+    mirror sweep examined (0 in steady state — the O(changed) claim as a
+    counter), and rows the event-fed dirty-set sync examined instead."""
+    st = getattr(app.solver, "build_stats", None) or {}
+    builds = max(int(st.get("builds", 0)), 1)
+    return {
+        "build_ms": round(st.get("build_ms", 0.0) / builds, 4),
+        "mirror_rows_compared": int(st.get("mirror_rows_compared", 0)),
+        "build_dirty_rows": int(st.get("dirty_rows", 0)),
+        "build_incremental": int(st.get("incremental_builds", 0)),
+        "build_full_snapshots": int(st.get("full_snapshots", 0)),
+    }
+
+
 def _scale_fields(app, n_nodes) -> dict:
     """`n_nodes` + `upload_bytes_per_event` on every serving JSON line
     (ISSUE 11): the roster size the section served at, and the average
@@ -656,6 +672,7 @@ def bench_serving_http(rng, transport="threaded", ingest="python"):
             # unfused; the fused A/B lives in the fused_dispatch section).
             "fused_k": batcher_fuse,
             **_prune_fields(app),
+            **_build_fields(app),
             **_scale_fields(app, 500),
             "r02_ms": 119.68,
         },
@@ -1025,6 +1042,7 @@ def _bench_serving_concurrent(
         # claim only engages when solver.fuse-windows > 1).
         "fused_k": stats["fuse_windows"],
         **_prune_fields(app),
+        **_build_fields(app),
         **_scale_fields(app, n_nodes),
         # Same rig, null handler, SAME body size (10k-node requests carry
         # ~200 KB of node names): what the 1-core HTTP harness itself can
@@ -1445,6 +1463,7 @@ def bench_serving_http_executors(rng, transport="threaded"):
         "host_cpus": os.cpu_count(),
         "fused_k": 1,  # executor ladder is host-side; no fused dispatch
         **_prune_fields(app),
+        **_build_fields(app),
         **_scale_fields(app, 500),
         "load_generator": "colocated threads, prebuilt bodies (see _threaded_phase)",
         "path": "concurrent executor /predicates -> reservation ladder (host-side)",

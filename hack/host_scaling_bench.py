@@ -149,6 +149,20 @@ def run_tier(n_nodes: int, windows: int) -> dict:
         add_lat.append((time.perf_counter() - t0) * 1e3)
     after_events = dict(stats)
 
+    # Node-ADD burst arm (ISSUE 13): BENCH_ADD_BURST sequential adds, one
+    # served window each — p50/p99 per add measures the AMORTIZED growth
+    # claim (preallocated roster/master buffers, O(changed) patches), not
+    # a single lucky event.
+    burst_n = int(os.environ.get("BENCH_ADD_BURST", "100"))
+    grows_before = ext.features.stats()["array_grows"]
+    burst_lat = []
+    for j in range(burst_n):
+        t0 = time.perf_counter()
+        backend.add_node(new_node(f"burst{j:04d}", zone=f"zone{j % 4}"))
+        serve_window(1)
+        burst_lat.append((time.perf_counter() - t0) * 1e3)
+    burst_grows = ext.features.stats()["array_grows"] - grows_before
+
     fs = ext.features.stats()
 
     # Warm restart (promotion analog): device state dropped, host caches hot.
@@ -168,12 +182,18 @@ def run_tier(n_nodes: int, windows: int) -> dict:
         "per_decision_ms": round(_pct(lat_wide, 50) / 16, 3),
         "node_update_ms_p50": _pct(upd_lat, 50),
         "node_add_ms_p50": _pct(add_lat, 50),
+        "add_burst_n": burst_n,
+        "add_burst_p50_ms": _pct(burst_lat, 50),
+        "add_burst_p99_ms": _pct(burst_lat, 99),
+        "add_burst_array_grows": burst_grows,
         "upload_bytes_per_event": upload_bytes_per_event(
             before_events, after_events
         ),
         "warm_restart_ms": round(warm_restart_ms, 1),
         "roster_rebuilds_after_boot": fs["roster_rebuilds"] - 1,
         "roster_add_patches": fs["roster_add_patches"],
+        "build": dict(app.solver.build_stats),
+        "array_grows": fs["array_grows"],
         "device_state": dict(stats),
         "prune": dict(app.solver.prune_stats, reasons=dict(
             app.solver.prune_stats["reasons"])),

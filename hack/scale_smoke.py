@@ -121,6 +121,10 @@ def main() -> None:
     serve_one("warm1")
     scanned_before = prune["planner_rows_scanned"]
     cold_before = prune["planner_cold_rows"]
+    build = app.solver.build_stats
+    compared_before = build["mirror_rows_compared"]
+    dense_before = build["mirror_dense_syncs"]
+    grows_before = store.stats()["array_grows"]
 
     # Event phase: 4 adds + 4 updates + 4 deletes, one served window
     # each. Added/deleted/updated nodes all sort OUTSIDE every kept set
@@ -177,6 +181,24 @@ def main() -> None:
         prune,
     )
     assert prune["plan_reuse"] > 0 and prune["gather_reuse"] > 0, prune
+    # Tensor-build O(changed) invariants (ISSUE 13): the event phase rode
+    # the event-fed dirty set — ZERO dense [N]-wide mirror sweeps (the
+    # `mirror_rows_compared` counter, the planner rows_scanned pattern) —
+    # and the resident build stayed incremental.
+    assert build["mirror_rows_compared"] == compared_before, (
+        "the tensor build ran a dense mirror sweep in steady state "
+        "(O(N) regression)",
+        build,
+    )
+    assert build["mirror_dense_syncs"] == dense_before, build
+    assert build["incremental_builds"] > 0, build
+    # Amortized roster growth: the add/update/delete burst reallocated NO
+    # resident buffer (the preallocated-capacity claim as a counter).
+    assert store.stats()["array_grows"] == grows_before, (
+        "a node event paid a full-array reallocation "
+        "(amortized-growth regression)",
+        store.stats(),
+    )
 
     print(
         json.dumps(
@@ -188,6 +210,8 @@ def main() -> None:
                 "roster_add_patches": fs["roster_add_patches"],
                 "roster_delete_patches": fs["roster_delete_patches"],
                 "planner_rows_scanned_events": scanned,
+                "build": dict(build),
+                "array_grows": store.stats()["array_grows"],
                 "planner": {
                     k: prune[k]
                     for k in (

@@ -939,6 +939,42 @@ void arena_snapshot(void* h, int64_t n, const int64_t* usage,
   }
 }
 
+// Incremental twin of arena_snapshot: recompute ONLY `rows` into the
+// caller's RESIDENT output buffers (each sized [n] rows). This is the
+// C-speed half of the O(K + changed) tensor build — the per-window full
+// materialization pass over all n slots was a measured ~35-50 ms at the
+// million-node tier even when a handful of rows had changed. Rows at or
+// past n are skipped defensively (the caller's buffers bound the write).
+void arena_snapshot_rows(void* h, const int64_t* rows, int64_t k, int64_t n,
+                         const int64_t* usage, const int64_t* overhead,
+                         int32_t* available, int32_t* schedulable,
+                         int32_t* zone_id, int32_t* name_rank,
+                         int32_t* lr_driver, int32_t* lr_executor,
+                         uint8_t* unschedulable, uint8_t* ready,
+                         uint8_t* valid) {
+  auto* a = static_cast<ClusterArena*>(h);
+  std::lock_guard<std::mutex> lock(a->mu);
+  a->ensure(n > 0 ? n - 1 : 0);
+  for (int64_t r = 0; r < k; ++r) {
+    int64_t i = rows[r];
+    if (i < 0 || i >= n) continue;
+    for (int d = 0; d < kDims; ++d) {
+      int64_t al = a->alloc[i * kDims + d];
+      int64_t ov = overhead[i * kDims + d];
+      int64_t us = usage[i * kDims + d];
+      available[i * kDims + d] = clip64(al - us - ov);
+      schedulable[i * kDims + d] = clip64(al - ov);
+    }
+    zone_id[i] = a->zone_id[i];
+    name_rank[i] = a->name_rank[i];
+    lr_driver[i] = a->lr_driver[i];
+    lr_executor[i] = a->lr_executor[i];
+    unschedulable[i] = a->unschedulable[i];
+    ready[i] = a->ready[i];
+    valid[i] = a->valid[i];
+  }
+}
+
 int64_t arena_capacity(void* h) {
   auto* a = static_cast<ClusterArena*>(h);
   std::lock_guard<std::mutex> lock(a->mu);

@@ -70,9 +70,21 @@ class _DomainNames(list):
     keying the solver's candidate-mask LRU and the window dispatch's
     domain memo on `names_digest` makes every steady-state lookup O(1)
     where tuple-keying hashed (and first built a tuple of) every name —
-    a measured per-window O(N) host cost at the million-node tier."""
+    a measured per-window O(N) host cost at the million-node tier.
+
+    `patch_base`/`patch_added`/`patch_removed` (ISSUE 13) record this
+    ticket's LINEAGE when the domain cache patched membership through a
+    node-event hint: the solver's candidate-mask patch follows the chain
+    and applies the exact deltas instead of re-walking every name — the
+    O(N) mask rebuild per node ADD that dominated the 1M add budget.
+    The solver bounds the chain walk and clears the back-reference once
+    it re-bases, so chains stay one-or-two links in practice."""
 
     __hash__ = object.__hash__
+
+    patch_base = None
+    patch_added: tuple = ()
+    patch_removed: frozenset = frozenset()
 
     def __eq__(self, other):
         return self is other
@@ -545,6 +557,8 @@ class SparkSchedulerExtender:
             statics_version=snap.statics_epoch,
             roster_rows=snap.roster_rows,
             dirty_hint=snap.dirty_hint,
+            avail_epoch=snap.avail_epoch,
+            avail_journal=snap.avail_journal,
         )
         t_tensors = self._clock()
         tensors_ms = (t_tensors - t_snap) * 1e3
@@ -654,6 +668,8 @@ class SparkSchedulerExtender:
             statics_version=snap.statics_epoch,
             roster_rows=snap.roster_rows,
             dirty_hint=snap.dirty_hint,
+            avail_epoch=snap.avail_epoch,
+            avail_journal=snap.avail_journal,
         )
         phases["featurize_tensors_ms"] = (self._clock() - t_snap) * 1e3
         requests = self._stage_driver_window(
@@ -793,6 +809,7 @@ class SparkSchedulerExtender:
                             if nm in name_set
                         }
                         if added or removed:
+                            prev_names = names
                             if removed:
                                 names = _DomainNames(
                                     nm for nm in names if nm not in removed
@@ -801,6 +818,16 @@ class SparkSchedulerExtender:
                                 names = _DomainNames(names)
                             names.extend(added)
                             name_set = (name_set - removed) | set(added)
+                            # Lineage for the solver's candidate-mask
+                            # patch (ISSUE 13): the new ticket names its
+                            # exact membership deltas so the mask updates
+                            # O(changed) instead of re-walking N names.
+                            # The solver clears the back-reference once it
+                            # re-bases its mask on this ticket, so chains
+                            # stay one-or-two links in practice.
+                            names.patch_base = prev_names
+                            names.patch_added = tuple(added)
+                            names.patch_removed = frozenset(removed)
                         domain_by_sig[sig] = names
                         self._domain_cache.put(sig, (topo, names, name_set))
                     else:
@@ -1025,12 +1052,16 @@ class SparkSchedulerExtender:
                 statics_version=snap.statics_epoch,
                 roster_rows=snap.roster_rows,
                 dirty_hint=snap.dirty_hint,
+                avail_epoch=snap.avail_epoch,
+                avail_journal=snap.avail_journal,
             )
         except PipelineDrainRequired:
             return self._solver.build_tensors(
                 snap.nodes, snap.usage, snap.overhead,
                 full_node_list=True, topo_version=snap.nodes_version,
                 roster_rows=snap.roster_rows,
+                avail_epoch=snap.avail_epoch,
+                avail_journal=snap.avail_journal,
             )
 
     def _mark_outcome(self, pod, role, outcome, timer_start) -> None:

@@ -22,47 +22,73 @@ The store keeps every host feature RESIDENT and epoch-versioned:
                     re-copied only when its version moved.
 
 `snapshot()` is the serving window's single featurize read: when nothing
-changed since the previous window it returns the SAME immutable arrays and
-tuples (zero work, zero copies); when k rows changed it costs one
-vectorized copy of the changed aggregate; only a node add/update/delete
-pays the O(nodes) roster walk — i.e. per-window featurize is
-O(window + dirty state), not O(nodes).
+changed since the previous window it returns the SAME immutable arrays
+(zero work, zero copies); when k rows changed it costs k row patches into
+the RESIDENT masters (ISSUE 13 — the per-refresh full [cap, 3] copies are
+gone: the tracker/overhead mirrors name their dirty rows and the store
+scatters just those); only a node add/update/delete pays the O(changed)
+roster patch — i.e. per-window featurize is O(window + dirty rows), never
+O(nodes).
 
 `statics_epoch` bumps exactly when the roster was re-walked; the solver's
 pipelined builder keys its static-field equality check on it, skipping the
 eight per-window O(nodes) array compares when no node event occurred.
 
-Thread-safety: snapshots are built under the store lock against
-version-consistent copies, so informer/listener threads mutating the
-underlying aggregates can never tear a snapshot already handed out.
+`avail_epoch` / `avail_journal` (ISSUE 13): the store names EXACTLY which
+registry rows' availability inputs (usage / overhead / node statics)
+changed in each refresh epoch — the solver's resident tensor build and its
+pipelined device mirror sync by scattering those rows instead of running a
+dense [N]-wide compare per window. A refresh that cannot name its rows
+(from-scratch tracker rebuild, roster re-list) BREAKS the journal: the
+epoch bumps with no entry, and the solver falls back to the dense compare
+for that one build.
+
+Capacity growth is AMORTIZED (ISSUE 13): the usage/overhead masters, the
+live-row mask and the roster-row buffer are allocated at the power-of-two
+bucket of the registry capacity, so a node-ADD burst appends in place —
+`array_grows` counts the reallocations (CI pins zero across a burst).
+
+Thread-safety: all mutation happens inside `snapshot()` under the store
+lock, and the serving paths take their snapshot and consume it within the
+request on the predicate batcher's single dispatcher thread. Handed-out
+arrays are read-only VIEWS of the resident masters: a consumer that parks
+a snapshot across later refreshes observes newer row values (resident-
+state semantics) — every decision path in this repo reads its snapshot
+immediately after taking it.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Mapping, NamedTuple, Optional
+from typing import Any, Mapping, NamedTuple, Optional, Sequence
 
 import numpy as np
 
 from spark_scheduler_tpu.models.resources import NUM_DIMS
 
 
+from spark_scheduler_tpu.models.cluster import (  # noqa: E402
+    pad_bucket as _bucket,
+)
+
+
 class FeatureSnapshot(NamedTuple):
-    """One window's host-feature view. Arrays are frozen (writeable=False)
-    and shared across snapshots until the underlying state changes — treat
-    everything here as read-only."""
+    """One window's host-feature view. Arrays are read-only views of the
+    store's resident masters, shared across snapshots until the underlying
+    rows change — treat everything here as read-only and consume it within
+    the taking request (see the module docstring's residency contract)."""
 
     epoch: int  # bumps on ANY tracked change
     statics_epoch: int  # bumps only on roster (node) changes
     nodes_version: Optional[int]  # backend nodes_version; None if racing
-    nodes: tuple  # full node roster
+    nodes: Sequence[Any]  # full node roster (store-owned; read-only)
     by_name: Mapping[str, Any]  # name -> Node over the same roster
     usage: Any  # dense int64 [cap,3] (or {node: Resources} w/o tracker)
     overhead: np.ndarray  # dense int64 [cap,3]
-    # Registry row of each node in `nodes` order (int32, frozen) — lets
-    # the solver scatter its request mask instead of walking 100k
-    # name->index lookups per cold build. None only when the registry was
-    # churning under the rebuild.
+    # Registry row of each node in `nodes` order (int32 read-only view of
+    # the preallocated roster buffer) — lets the solver scatter its
+    # request mask instead of walking 100k name->index lookups per cold
+    # build. None only when the registry was churning under the rebuild.
     roster_rows: Optional[np.ndarray] = None
     # (previous nodes_version, changed Node objects) when this snapshot's
     # roster differs from the last one by UPDATES AND/OR ADDS only — the
@@ -71,6 +97,17 @@ class FeatureSnapshot(NamedTuple):
     # O(nodes) identity walk. None = no hint (full walk on version
     # mismatch; deletes always rebuild).
     dirty_hint: Optional[tuple] = None
+    # Availability-input change journal (ISSUE 13): `avail_epoch` is the
+    # store's refresh epoch for availability inputs, and `avail_journal`
+    # maps each epoch to (usage_rows, overhead_rows, node_rows) — the
+    # EXACT registry rows whose usage / overhead / node-static inputs
+    # changed in that epoch (split so the solver copies-on-write only the
+    # static fields a class of change can touch). The solver's
+    # resident tensor build recomputes just those rows and its pipelined
+    # mirror syncs by scattering them; a missing epoch (journal break or
+    # eviction) sends it to the dense-compare fallback for one build.
+    avail_epoch: Optional[int] = None
+    avail_journal: Optional[Mapping[int, tuple]] = None
 
 
 class RankIndex:
@@ -97,12 +134,13 @@ class RankIndex:
     """
 
     __slots__ = (
-        "_zorders", "_pos", "_zone", "_mem", "_cpu", "_name",
-        "num_zones", "rebuilds", "incremental_updates",
+        "_zorders", "_zrows", "_pos", "_zone", "_mem", "_cpu", "_name",
+        "num_zones", "rebuilds", "incremental_updates", "zone_sorts",
     )
 
     def __init__(self):
         self._zorders: list | None = None  # [Zb] of [n_z] int32 row arrays
+        self._zrows: list | None = None  # [Zb] unsorted rows of LAZY zones
         self._pos: np.ndarray | None = None  # [N] int32 pos within zone order
         self._zone: np.ndarray | None = None  # [N] int32
         self._mem: np.ndarray | None = None  # [N] int64 key snapshots
@@ -111,6 +149,7 @@ class RankIndex:
         self.num_zones = 0
         self.rebuilds = 0
         self.incremental_updates = 0
+        self.zone_sorts = 0  # deferred per-zone lexsorts actually paid
 
     def invalidate(self) -> None:
         self._zorders = None
@@ -138,21 +177,36 @@ class RankIndex:
         self._name = np.asarray(name_rank).astype(np.int64)
         self._zone = np.asarray(zone_id).astype(np.int32)
         self.num_zones = int(num_zones)
-        rows = np.arange(n)
-        order = np.lexsort(
-            (rows, self._name, self._cpu, self._mem)
-        ).astype(np.int32)
-        # Split the global order by zone (stable: relative order within a
-        # zone is the zone's priority order) and invert to per-zone
-        # positions in one pass.
+        # LAZY per-zone cold build (ISSUE 13 tentpole (d)): the rebuild
+        # pays only one stable zone-bucketing pass (radix argsort of the
+        # int32 zone ids — no key comparisons); each zone's 4-key LEXSORT,
+        # the expensive part of the old global cold build, is deferred to
+        # the zone's first `zone_order` touch. A restart that re-plans one
+        # zone pays one zone's sort, not the global one.
+        order = np.argsort(self._zone, kind="stable").astype(np.int32)
         zo = self._zone[order]
-        self._zorders = [
-            order[zo == z] for z in range(self.num_zones)
+        bounds = np.searchsorted(zo, np.arange(self.num_zones + 1))
+        self._zrows = [
+            order[bounds[z]:bounds[z + 1]] for z in range(self.num_zones)
         ]
+        self._zorders = [None] * self.num_zones
         self._pos = np.empty(n, np.int32)
-        for zorder in self._zorders:
-            self._pos[zorder] = np.arange(len(zorder), dtype=np.int32)
         self.rebuilds += 1
+
+    def _materialize(self, z: int) -> np.ndarray:
+        """Pay zone z's deferred lexsort and make its order resident."""
+        rows = self._zrows[z]
+        if rows.size:
+            zorder = rows[np.lexsort(
+                (rows, self._name[rows], self._cpu[rows], self._mem[rows])
+            )].astype(np.int32)
+        else:
+            zorder = rows.astype(np.int32)
+        self._zorders[z] = zorder
+        self._pos[zorder] = np.arange(len(zorder), dtype=np.int32)
+        self._zrows[z] = zorder  # keep slots aligned; no longer consulted
+        self.zone_sorts += 1
+        return zorder
 
     def update_rows(
         self, avail: np.ndarray, name_rank: np.ndarray, dirty: np.ndarray,
@@ -176,6 +230,11 @@ class RankIndex:
         )
         old_zone = self._zone[d]
         touched = np.unique(np.concatenate([old_zone, new_zone]))
+        # A lazily-deferred zone must materialize before its order can be
+        # merged into (its _pos entries are unset until then).
+        for z in touched:
+            if self._zorders[z] is None:
+                self._materialize(int(z))
         # Remove the dirty rows from their OLD zones' orders.
         for z in touched:
             zorder = self._zorders[z]
@@ -240,14 +299,20 @@ class RankIndex:
         return lo
 
     def zone_order(self, z: int) -> np.ndarray:
-        """Zone z's rows in priority order (treat as read-only)."""
-        return self._zorders[z]
+        """Zone z's rows in priority order (treat as read-only); pays the
+        zone's deferred cold lexsort on first touch."""
+        zo = self._zorders[z]
+        return zo if zo is not None else self._materialize(z)
 
     def order(self) -> np.ndarray:
         """The GLOBAL priority order, merged from the zone orders — an
         O(N log N) reconstruction for oracles/tests; the serving planner
         only ever walks zone orders."""
-        parts = [z for z in self._zorders if len(z)]
+        parts = [
+            self.zone_order(z)
+            for z in range(self.num_zones)
+        ]
+        parts = [z for z in parts if len(z)]
         if not parts:
             return np.empty(0, np.int32)
         rows = np.concatenate(parts)
@@ -259,9 +324,19 @@ class RankIndex:
         return {
             "rebuilds": self.rebuilds,
             "incremental_updates": self.incremental_updates,
+            "zone_sorts": self.zone_sorts,
             "rows": self.rows,
             "zones": 0 if not self.valid else sum(
-                1 for z in self._zorders if len(z)
+                1
+                for z in range(self.num_zones)
+                if len(
+                    self._zorders[z]
+                    if self._zorders[z] is not None
+                    else self._zrows[z]
+                )
+            ),
+            "lazy_zones": 0 if not self.valid else sum(
+                1 for z in self._zorders if z is None
             ),
         }
 
@@ -273,7 +348,10 @@ class HostFeatureStore:
         self._overhead = overhead_computer
         self._rrm = reservation_manager
         self._lock = threading.Lock()
-        self._nodes: tuple = ()
+        # Roster structures are store-OWNED and mutated in place (adds
+        # append, updates assign; a delete burst copies once — see
+        # _refresh_roster). Snapshots expose them directly.
+        self._nodes: list = []
         self._by_name: dict[str, Any] = {}
         self._node_pos: dict[str, int] = {}  # name -> position in _nodes
         self._roster_topo: Optional[int] = None
@@ -291,29 +369,65 @@ class HostFeatureStore:
         # past the ratio threshold ONE full rebuild re-compacts the
         # roster structures.
         self._tombstones = 0
-        self._roster_rows: Optional[np.ndarray] = None
+        # Preallocated roster-row buffer (ISSUE 13 amortized growth):
+        # `_roster_buf[:len(nodes)]` is the registry row of each roster
+        # position; snapshots hand out a read-only VIEW. Adds append in
+        # place; a delete burst pays ONE copy-on-write (stale snapshots
+        # keep positional integrity) and then swap-removes on the owned
+        # copy — the per-delete np.array(...) copy is gone.
+        self._roster_buf: np.ndarray = np.empty(8, np.int32)
+        self._roster_view: Optional[np.ndarray] = None
         self._dirty_hint: Optional[tuple] = None
         self._statics_epoch = 0
         self._epoch = 0
+        # Resident masters (ISSUE 13): writable int64 [bucket(cap), 3]
+        # aggregates patched O(changed) from the tracker/overhead dirty
+        # feeds; snapshots hand out read-only views. Sized at the
+        # power-of-two bucket of the registry capacity — the same bucket
+        # the solver pads to, so `_dense_or_scatter` stays zero-copy.
+        self._usage_master: Optional[np.ndarray] = None
         self._usage: Optional[np.ndarray] = None
         self._usage_version: Optional[int] = None
+        self._overhead_master: Optional[np.ndarray] = None
         self._overhead_arr = np.zeros((1, NUM_DIMS), np.int64)
         self._overhead_arr.flags.writeable = False
         self._overhead_version: Optional[int] = None
+        self._overhead_full = True  # force first full overhead resync
         # Live-roster row mask over the registry index space: the overhead
-        # copy zeroes non-live rows so the dense view equals the legacy
+        # master zeroes non-live rows so the dense view equals the legacy
         # get_overhead(all_nodes) dict exactly (a deleted node whose pods
         # still exist keeps aggregate rows that the dict never surfaced).
         self._roster_mask: Optional[np.ndarray] = None
+        # Rows whose live-mask bit flipped since the last overhead refresh
+        # (adds + deletes) — the overhead master re-masks just those.
+        self._mask_flips: list = []
+        # Availability-input journal (ISSUE 13): epoch -> (usage rows,
+        # static rows) changed in that refresh. `_avail_break` bumps the
+        # epoch WITHOUT an entry — the solver detects the gap and runs its
+        # dense-compare fallback once. `journal_enabled=False` (tests)
+        # withholds the journal so the dense oracle path serves every
+        # window.
+        self._avail_epoch = 0
+        self._avail_journal: dict[int, tuple] = {}
+        self._pending_arows: list = []  # usage rows (available only)
+        self._pending_orows: list = []  # overhead rows (avail+schedulable)
+        self._pending_nrows: list = []  # node/roster rows (all statics)
+        self.journal_enabled = True
         # Instrumentation — the O(changed) claim as counters, consumed by
-        # the tier-1 budget test and the featurize telemetry gauges.
+        # the tier-1 budget test, the CI scale smoke and the featurize
+        # telemetry gauges. `array_grows` counts capacity reallocations of
+        # the resident buffers (amortized growth: zero across an ADD
+        # burst that stays inside the bucket).
         self.snapshots = 0
         self.roster_rebuilds = 0
         self.roster_patches = 0
         self.roster_add_patches = 0
         self.roster_delete_patches = 0
         self.usage_refreshes = 0
+        self.usage_patches = 0
         self.overhead_refreshes = 0
+        self.overhead_patches = 0
+        self.array_grows = 0
         overhead_computer.attach_registry(registry)
         # Node events only mark the roster dirty (O(1)); the next snapshot
         # pays ONE refresh for the whole burst — a patch (O(changed) dict
@@ -394,6 +508,7 @@ class HostFeatureStore:
             self._refresh_roster()
             usage = self._refresh_usage()
             self._refresh_overhead()
+            self._avail_commit()
             hint = self._dirty_hint
             self._dirty_hint = None  # one consumer, one hand-off
             return FeatureSnapshot(
@@ -404,9 +519,80 @@ class HostFeatureStore:
                 by_name=self._by_name,
                 usage=usage,
                 overhead=self._overhead_arr,
-                roster_rows=self._roster_rows,
+                roster_rows=self._roster_rows_view(),
                 dirty_hint=hint,
+                avail_epoch=(
+                    self._avail_epoch if self.journal_enabled else None
+                ),
+                avail_journal=(
+                    self._avail_journal if self.journal_enabled else None
+                ),
             )
+
+    # -- availability-input journal (ISSUE 13) --------------------------------
+
+    def _avail_break(self) -> None:
+        """A refresh could not name its changed rows: bump the epoch with
+        NO journal entry — the solver's next resident build detects the
+        gap and runs its dense-compare fallback once."""
+        self._avail_epoch += 1
+        self._avail_journal.clear()
+        self._pending_arows = []
+        self._pending_orows = []
+        self._pending_nrows = []
+
+    def _avail_commit(self) -> None:
+        """Fold this snapshot's named row changes into one journal epoch."""
+        if not (
+            self._pending_arows or self._pending_orows or self._pending_nrows
+        ):
+            return
+
+        def _fold(parts):
+            return (
+                np.unique(np.concatenate(parts))
+                if parts
+                else np.empty(0, np.int64)
+            )
+
+        arows = _fold(self._pending_arows)
+        orows = _fold(self._pending_orows)
+        nrows = _fold(self._pending_nrows)
+        self._pending_arows = []
+        self._pending_orows = []
+        self._pending_nrows = []
+        self._avail_epoch += 1
+        self._avail_journal[self._avail_epoch] = (arows, orows, nrows)
+        while len(self._avail_journal) > 64:
+            self._avail_journal.pop(next(iter(self._avail_journal)))
+
+    # -- resident-buffer sizing (ISSUE 13 amortized growth) -------------------
+
+    def _master_len(self) -> int:
+        return _bucket(max(self._registry.capacity, 1), 8)
+
+    def _new_roster_buf(self, n: int) -> np.ndarray:
+        return np.empty(_bucket(max(n, 8), 8), np.int32)
+
+    def _roster_rows_view(self) -> Optional[np.ndarray]:
+        n = len(self._nodes)
+        v = self._roster_view
+        if v is None or v.shape[0] != n or v.base is not self._roster_buf:
+            v = self._roster_buf[:n].view()
+            v.flags.writeable = False
+            self._roster_view = v
+        return v
+
+    def _ensure_mask(self) -> np.ndarray:
+        need = self._master_len()
+        mask = self._roster_mask
+        if mask is None or mask.shape[0] < need:
+            grown = np.zeros(need, dtype=bool)
+            if mask is not None:
+                grown[: mask.shape[0]] = mask
+                self.array_grows += 1
+            self._roster_mask = mask = grown
+        return mask
 
     def _refresh_roster(self) -> None:
         """Refresh the roster only when a node event (or an unobserved
@@ -457,71 +643,91 @@ class HostFeatureStore:
             self._dirty_updates = {}
             self._dirty_adds = {}
             self._dirty_deletes = {}
-            nodes = list(self._nodes)
-            by_name = dict(self._by_name)
+            # Store-owned roster structures, patched IN PLACE (ISSUE 13
+            # amortized growth): an update assigns its position, an add
+            # appends — no O(nodes) list/dict copy per event. Only a
+            # delete burst pays one copy-on-write of the list + row
+            # buffer (stale snapshots keep positional integrity) before
+            # swap-removing on the owned copies.
+            nodes = self._nodes
+            by_name = self._by_name
             pos = self._node_pos
-            for name, node in updates.items():
-                nodes[pos[name]] = node
-                by_name[name] = node
+            if updates:
+                upd_rows = np.asarray(
+                    [self._roster_buf[pos[name]] for name in updates],
+                    np.int64,
+                )
+                for name, node in updates.items():
+                    nodes[pos[name]] = node
+                    by_name[name] = node
+                self._pending_nrows.append(upd_rows)
             if deletes:
-                # DELETE patch (ISSUE 12, O(changed)): swap-remove each
-                # deleted node (the last roster entry fills its hole, so
-                # only ONE position shifts per delete), clear its
-                # live-mask row (the overhead copy re-masks on its next
-                # refresh), and drop its registry row from roster_rows —
-                # the row itself stays interned as a TOMBSTONE until the
-                # solver recycles it. The existing roster is never
-                # re-listed or re-interned.
-                rows_arr = np.array(self._roster_rows)
-                mask = self._roster_mask
+                # DELETE patch (ISSUE 12/13, O(changed) + one COW):
+                # swap-remove each deleted node (the last roster entry
+                # fills its hole, so only ONE position shifts per
+                # delete), clear its live-mask row (the overhead master
+                # re-masks just the flipped rows), and drop its registry
+                # row from the roster buffer — the row itself stays
+                # interned as a TOMBSTONE until the solver recycles it.
+                # The existing roster is never re-listed or re-interned,
+                # and the old per-delete np.array(...) full copy is gone.
+                # The list, row buffer AND by-name map all copy-on-write
+                # ONCE per burst: an in-flight window's ticket parks the
+                # old snapshot's structures across its dispatch->complete
+                # gap and indexes by_name with dispatch-time names — an
+                # in-place pop would KeyError its completion.
+                nodes = self._nodes = list(nodes)
+                by_name = self._by_name = dict(by_name)
+                n = len(nodes)
+                buf = self._new_roster_buf(n)
+                buf[:n] = self._roster_buf[:n]
+                self._roster_buf = buf
+                mask = self._ensure_mask()
+                del_rows: list[int] = []
                 for name in deletes:
                     i = pos.pop(name)
                     by_name.pop(name, None)
                     last = len(nodes) - 1
-                    row = rows_arr[i]
+                    row = int(buf[i])
                     if i != last:
                         nodes[i] = nodes[last]
-                        rows_arr[i] = rows_arr[last]
+                        buf[i] = buf[last]
                         pos[nodes[i].name] = i
                     nodes.pop()
-                    rows_arr = rows_arr[:last]
-                    if mask is not None and 0 <= row < mask.shape[0]:
+                    if 0 <= row < mask.shape[0]:
                         mask[row] = False
-                rows_arr = rows_arr.copy()
-                rows_arr.flags.writeable = False
-                self._roster_rows = rows_arr
-                self._overhead_version = None  # re-mask on next refresh
+                    del_rows.append(row)
+                flips = np.asarray(del_rows, np.int64)
+                self._mask_flips.append(flips)
+                self._pending_nrows.append(flips)
                 self._tombstones += len(deletes)
                 self.roster_delete_patches += 1
             if adds:
-                # APPEND path (node-ADD, O(changed)): new names intern in
-                # one bulk call, the registry-row array and live-row mask
-                # extend in place, and the overhead copy re-masks against
-                # the grown mask on its next refresh. The existing roster
-                # is never re-listed or re-interned.
+                # APPEND path (node-ADD, O(changed) amortized): new names
+                # intern in one bulk call and append into the
+                # preallocated roster buffer / live mask — growth is
+                # bucketed doubling, so a burst reallocates nothing
+                # (array_grows counts the exceptions).
+                start = len(nodes)
                 for name, node in adds.items():
                     pos[name] = len(nodes)
                     nodes.append(node)
                     by_name[name] = node
                 new_rows = self._registry.intern_many(list(adds))
-                rows = np.concatenate(
-                    [self._roster_rows, new_rows.astype(np.int32)]
-                )
-                rows.flags.writeable = False
-                self._roster_rows = rows
-                cap = max(self._registry.capacity, 1)
-                mask = self._roster_mask
-                if mask is None or mask.shape[0] < cap:
-                    grown = np.zeros(cap, dtype=bool)
-                    if mask is not None:
-                        grown[: mask.shape[0]] = mask
-                    mask = grown
+                n = len(nodes)
+                if n > self._roster_buf.shape[0]:
+                    buf = self._new_roster_buf(n)
+                    buf[:start] = self._roster_buf[:start]
+                    self._roster_buf = buf
+                    self.array_grows += 1
+                self._roster_buf[start:n] = new_rows
+                mask = self._ensure_mask()
                 mask[new_rows] = True
-                self._roster_mask = mask
-                self._overhead_version = None  # re-mask on next refresh
+                flips = new_rows.astype(np.int64)
+                self._mask_flips.append(flips)
+                self._pending_nrows.append(flips)
                 self.roster_add_patches += 1
-            self._nodes = tuple(nodes)
-            self._by_name = by_name
+            self._roster_view = None  # length moved: re-slice on demand
             self._roster_topo = topo
             self._roster_dirty = False
             # 3-tuple since ISSUE 12: (base version, changed Nodes,
@@ -538,7 +744,7 @@ class HostFeatureStore:
             return
         nodes = self._backend.list_nodes()
         topo_after = getattr(self._backend, "nodes_version", None)
-        self._nodes = tuple(nodes)
+        self._nodes = list(nodes)
         self._by_name = {n.name: n for n in nodes}
         self._node_pos = {n.name: i for i, n in enumerate(nodes)}
         raced = topo is None or topo != topo_after
@@ -551,15 +757,21 @@ class HostFeatureStore:
         self._tombstones = 0
         self._dirty_hint = None
         # Rebuild the live-row mask (we are already on the O(nodes) path)
-        # and force the overhead copy to re-mask against it. One bulk
-        # intern instead of a lock acquire per name.
+        # and force the overhead master's full resync against it. One bulk
+        # intern instead of a lock acquire per name. The journal breaks:
+        # a re-list cannot name which rows drifted.
         rows = self._registry.intern_many([n.name for n in nodes])
-        rows.flags.writeable = False
-        self._roster_rows = rows
-        mask = np.zeros(max(self._registry.capacity, 1), dtype=bool)
+        n = len(nodes)
+        buf = self._new_roster_buf(n)
+        buf[:n] = rows
+        self._roster_buf = buf
+        self._roster_view = None
+        mask = np.zeros(self._master_len(), dtype=bool)
         mask[rows] = True
         self._roster_mask = mask
-        self._overhead_version = None
+        self._mask_flips = []
+        self._overhead_full = True
+        self._avail_break()
         self._statics_epoch += 1
         self._epoch += 1
         self.roster_rebuilds += 1
@@ -568,39 +780,115 @@ class HostFeatureStore:
         tracker = self._rrm.usage_tracker
         if tracker is None:
             # No tracker attached (legacy wiring): the map fallback has no
-            # version to key on, so every snapshot is a fresh walk.
+            # version to key on, so every snapshot is a fresh walk — and
+            # the journal cannot name rows.
             self._epoch += 1
+            self._avail_break()
             return self._rrm.reserved_usage()
-        version = tracker.version
-        if self._usage is None or version != self._usage_version:
-            arr = tracker.array()
-            arr.flags.writeable = False
-            self._usage = arr
-            self._usage_version = version
-            self._epoch += 1
+        need = self._master_len()
+        master = self._usage_master
+        if (
+            master is not None
+            and master.shape[0] == need
+            and tracker.version == self._usage_version
+        ):
+            return self._usage
+        version, rows, vals = tracker.collect_delta()
+        if master is None or rows is None or master.shape[0] != need:
+            # Full resync: cold start, a tracker rebuild, or capacity
+            # growth past the master's bucket (counted as a realloc).
+            arr = tracker.array(min_rows=need)
+            if arr.shape[0] != need:
+                arr = np.ascontiguousarray(arr[:need])
+            if master is not None and master.shape[0] != need:
+                self.array_grows += 1
+            self._usage_master = arr
+            view = arr.view()
+            view.flags.writeable = False
+            self._usage = view
+            self._avail_break()
             self.usage_refreshes += 1
+        elif rows.size:
+            # O(changed): scatter the tracker's named dirty rows into the
+            # resident master and journal them for the solver's build.
+            inside = rows < need
+            rows = rows[inside]
+            master[rows] = vals[inside]
+            self._pending_arows.append(rows)
+            self.usage_patches += 1
+        self._usage_version = version
+        self._epoch += 1
         return self._usage
 
     def _refresh_overhead(self) -> None:
-        version, arr = self._overhead.overhead_snapshot(self._overhead_version)
-        if arr is not None:  # None = unchanged since our cached copy
-            mask = self._roster_mask
-            if mask is not None:
-                rows = min(arr.shape[0], mask.shape[0])
-                arr[:rows][~mask[:rows]] = 0
-                arr[rows:] = 0  # interned-after-roster rows are not live
-            arr.flags.writeable = False
-            self._overhead_arr = arr
-            self._overhead_version = version
-            self._epoch += 1
-            # Overhead feeds `schedulable = allocatable - overhead`, a
-            # STATIC field of the cluster tensors: an overhead change must
-            # invalidate the solver's statics-epoch skip (back to the
-            # array compare, which sees the schedulable drift and forces
-            # the full re-upload) or the device would score efficiencies
-            # against a stale schedulable tensor.
-            self._statics_epoch += 1
+        need = self._master_len()
+        master = self._overhead_master
+        if (
+            master is not None
+            and master.shape[0] == need
+            and not self._overhead_full
+            and not self._mask_flips
+            and self._overhead.overhead_version == self._overhead_version
+        ):
+            return
+        version, rows, vals = self._overhead.collect_delta()
+        mask = self._ensure_mask()
+        if (
+            master is None
+            or rows is None
+            or master.shape[0] != need
+            or self._overhead_full
+        ):
+            # Full resync: cold start, an overhead-mirror rebuild, a
+            # roster re-list, or capacity growth past the bucket.
+            _, arr = self._overhead.overhead_snapshot()
+            full = np.zeros((need, NUM_DIMS), np.int64)
+            r = min(arr.shape[0], need)
+            full[:r] = arr[:r]
+            full[~mask[:need]] = 0
+            if master is not None and master.shape[0] != need:
+                self.array_grows += 1
+            self._overhead_master = full
+            view = full.view()
+            view.flags.writeable = False
+            self._overhead_arr = view
+            self._mask_flips = []
+            self._overhead_full = False
+            self._avail_break()
             self.overhead_refreshes += 1
+        else:
+            # O(changed): the mirror's named dirty rows plus any live-mask
+            # flips (node add/delete) re-mask and scatter in place.
+            flips = self._mask_flips
+            self._mask_flips = []
+            parts = ([rows] if rows.size else []) + flips
+            if not parts:
+                if version == self._overhead_version:
+                    return
+                rows_all = np.empty(0, np.int64)
+            elif not flips:
+                # Common case: mirror dirt only — the values were already
+                # copied under the mirror's lock by collect_delta.
+                rows_all = rows[rows < need]
+                vals = vals[rows < need]
+            else:
+                rows_all = np.unique(np.concatenate(parts))
+                rows_all = rows_all[rows_all < need]
+                vals = self._overhead.dense_values(rows_all)
+            if rows_all.size:
+                vals[~mask[rows_all]] = 0
+                master[rows_all] = vals
+                self._pending_orows.append(rows_all)
+                self.overhead_patches += 1
+        self._overhead_version = version
+        self._epoch += 1
+        # Overhead feeds `schedulable = allocatable - overhead`, a
+        # STATIC field of the cluster tensors: an overhead change must
+        # invalidate the solver's statics-epoch skip (back to the
+        # array compare / static row-delta, which sees the schedulable
+        # drift) or the device would score efficiencies against a stale
+        # schedulable tensor.
+        self._statics_epoch += 1
 
     # -- introspection --------------------------------------------------------
 
@@ -614,7 +902,11 @@ class HostFeatureStore:
                 "roster_delete_patches": self.roster_delete_patches,
                 "tombstones": self._tombstones,
                 "usage_refreshes": self.usage_refreshes,
+                "usage_patches": self.usage_patches,
                 "overhead_refreshes": self.overhead_refreshes,
+                "overhead_patches": self.overhead_patches,
+                "array_grows": self.array_grows,
+                "avail_epoch": self._avail_epoch,
                 "nodes": len(self._nodes),
                 "statics_epoch": self._statics_epoch,
             }

@@ -42,6 +42,7 @@ from spark_scheduler_tpu.models.resources import (
     FrozenResources,
     Resources,
 )
+from spark_scheduler_tpu.core.dirty_feed import DirtyRowFeed
 from spark_scheduler_tpu.core.sparkpods import SPARK_SCHEDULER_NAME
 from spark_scheduler_tpu.store.cache import BatchableListener
 
@@ -80,6 +81,12 @@ class OverheadComputer:
         self._registry = None
         self._dense: np.ndarray | None = None
         self.overhead_version = 0
+        # Dirty-row feed for the HostFeatureStore's resident overhead
+        # master (ISSUE 13): rows the dense mirror changed since the last
+        # drain, so the store patches O(changed) instead of copying the
+        # whole [cap, 3] array (core/dirty_feed.py — the drain protocol
+        # shared with the usage tracker).
+        self._dirty = DirtyRowFeed()
         # Instrumentation: per-event membership recomputes (delta evidence).
         self.recomputes = 0
         backend.subscribe(
@@ -220,6 +227,7 @@ class OverheadComputer:
                         self._dense, ((0, grow - self._dense.shape[0]), (0, 0))
                     )
                 self._dense[idx] += sign * res.as_array().astype(np.int64)
+                self._dirty.note(idx)
 
     # -- dense feed (HostFeatureStore) ---------------------------------------
 
@@ -239,6 +247,33 @@ class OverheadComputer:
                 dense[idx] += res.as_array().astype(np.int64)
             self._dense = dense
             self.overhead_version += 1
+            self._dirty.mark_unknown()
+
+    def collect_delta(self):
+        """Drain the dirty-row feed (single consumer: the feature store's
+        resident overhead master). Returns (version, rows, vals) — rows is
+        None when the mirror cannot name its changes (a re-attach rebuild):
+        the consumer then takes one full `overhead_snapshot` copy. vals are
+        the current values of `rows`, copied under the lock (consistent
+        with `version`). Requires attach_registry."""
+        with self._lock:
+            if self._dense is None:
+                raise RuntimeError("attach_registry() before collect_delta()")
+            rows, vals = self._dirty.drain(self._dense)
+            return self.overhead_version, rows, vals
+
+    def dense_values(self, rows: np.ndarray) -> np.ndarray:
+        """Current dense-mirror values of `rows` (a consistent copy under
+        the lock) — the feature store's live-mask-flip patch input. Rows
+        beyond the mirror (interned after the last delta) read as zero."""
+        with self._lock:
+            if self._dense is None:
+                raise RuntimeError("attach_registry() before dense_values()")
+            rows = np.asarray(rows, dtype=np.int64)
+            out = np.zeros((rows.shape[0], NUM_DIMS), np.int64)
+            inside = rows < self._dense.shape[0]
+            out[inside] = self._dense[rows[inside]]
+            return out
 
     def overhead_snapshot(self, last_version: int | None = None):
         """(version, dense copy | None): None when nothing changed since

@@ -95,11 +95,7 @@ _I64_MAX = np.iinfo(np.int64).max
 _I64_MIN = np.iinfo(np.int64).min
 
 
-def _bucket(n: int, minimum: int) -> int:
-    out = minimum
-    while out < n:
-        out *= 2
-    return out
+from spark_scheduler_tpu.models.cluster import pad_bucket as _bucket  # noqa: E402
 
 
 def _zone_sum(zones: np.ndarray, vals: np.ndarray, zb: int) -> np.ndarray:

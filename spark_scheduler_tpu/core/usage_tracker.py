@@ -30,6 +30,7 @@ import threading
 
 import numpy as np
 
+from spark_scheduler_tpu.core.dirty_feed import DirtyRowFeed
 from spark_scheduler_tpu.models.cluster import NodeRegistry
 from spark_scheduler_tpu.models.resources import NUM_DIMS, Resources
 from spark_scheduler_tpu.store.cache import BatchableListener
@@ -50,6 +51,12 @@ class ReservedUsageTracker:
         # the "per-request host work proportional to the delta" evidence.
         self.deltas_applied = 0
         self.rebuilds = 0
+        # Dirty-row feed for the HostFeatureStore's resident usage master
+        # (ISSUE 13): every scatter records its row so the store patches
+        # O(changed) rows instead of copying the whole [cap, 3] array per
+        # serving window (core/dirty_feed.py — the drain protocol shared
+        # with the overhead mirror).
+        self._dirty = DirtyRowFeed()
         # Batch-aware: a serving window's coalesced reservation write-back
         # (create_reservations_batch under rr_cache.deferred_notifications)
         # applies all its per-slot diffs under ONE lock hold instead of one
@@ -101,6 +108,22 @@ class ReservedUsageTracker:
                 self._scatter(node, res, +1)
             self.rebuilds += 1
             self.version += 1
+            self._dirty.mark_unknown()
+
+    def collect_delta(self):
+        """Drain the dirty-row feed (single consumer: the feature store's
+        resident usage master). Returns (version, rows, vals):
+
+          rows  int64 registry rows whose usage changed since the last
+                drain (deduplicated), or None when the tracker cannot name
+                them (a from-scratch rebuild happened) — the consumer then
+                pays one full `array()` copy;
+          vals  the current [len(rows), 3] int64 values of those rows,
+                copied under the tracker lock (consistent with `version`).
+        """
+        with self._lock:
+            rows, vals = self._dirty.drain(self._dense)
+            return self.version, rows, vals
 
     def _ensure_row(self, idx: int) -> None:
         if idx >= self._dense.shape[0]:
@@ -115,6 +138,7 @@ class ReservedUsageTracker:
         self._dense[idx] += sign * res.as_array().astype(np.int64)
         self.deltas_applied += 1
         self.version += 1
+        self._dirty.note(idx)
 
     # -- listeners -----------------------------------------------------------
 

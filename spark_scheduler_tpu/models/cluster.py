@@ -110,6 +110,19 @@ def cluster_from_statics(available, statics: tuple) -> ClusterTensors:
     return ClusterTensors(available, *statics)
 
 
+def pad_bucket(n: int, minimum: int) -> int:
+    """Power-of-two size bucketing, THE shared sizing function of the
+    resident-state stack: the solver pads its tensors, the feature store
+    sizes its usage/overhead masters and roster buffers, and the prune
+    planner buckets K with this same function. The store/solver equality
+    is load-bearing — `_dense_or_scatter`'s zero-copy fast path requires
+    the store's master length to equal the solver's pad exactly."""
+    out = minimum
+    while out < n:
+        out *= 2
+    return out
+
+
 class NodeRegistry:
     """Host-side interning of node names and zone labels to stable indices.
 
@@ -132,6 +145,35 @@ class NodeRegistry:
         # not cache anything keyed on an odd epoch, and must re-check the
         # epoch after reading to detect a concurrent mutation.
         self._epoch = 0
+        # Mapping-change journal (ISSUE 13): post-mutation EVEN epoch ->
+        # [("add"|"remove", name, row)] — lets the solver PATCH a cached
+        # candidate mask across epochs (a node ADD used to force an
+        # O(N) name->row rebuild of every million-name mask). Bounded;
+        # a missing epoch sends the consumer to the full rebuild.
+        self._journal: dict[int, list] = {}
+
+    def _journal_put(self, entries: list) -> None:
+        """Record one mutation's mapping changes (caller holds the lock;
+        epoch is even again)."""
+        self._journal[self._epoch] = entries
+        while len(self._journal) > 128:
+            self._journal.pop(next(iter(self._journal)))
+
+    def journal_between(self, e0: int, e1: int):
+        """Concatenated mapping changes over the even epochs in (e0, e1],
+        oldest first — or None when any epoch is missing (evicted, or the
+        consumer's base predates the journal). Lock-free reads of
+        GIL-atomic dict gets; callers run under the same seqlock verify
+        they use for the masks themselves."""
+        if e1 < e0 or (e1 - e0) % 2 or e1 - e0 > 256:
+            return None
+        out: list = []
+        for e in range(e0 + 2, e1 + 1, 2):
+            ent = self._journal.get(e)
+            if ent is None:
+                return None
+            out.extend(ent)
+        return out
 
     @property
     def epoch(self) -> int:
@@ -158,6 +200,7 @@ class NodeRegistry:
                 self._epoch += 1  # odd: mapping unstable
                 idx = self._alloc_locked(name)
                 self._epoch += 1  # even: stable again
+                self._journal_put([("add", name, idx)])
             return idx
 
     def intern_many(self, names) -> np.ndarray:
@@ -171,10 +214,12 @@ class NodeRegistry:
             missing = [n for n in names if n not in index]
             if missing:
                 self._epoch += 1  # odd: mapping unstable
+                added = []
                 for n in missing:
                     if n not in index:  # duplicate within `missing`
-                        self._alloc_locked(n)
+                        added.append(("add", n, self._alloc_locked(n)))
                 self._epoch += 1  # even: stable again
+                self._journal_put(added)
             return np.fromiter(
                 (index[n] for n in names), np.int32, count=len(names)
             )
@@ -188,6 +233,7 @@ class NodeRegistry:
             self._names[idx] = None
             self._free.append(idx)
             self._epoch += 1  # even: stable again
+            self._journal_put([("remove", name, idx)])
 
     def index_of(self, name: str) -> int | None:
         return self._index.get(name)

@@ -153,6 +153,10 @@ def _bind(lib) -> None:
         ctypes.c_void_p, i64, p(i64), p(i64), p(i32), p(i32), p(i32), p(i32),
         p(i32), p(i32), p(u8), p(u8), p(u8),
     ]
+    lib.arena_snapshot_rows.argtypes = [
+        ctypes.c_void_p, p(i64), i64, i64, p(i64), p(i64), p(i32), p(i32),
+        p(i32), p(i32), p(i32), p(i32), p(u8), p(u8), p(u8),
+    ]
     lib.arena_capacity.argtypes = [ctypes.c_void_p]
     lib.arena_capacity.restype = i64
     lib.queue_create.argtypes = [i64, i64]
@@ -331,12 +335,11 @@ class ClusterArena:
     def capacity(self) -> int:
         return int(self._lib.arena_capacity(self._h))
 
-    def snapshot(self, n: int, usage: np.ndarray, overhead: np.ndarray):
-        """Materialize ClusterTensors fields for slots [0, n).
-
-        usage/overhead: [n, 3] int64 (caller scatters the sparse maps).
-        Returns the 9 arrays in ClusterTensors field order.
-        """
+    def snapshot_raw(self, n: int, usage: np.ndarray, overhead: np.ndarray):
+        """snapshot() but returning the three mask fields as their uint8
+        BACKING buffers (callers expose `.view(np.bool_)` of the same
+        memory) — the solver's resident tensor build keeps these buffers
+        and patches them in place via snapshot_rows."""
         usage = np.ascontiguousarray(usage, dtype=np.int64)
         overhead = np.ascontiguousarray(overhead, dtype=np.int64)
         available = np.empty((n, 3), dtype=np.int32)
@@ -361,9 +364,49 @@ class ClusterArena:
             name_rank,
             lr_driver,
             lr_executor,
-            unschedulable.astype(bool),
-            ready.astype(bool),
-            valid.astype(bool),
+            unschedulable,
+            ready,
+            valid,
+        )
+
+    def snapshot(self, n: int, usage: np.ndarray, overhead: np.ndarray):
+        """Materialize ClusterTensors fields for slots [0, n).
+
+        usage/overhead: [n, 3] int64 (caller scatters the sparse maps).
+        Returns the 9 arrays in ClusterTensors field order.
+        """
+        fields = self.snapshot_raw(n, usage, overhead)
+        return fields[:6] + tuple(f.astype(bool) for f in fields[6:])
+
+    def snapshot_rows(
+        self,
+        rows: np.ndarray,
+        usage: np.ndarray,
+        overhead: np.ndarray,
+        available: np.ndarray,
+        schedulable: np.ndarray,
+        zone_id: np.ndarray,
+        name_rank: np.ndarray,
+        lr_driver: np.ndarray,
+        lr_executor: np.ndarray,
+        unschedulable: np.ndarray,
+        ready: np.ndarray,
+        valid: np.ndarray,
+    ) -> None:
+        """Recompute ONLY `rows` into the caller's RESIDENT field buffers
+        (the solver's O(K + changed) tensor build). Buffers must be the
+        C-contiguous arrays of one prior full `snapshot` materialization;
+        unschedulable/ready/valid are the uint8 backing stores (callers
+        expose bool views of the same memory). usage/overhead are the FULL
+        [n, 3] int64 inputs — only their `rows` entries are read."""
+        idx = np.ascontiguousarray(rows, dtype=np.int64)
+        usage = np.ascontiguousarray(usage, dtype=np.int64)
+        overhead = np.ascontiguousarray(overhead, dtype=np.int64)
+        self._lib.arena_snapshot_rows(
+            self._h, _i64p(idx), len(idx), available.shape[0], _i64p(usage),
+            _i64p(overhead), _i32p(available), _i32p(schedulable),
+            _i32p(zone_id), _i32p(name_rank), _i32p(lr_driver),
+            _i32p(lr_executor), _u8p(unschedulable), _u8p(ready), _u8p(valid),
         )
 
 

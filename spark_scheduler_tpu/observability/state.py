@@ -156,6 +156,17 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
         dev_state = getattr(solver, "device_state_stats", None)
         if dev_state is not None:
             out["device_state"] = dict(dev_state)
+        # O(K + changed) tensor build (ISSUE 13): per-window build wall
+        # time, the dense-sweep vs dirty-set row ledgers (the "O(changed)
+        # is a counter, not a narrative" block), and the incremental vs
+        # full resident-snapshot mix.
+        build = getattr(solver, "build_stats", None)
+        if build is not None and build.get("builds"):
+            block = dict(build)
+            block["build_ms_mean"] = round(
+                build["build_ms"] / max(int(build["builds"]), 1), 4
+            )
+            out["build"] = block
         scale = getattr(solver, "scale_tier_stats", None)
         if scale is not None and any(scale.values()):
             out["scale_tier"] = dict(scale)

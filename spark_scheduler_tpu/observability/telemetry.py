@@ -95,6 +95,12 @@ FEATURIZE_USAGE_REFRESHES = (
 FEATURIZE_OVERHEAD_REFRESHES = (
     "foundry.spark.scheduler.solver.featurize.overhead.refreshes"
 )
+# O(K + changed) tensor build (ISSUE 13): per-window build wall time, rows
+# the DENSE mirror sweep examined (0 in steady state — the fallback), and
+# rows the event-fed dirty-set sync examined instead.
+BUILD_MS = "foundry.spark.scheduler.solver.build.ms"
+BUILD_ROWS_COMPARED = "foundry.spark.scheduler.solver.build.rows.compared"
+BUILD_DIRTY_ROWS = "foundry.spark.scheduler.solver.build.dirty.rows"
 
 # The one real-compile event (trace/lowering events also fire per compile
 # but would triple-count).
@@ -320,6 +326,23 @@ class SolverTelemetry:
         statics sub-blob (kept rows and their static fields unchanged):
         no host gather, no h2d re-upload."""
         self.registry.counter(PRUNE_GATHER_REUSE).inc()
+
+    # -- tensor build (ISSUE 13) ---------------------------------------------
+
+    def on_build(
+        self, ms: float, rows_compared: int, dirty_rows: int
+    ) -> None:
+        """One pipelined tensor build: wall time, rows the dense mirror
+        sweep examined (the fallback — 0 in steady state, the O(changed)
+        claim as a counter), and rows the event-fed dirty-set sync
+        examined."""
+        self.registry.histogram(BUILD_MS).update(round(ms, 4))
+        if rows_compared:
+            self.registry.counter(BUILD_ROWS_COMPARED).inc(
+                int(rows_compared)
+            )
+        if dirty_rows:
+            self.registry.counter(BUILD_DIRTY_ROWS).inc(int(dirty_rows))
 
     # -- pipeline ------------------------------------------------------------
 

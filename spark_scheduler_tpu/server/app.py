@@ -239,6 +239,8 @@ def build_scheduler_app(
         prune_slack=config.solver_prune_slack,
         delta_statics=config.solver_delta_statics,
         scale_tier=config.solver_scale_tier,
+        build_oracle=config.solver_build_oracle,
+        lazy_warm_start=config.solver_lazy_warm_start,
     )
     recorder = None
     if config.flight_recorder:

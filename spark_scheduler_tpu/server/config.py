@@ -192,6 +192,16 @@ class InstallConfig:
     # device failure falls back to the host greedy oracle. OFF by
     # default — node-axis sharding wants an ICI-class interconnect.
     solver_scale_tier: bool = False
+    # O(K + changed) tensor build (ISSUE 13). `solver.build-oracle`: after
+    # every event-fed dirty-set mirror sync, ALSO run the dense [N]-wide
+    # compare as an oracle and fail loudly on a missed row — the
+    # equivalence suites' guard; off in production (it re-adds the O(N)
+    # sweep the dirty set retires). `solver.lazy-warm-start`: a full
+    # device upload whose host-side change feed stayed exact keeps the
+    # prune planner's resident per-zone orders (a warm restart skips the
+    # O(N log N) cold replan); false restores the hard invalidate.
+    solver_build_oracle: bool = False
+    solver_lazy_warm_start: bool = True
     # Fused multi-window device dispatch (`solver.fuse-windows`): when the
     # predicate backlog holds more than one window's worth of requests,
     # the batcher claims up to fuse-windows x predicate-max-window of them
@@ -496,6 +506,12 @@ class InstallConfig:
             ),
             solver_scale_tier=bool(
                 block_key(solver_block, "scale-tier", False)
+            ),
+            solver_build_oracle=bool(
+                block_key(solver_block, "build-oracle", False)
+            ),
+            solver_lazy_warm_start=bool(
+                block_key(solver_block, "lazy-warm-start", True)
             ),
             runtime_config_path=raw.get("runtime-config-path"),
             jax_compilation_cache_dir=raw.get("jax-compilation-cache-dir"),

@@ -1,0 +1,341 @@
+"""O(K + changed) tensor build equivalence (ISSUE 13).
+
+The per-window tensor build no longer runs the dense
+`(mirror != host.available)` sweep or the full arena materialization:
+the feature store journals EXACTLY which rows' availability inputs
+changed, the resident build patches those rows in place, and the
+pipelined mirror syncs by scattering them. Pinned here:
+
+  - dirty-set build == dense-compare oracle BIT-IDENTICAL decisions
+    under randomized add/update/delete churn (including the
+    delete-tombstone + row-recycle interleavings of ISSUE 12) x
+    {pruned, unpruned} x device pool {1, 2}, with the in-build oracle
+    (`solver.build-oracle`) armed on the dirty-set side — a missed row
+    fails the build itself, not just the comparison;
+  - in-flight reconstruction: a window dispatched BEFORE external churn
+    patched the resident buffer escalates/reconstructs against its
+    dispatch-time view (the undo journal), byte-identical to the
+    dense twin;
+  - the steady-state serving loop runs ZERO dense mirror sweeps
+    (`mirror_rows_compared` stays 0 — the counter the CI scale smoke
+    pins at the million-node tier);
+  - lazy warm start: a discard_pipeline restart re-serves without
+    re-paying the planner's O(N log N) cold rebuild, and decisions
+    after the restart still match the dense twin;
+  - amortized roster growth: an ADD burst reallocates no resident
+    buffer (`array_grows` 0) and pays zero roster rebuilds.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.core.extender import ExtenderArgs
+from spark_scheduler_tpu.testing.harness import (
+    Harness,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+def _mk(pool, prune, *, dirty: bool, n0: int):
+    kw = dict(binpack_algo="tightly-pack", fifo=False)
+    if pool > 1:
+        kw["solver_device_pool"] = pool
+    if prune:
+        kw["solver_prune_top_k"] = prune
+        kw["solver_prune_slack"] = 0.75
+    h = Harness(**kw)
+    h.add_nodes(
+        *[new_node(f"n{i:03d}", zone=f"zone{i % 2}") for i in range(n0)]
+    )
+    if dirty:
+        # The in-build oracle: every dirty-set mirror sync re-runs the
+        # dense compare and raises on a missed row.
+        h.app.solver.build_oracle = True
+    else:
+        # The DENSE twin: withholding the journal sends every build down
+        # the full-materialization + dense-compare path (the pre-ISSUE-13
+        # semantics, byte for byte).
+        h.app.extender.features.journal_enabled = False
+    return h
+
+
+def _serve(h, live, seq, n_req=2):
+    names = list(live)
+    drivers = []
+    for _ in range(n_req):
+        d = static_allocation_spark_pods(f"bds-{next(seq)}", 2)[0]
+        h.add_pods(d)
+        drivers.append(d)
+    t = h.extender.predicate_window_dispatch(
+        [ExtenderArgs(pod=d, node_names=names) for d in drivers]
+    )
+    return [tuple(r.node_names) for r in h.extender.predicate_window_complete(t)]
+
+
+def _churn_event(h, rng, live, spare, deleted):
+    """One seeded node event; `deleted` names become re-addable, so the
+    stream exercises delete-tombstone -> row-recycle interleavings."""
+    op = rng.random()
+    if op < 0.3 and (spare or deleted):
+        # Re-adding a recently deleted name reuses its recycled registry
+        # row through the tombstone-release path.
+        name = deleted.pop() if deleted and rng.random() < 0.5 else (
+            spare.pop() if spare else deleted.pop()
+        )
+        h.add_nodes(new_node(name, zone=f"zone{len(live) % 2}"))
+        live.append(name)
+        return ("add", name)
+    if op < 0.75 and live:
+        name = live[int(rng.integers(0, len(live)))]
+        cur = h.backend.get_node(name)
+        h.backend.update(
+            "nodes",
+            dataclasses.replace(cur, unschedulable=not cur.unschedulable),
+        )
+        return ("update", name)
+    if len(live) > 8:
+        name = live.pop(int(rng.integers(0, len(live))))
+        h.backend.delete("nodes", "", name)
+        deleted.append(name)
+        return ("delete", name)
+    return ("noop", None)
+
+
+@pytest.mark.parametrize("pool,prune", [(1, 0), (1, 4), (2, 0), (2, 4)])
+def test_dirty_set_build_matches_dense_oracle_under_churn(pool, prune):
+    n0 = 48
+    h_dirty = _mk(pool, prune, dirty=True, n0=n0)
+    h_dense = _mk(pool, prune, dirty=False, n0=n0)
+    live_a = [f"n{i:03d}" for i in range(n0)]
+    live_b = list(live_a)
+    spare_a = [f"x{j:02d}" for j in range(20, 0, -1)]
+    spare_b = list(spare_a)
+    del_a: list = []
+    del_b: list = []
+    rng_a = np.random.default_rng(20813)
+    rng_b = np.random.default_rng(20813)
+    seq = iter(range(100_000))
+    for step in range(18):
+        ev_a = _churn_event(h_dirty, rng_a, live_a, spare_a, del_a)
+        ev_b = _churn_event(h_dense, rng_b, live_b, spare_b, del_b)
+        assert ev_a == ev_b  # identical seeded streams
+        start = next(seq)
+        a = _serve(h_dirty, live_a, iter(range(start, start + 2)))
+        b = _serve(h_dense, live_b, iter(range(start, start + 2)))
+        assert a == b, f"step {step} ({ev_a}): {a} vs {b}"
+    bs = h_dirty.app.solver.build_stats
+    if prune and pool == 1:
+        # The dirty-set sync actually served (the oracle checked it).
+        # Pooled fetches debit the mirror densely (their placements
+        # reassemble across partitions), so the pool arm legitimately
+        # rides the dense fallback — the equivalence above is the claim
+        # there.
+        assert bs["dirty_rows"] > 0, bs
+        assert bs["oracle_checks"] > 0, bs
+    # The dense twin never took the dirty path.
+    assert h_dense.app.solver.build_stats["dirty_rows"] == 0
+    h_dirty.app.stop()
+    h_dense.app.stop()
+
+
+def test_steady_state_runs_zero_dense_mirror_sweeps():
+    """After the cold build, a no-event pruned serving loop performs ZERO
+    dense mirror sweeps — the `mirror_rows_compared` claim the CI scale
+    smoke pins at 1M, asserted here at tier-1 scale."""
+    h = _mk(1, 4, dirty=True, n0=64)
+    live = [f"n{i:03d}" for i in range(64)]
+    seq = iter(range(1000))
+    _serve(h, live, seq)  # cold build + full upload
+    bs = h.app.solver.build_stats
+    compared0 = bs["mirror_rows_compared"]
+    dense0 = bs["mirror_dense_syncs"]
+    for _ in range(8):
+        out = _serve(h, live, seq)
+        assert all(out), out
+    assert bs["mirror_rows_compared"] == compared0, bs
+    assert bs["mirror_dense_syncs"] == dense0, bs
+    assert bs["incremental_builds"] >= 8, bs
+    h.app.stop()
+
+
+def test_inflight_churn_escalation_reconstructs_dispatch_time_view():
+    """A window dispatched, THEN external usage churn patches the resident
+    availability in place, THEN the window fetches with a starved-K
+    certificate (escalation): the re-solve must run against the
+    dispatch-time view (undo journal), byte-identical to the dense twin
+    whose buffers froze naturally."""
+    from spark_scheduler_tpu.models.reservations import (
+        new_resource_reservation,
+    )
+    from spark_scheduler_tpu.models.resources import Resources
+
+    outs = {}
+    for mode in ("dirty", "dense"):
+        kw = dict(
+            binpack_algo="tightly-pack", fifo=False,
+            solver_prune_top_k=1, solver_prune_slack=0.01,
+        )
+        h = Harness(**kw)
+        h.add_nodes(
+            *[new_node(f"n{i:03d}", zone=f"zone{i % 2}") for i in range(32)]
+        )
+        if mode == "dirty":
+            h.app.solver.build_oracle = True
+        else:
+            h.app.extender.features.journal_enabled = False
+        live = [f"n{i:03d}" for i in range(32)]
+        seq = iter(range(100))
+        _serve(h, live, seq)  # warm
+        ext = h.extender
+        names = list(live)
+        d1 = static_allocation_spark_pods(f"if-{mode}-1", 2)[0]
+        h.add_pods(d1)
+        t1 = ext.predicate_window_dispatch(
+            [ExtenderArgs(pod=d1, node_names=names)]
+        )
+        # External churn lands between t1's dispatch and its fetch: a
+        # reservation created outside the window path patches the
+        # resident availability (tracker delta -> journal -> in-place
+        # patch during t2's build).
+        blocker = static_allocation_spark_pods(f"if-{mode}-blk", 1)[0]
+        h.backend.add_pod(blocker)
+        rr = new_resource_reservation(
+            "n005", ["n005"], blocker,
+            Resources.from_quantities("2", "2Gi"),
+            Resources.from_quantities("1", "1Gi"),
+        )
+        h.app.rr_cache.create(rr)
+        d2 = static_allocation_spark_pods(f"if-{mode}-2", 2)[0]
+        h.add_pods(d2)
+        t2 = ext.predicate_window_dispatch(
+            [ExtenderArgs(pod=d2, node_names=names)]
+        )
+        r1 = [tuple(r.node_names) for r in ext.predicate_window_complete(t1)]
+        r2 = [tuple(r.node_names) for r in ext.predicate_window_complete(t2)]
+        outs[mode] = (r1, r2)
+        if mode == "dirty":
+            # The starved K actually escalated (the reconstruction ran).
+            assert h.app.solver.prune_stats["escalations"] > 0, (
+                h.app.solver.prune_stats
+            )
+        h.app.stop()
+    assert outs["dirty"] == outs["dense"], outs
+
+
+def test_warm_restart_persists_planner():
+    """discard_pipeline (the warm-restart analog) keeps the planner's
+    resident per-zone orders: zero index rebuilds across the restart, and
+    post-restart decisions equal the dense twin's."""
+    h = _mk(1, 4, dirty=True, n0=64)
+    live = [f"n{i:03d}" for i in range(64)]
+    seq = iter(range(1000))
+    for _ in range(3):
+        _serve(h, live, seq)
+    planner = h.app.solver._planner
+    assert planner is not None
+    rebuilds = planner.index.rebuilds
+    h.app.solver.discard_pipeline()
+    out = _serve(h, live, seq)
+    assert all(out), out
+    assert planner.index.rebuilds == rebuilds, (
+        "warm restart re-paid the planner cold rebuild"
+    )
+    # Control: with lazy warm start OFF the restart invalidates.
+    h2 = Harness(
+        binpack_algo="tightly-pack", fifo=False,
+        solver_prune_top_k=4, solver_prune_slack=0.75,
+        solver_lazy_warm_start=False,
+    )
+    h2.add_nodes(
+        *[new_node(f"n{i:03d}", zone=f"zone{i % 2}") for i in range(64)]
+    )
+    for _ in range(3):
+        _serve(h2, live, seq)
+    planner2 = h2.app.solver._planner
+    rebuilds2 = planner2.index.rebuilds
+    h2.app.solver.discard_pipeline()
+    _serve(h2, live, seq)
+    assert planner2.index.rebuilds == rebuilds2 + 1, (
+        "lazy-warm-start=false must keep the hard invalidate"
+    )
+    h.app.stop()
+    h2.app.stop()
+
+
+def test_add_burst_zero_reallocations_and_rebuilds():
+    """A node-ADD burst inside the capacity bucket reallocates NO resident
+    buffer (`array_grows`) and pays zero roster rebuilds — the amortized
+    growth claim as counters."""
+    h = _mk(1, 0, dirty=True, n0=40)
+    live = [f"n{i:03d}" for i in range(40)]
+    seq = iter(range(1000))
+    _serve(h, live, seq)
+    store = h.app.extender.features
+    grows0 = store.array_grows
+    rebuilds0 = store.stats()["roster_rebuilds"]
+    # 40 -> 60 nodes stays inside the 64-bucket: zero reallocations.
+    for j in range(20):
+        name = f"zadd{j:02d}"
+        h.add_nodes(new_node(name, zone=f"zone{j % 2}"))
+        live.append(name)
+        out = _serve(h, live, seq, n_req=1)
+        assert all(out), out
+    st = store.stats()
+    assert store.array_grows == grows0, st
+    assert st["roster_rebuilds"] == rebuilds0, st
+    assert st["roster_add_patches"] >= 20, st
+    h.app.stop()
+
+
+def test_delete_between_dispatch_and_complete_keeps_old_roster_view():
+    """A node DELETE landing between a window's dispatch and its
+    completion must not tear the ticket's parked snapshot: the delete
+    patch copies-on-write the roster list AND the by-name map (an
+    in-place pop would KeyError the completion's domain lookup)."""
+    h = _mk(1, 4, dirty=True, n0=32)
+    live = [f"n{i:03d}" for i in range(32)]
+    seq = iter(range(100))
+    _serve(h, live, seq)  # warm
+    ext = h.extender
+    d1 = static_allocation_spark_pods("dl-1", 2)[0]
+    h.add_pods(d1)
+    t1 = ext.predicate_window_dispatch(
+        [ExtenderArgs(pod=d1, node_names=list(live))]
+    )
+    # Delete while W1 is in flight, and force a refresh that applies it
+    # (W2's dispatch snapshots).
+    h.backend.delete("nodes", "", "n030")
+    d2 = static_allocation_spark_pods("dl-2", 2)[0]
+    h.add_pods(d2)
+    t2 = ext.predicate_window_dispatch(
+        [ExtenderArgs(pod=d2, node_names=[n for n in live if n != "n030"])]
+    )
+    r1 = [tuple(r.node_names) for r in ext.predicate_window_complete(t1)]
+    r2 = [tuple(r.node_names) for r in ext.predicate_window_complete(t2)]
+    assert all(r1) and all(r2), (r1, r2)
+    assert h.app.extender.features.stats()["roster_delete_patches"] >= 1
+    h.app.stop()
+
+
+def test_dense_fallback_on_journal_gap_is_exact():
+    """A journal break mid-stream (simulated by toggling journal_enabled)
+    downgrades to the dense compare for those builds and back — decisions
+    stay identical to an always-dense twin."""
+    h_a = _mk(1, 4, dirty=True, n0=48)
+    h_b = _mk(1, 4, dirty=False, n0=48)
+    live = [f"n{i:03d}" for i in range(48)]
+    seq = iter(range(10_000))
+    for step in range(9):
+        if step == 3:
+            h_a.app.extender.features.journal_enabled = False
+        if step == 6:
+            h_a.app.extender.features.journal_enabled = True
+        start = next(seq)
+        a = _serve(h_a, live, iter(range(start, start + 2)))
+        b = _serve(h_b, live, iter(range(start, start + 2)))
+        assert a == b, f"step {step}: {a} vs {b}"
+    h_a.app.stop()
+    h_b.app.stop()

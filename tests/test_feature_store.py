@@ -87,9 +87,12 @@ def test_budget_10k_nodes_steady_state_featurize_is_o_changed():
     assert store.roster_rebuilds == rebuilds_before, (
         "steady-state featurize paid an O(nodes) roster re-walk"
     )
-    # Usage refreshed once per dirty window — one vectorized copy per
-    # event, never per node.
-    assert store.usage_refreshes - usage_refreshes_before == 50
+    # Usage refreshed once per dirty window as an O(changed) row PATCH
+    # into the resident master (ISSUE 13) — zero full [cap,3] copies.
+    assert store.usage_patches == 50
+    assert store.usage_refreshes == usage_refreshes_before, (
+        "steady-state usage refresh paid a full-array copy"
+    )
 
     # The snapshots carried the commits: reserved rows are non-zero.
     assert snap.usage.any()
