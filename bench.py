@@ -532,6 +532,12 @@ def _build_fields(app) -> dict:
     return {
         "build_ms": round(st.get("build_ms", 0.0) / builds, 4),
         "mirror_rows_compared": int(st.get("mirror_rows_compared", 0)),
+        # ISSUE 15: the dense-sweep event count and the device-pool size
+        # on every serving line — the pooled sparse-debit claim (0 dense
+        # syncs at any pool size) rides the same trajectory fields.
+        "mirror_dense_syncs": int(st.get("mirror_dense_syncs", 0)),
+        "pool": int(getattr(app.solver, "pool_size", 1)),
+        "pooled_debit_rows": int(st.get("pooled_debit_rows", 0)),
         "build_dirty_rows": int(st.get("dirty_rows", 0)),
         "build_incremental": int(st.get("incremental_builds", 0)),
         "build_full_snapshots": int(st.get("full_snapshots", 0)),

@@ -20,6 +20,18 @@ COUNTERS, not timings (no hot-loop timing flakiness):
     wall-clock budget (SCALE_SMOKE_BUDGET_S, default 600 — generous: the
     budget catches quadratic boot regressions, not jitter).
 
+SCALE_SMOKE_POOL=2 (ISSUE 15, the pool-2 CI leg) runs the same smoke
+against a 2-slot device pool with the roster split across TWO instance
+groups and every serving window partitioned across them: the same
+invariants must hold — plus ZERO dense mirror syncs (the pooled sparse
+debit pins `mirror_dense_syncs` at 0), pooled debit rows engaged, and
+planner rows-scanned O(K) with the per-domain plan contexts re-serving
+across windows (`planner_sweep_rows` stops at the per-domain cold
+sweeps). Event-phase adds/deletes land in a THIRD spare group, so the
+served groups' domain tickets stay membership-stable — a membership
+change inside a served instance group re-sweeps that domain by design
+(the documented residual).
+
 Exit code 0 = pass; assertion failure names the broken invariant.
 """
 
@@ -37,7 +49,16 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 N_NODES = int(os.environ.get("SCALE_SMOKE_NODES", "1000000"))
 BUDGET_S = float(os.environ.get("SCALE_SMOKE_BUDGET_S", "600"))
+POOL = int(os.environ.get("SCALE_SMOKE_POOL", "1"))
 EVENT_BYTES_CEILING = 64 * 1024
+
+if POOL > 1 and "xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={POOL}"
+    )
 
 
 def main() -> None:
@@ -55,7 +76,17 @@ def main() -> None:
     t_boot = time.perf_counter()
     backend = InMemoryBackend()
     for i in range(N_NODES):
-        backend.add_node(new_node(f"s{i:07d}", zone=f"zone{i % 4}"))
+        if POOL > 1:
+            # Two served instance groups: every window partitions across
+            # the pool (the pooled sparse-debit path under test).
+            backend.add_node(
+                new_node(
+                    f"s{i:07d}", zone=f"zone{i % 4}",
+                    instance_group=f"ig{i % 2}",
+                )
+            )
+        else:
+            backend.add_node(new_node(f"s{i:07d}", zone=f"zone{i % 4}"))
     app = build_scheduler_app(
         backend,
         InstallConfig(
@@ -63,6 +94,7 @@ def main() -> None:
             sync_writes=True,
             instance_group_label=INSTANCE_GROUP_LABEL,
             solver_prune_top_k=64,
+            solver_device_pool=POOL,
             flight_recorder=False,
         ),
     )
@@ -91,11 +123,21 @@ def main() -> None:
     names = NameTicket(f"s{i:07d}" for i in range(N_NODES))
 
     def serve_one(tag: str) -> None:
-        d = static_allocation_spark_pods(f"smoke-{tag}", 2)[0]
-        backend.add_pod(d)
-        tok = ext.predicate_window_dispatch(
-            [ExtenderArgs(pod=d, node_names=names)]
-        )
+        if POOL > 1:
+            args = []
+            for g in ("ig0", "ig1"):
+                d = static_allocation_spark_pods(
+                    f"smoke-{tag}-{g}", 2, instance_group=g
+                )[0]
+                backend.add_pod(d)
+                args.append(ExtenderArgs(pod=d, node_names=names))
+            tok = ext.predicate_window_dispatch(args)
+        else:
+            d = static_allocation_spark_pods(f"smoke-{tag}", 2)[0]
+            backend.add_pod(d)
+            tok = ext.predicate_window_dispatch(
+                [ExtenderArgs(pod=d, node_names=names)]
+            )
         res = ext.predicate_window_complete(tok)
         assert res[0].node_names, f"window {tag} failed to place"
 
@@ -121,6 +163,7 @@ def main() -> None:
     serve_one("warm1")
     scanned_before = prune["planner_rows_scanned"]
     cold_before = prune["planner_cold_rows"]
+    sweep_after_warm = prune["planner_sweep_rows"]
     build = app.solver.build_stats
     compared_before = build["mirror_rows_compared"]
     dense_before = build["mirror_dense_syncs"]
@@ -129,11 +172,14 @@ def main() -> None:
     # Event phase: 4 adds + 4 updates + 4 deletes, one served window
     # each. Added/deleted/updated nodes all sort OUTSIDE every kept set
     # (names after the roster's, high indices), so the planner absorbs
-    # them as exact merges/static dirt without a zone re-scan — an add
-    # whose name ranked INSIDE the kept boundary would instead pay one
-    # O(zone) re-scan by design (the kept set must admit it).
+    # them as exact merges/static dirt — since ISSUE 15 a boundary-
+    # beating add would be INSERTED in O(K) rather than re-scanned. On
+    # the pool leg, adds/deletes land in a spare instance group so the
+    # served groups' domain tickets stay membership-stable (a membership
+    # change re-sweeps that domain by design).
+    spare = {"instance_group": "igspare"} if POOL > 1 else {}
     for j in range(4):
-        backend.add_node(new_node(f"zlate{j:03d}", zone="zone0"))
+        backend.add_node(new_node(f"zlate{j:03d}", zone="zone0", **spare))
         serve_one(f"add{j}")
     for j in range(4):
         name = f"s{N_NODES - 1 - j:07d}"
@@ -144,7 +190,12 @@ def main() -> None:
         )
         serve_one(f"upd{j}")
     for j in range(4):
-        backend.delete("nodes", "", f"s{N_NODES - 5 - j:07d}")
+        if POOL > 1:
+            # Delete the spare-group adds: exercises the delete-tombstone
+            # patch without re-keying a served domain.
+            backend.delete("nodes", "", f"zlate{j:03d}")
+        else:
+            backend.delete("nodes", "", f"s{N_NODES - 5 - j:07d}")
         serve_one(f"del{j}")
 
     fs = store.stats()
@@ -170,7 +221,12 @@ def main() -> None:
     # event-phase windows re-scanned at most a K-bounded row count —
     # zero in this synthetic roster: every change merges or is benign.
     scanned = prune["planner_rows_scanned"] - scanned_before
-    assert prune["planner_sweep_rows"] == 0, prune
+    # Pool leg: the per-domain contexts pay one cold sweep each at warm,
+    # then NEVER re-sweep across the event phase (ISSUE 15 tentpole (b));
+    # single-device full-domain serving never sweeps at all.
+    assert prune["planner_sweep_rows"] == sweep_after_warm, prune
+    if POOL == 1:
+        assert prune["planner_sweep_rows"] == 0, prune
     assert prune["planner_cold_rows"] == cold_before, (
         "planner re-ran its cold build during the event phase", prune,
     )
@@ -192,6 +248,13 @@ def main() -> None:
     )
     assert build["mirror_dense_syncs"] == dense_before, build
     assert build["incremental_builds"] > 0, build
+    if POOL > 1:
+        # Pooled sparse debits (ISSUE 15 tentpole (a)): partitioned
+        # windows never downgraded the mirror sync to a dense sweep, and
+        # the partition debit rows actually flowed through the ledger.
+        assert build["mirror_dense_syncs"] == 0, build
+        assert build["pooled_debit_rows"] > 0, build
+        assert prune["plan_reuse"] > 0 and prune["gather_reuse"] > 0, prune
     # Amortized roster growth: the add/update/delete burst reallocated NO
     # resident buffer (the preallocated-capacity claim as a counter).
     assert store.stats()["array_grows"] == grows_before, (
@@ -205,6 +268,7 @@ def main() -> None:
             {
                 "scale_smoke": "pass",
                 "n_nodes": N_NODES,
+                "pool": POOL,
                 "boot_s": round(boot_s, 1),
                 "upload_bytes_per_event": round(per_event, 1),
                 "roster_add_patches": fs["roster_add_patches"],

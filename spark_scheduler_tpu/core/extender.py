@@ -814,10 +814,20 @@ class SparkSchedulerExtender:
                                 names = _DomainNames(
                                     nm for nm in names if nm not in removed
                                 )
+                                names.extend(added)
+                                name_set = (name_set - removed) | set(added)
                             else:
+                                # Adds-only (the node-ADD burst case): one
+                                # pointer copy of the name list, and the
+                                # member set grows IN PLACE — rebuilding a
+                                # million-entry set per event was the
+                                # dominant 1M ADD cost (ISSUE 15). The set
+                                # is owned by this cache entry alone, and
+                                # the ticket object must still be NEW (its
+                                # digest keys the solver's mask memo).
                                 names = _DomainNames(names)
-                            names.extend(added)
-                            name_set = (name_set - removed) | set(added)
+                                names.extend(added)
+                                name_set.update(added)
                             # Lineage for the solver's candidate-mask
                             # patch (ISSUE 13): the new ticket names its
                             # exact membership deltas so the mask updates

@@ -45,30 +45,38 @@ excluded rows overestimate fit, candidate masks are ignored for excluded
 driver checks, and any uncertainty (a prior window's placement landing on
 an excluded row, a non-kept index in the blob) escalates outright.
 
-O(K + changed) planning (ISSUE 12). The planner used to pay O(N) host
-sweeps per window (per-zone bincounts, excluded-row sums, per-zone maxima
-over N−K rows) even when nothing outside the kept rows moved between
-windows. `PrunePlanner` retires them:
+O(K + changed) planning (ISSUE 12, generalized to per-domain contexts in
+ISSUE 15). The planner used to pay O(N) host sweeps per window; the
+resident `PrunePlanner` retires them:
 
   - per-zone availability TOTALS live in resident, event-maintained
-    aggregates (core/zone_aggregates.ZoneAggregates — the census/
-    soft-mirror pattern), so a window's `zone_base` excluded sums derive
-    as `total − Σ kept` in O(K);
+    aggregates — the full valid mask reads core/zone_aggregates.
+    ZoneAggregates directly, and every SUBSET domain (the pooled engine's
+    partition domains) keeps its own [Zb] totals, delta-maintained from
+    the same dirty-row feed — so a window's `zone_base` excluded sums
+    derive as `total − Σ kept` in O(K) for full AND partitioned windows;
   - the top-K kept rows, the excluded lexmin keys and the excluded
-    per-dim maxima are CACHED per zone and reused while the zone's
-    excluded rows are untouched. The cache is sound by construction:
-    every certificate input about excluded rows depends only on excluded
-    rows, so churn confined to the kept rows (gang placements — the
-    steady serving case) reuses the entry verbatim; a newly-valid row
-    (node ADD) merges in exactly (min/max/flag updates are exact for a
-    set gaining a member); ANY other change touching a zone's excluded
-    rows re-scans just that zone's order (O(zone), counted);
+    per-dim maxima are CACHED per (domain, zone) and reused while the
+    zone's excluded rows are untouched. The cache is sound by
+    construction: every certificate input about excluded rows depends
+    only on excluded rows, so churn confined to the kept rows (gang
+    placements — the steady serving case) reuses the entry verbatim; a
+    newly-valid row (node ADD) merges in exactly; a merged row BEATING
+    the kept-set boundary is INSERTED into the kept order directly (the
+    old K-th row evicts into the excluded summaries — O(K), ISSUE 15
+    tentpole (c)) instead of forcing the historical O(zone) re-scan,
+    which survives only for depletion / static flips on kept rows /
+    exhausted leftover budgets;
   - consequently a no-churn window re-serves the identical kept row set
-    (`plan_reuse`), which is what keys the solver's statics-gather reuse.
+    (`plan_reuse`), which is what keys the solver's statics-gather reuse
+    — per partition too, since each domain context owns its keep array.
 
-Subset-domain windows (a shared non-default domain) take the legacy
-vectorized sweep (`sweep_rows` counts them); the pooled partition path
-prunes per-partition the same way.
+A subset domain's FIRST plan still pays one vectorized O(N) sweep to
+derive its per-zone membership and totals (`sweep_rows` counts it); after
+that the domain context absorbs churn in O(changed) exactly like the full
+domain. A domain MEMBERSHIP change (node add/delete inside the domain's
+instance group) re-keys the window's domain mask object and cold-starts a
+fresh context — the documented residual.
 
 Gating (checked by the solver before planning): plain fills only (the
 single-AZ wrappers score zones by subset-dependent efficiencies), no
@@ -96,21 +104,6 @@ _I64_MIN = np.iinfo(np.int64).min
 
 
 from spark_scheduler_tpu.models.cluster import pad_bucket as _bucket  # noqa: E402
-
-
-def _zone_sum(zones: np.ndarray, vals: np.ndarray, zb: int) -> np.ndarray:
-    """Exact per-zone int64 sums. bincount accumulates in float64 —
-    exact while |sum| < 2^53, guaranteed for < 2^22 int32 rows (2^22 x
-    2^31/2 = 2^52); larger row sets take the exact-but-slow np.add.at.
-    (The resident-aggregate fast path never calls this — only the
-    subset-domain sweep does.)"""
-    if vals.size >= (1 << 22):
-        out = np.zeros(zb, np.int64)
-        np.add.at(out, zones, vals.astype(np.int64))
-        return out
-    return np.bincount(
-        zones, weights=vals, minlength=zb
-    ).astype(np.int64)
 
 
 def zone_ranks_host(
@@ -158,12 +151,14 @@ def _lex_lt(a0, a1, a2, a3, b0, b1, b2, b3):
 class PrunePlan:
     """One window's candidate-pruning decision: the kept row set, the
     device zone-sum offsets, and the excluded-row summaries the
-    certificate tests against. All arrays are host numpy."""
+    certificate tests against. All arrays are host numpy. Kept-row
+    MEMBERSHIP is answered by bisecting the sorted real part of `keep`
+    (the dense [N] kept_mask of the original implementation was an O(N)
+    allocation per window — ISSUE 15 tentpole (d))."""
 
     keep: np.ndarray  # [Kp] int32 — kept global rows, real part SORTED
     #                     ascending, padding repeats keep[0]
     k_real: int  # number of real kept rows
-    kept_mask: np.ndarray  # [N] bool
     dom_mask: np.ndarray  # [N] bool — window domain & valid
     num_zones: int  # the solver's zone bucket Zb
     # Device offsets: excluded-row zone sums as int32 limbs + present.
@@ -197,10 +192,10 @@ class PrunePlan:
 
 
 class _ZoneEntry:
-    """Cached per-zone prefilter state: the kept rows and the excluded-row
-    summaries for one zone. An excluded-row change keeps the entry SOUND
-    by merging the row's new state (exact-direction: min/max/presence
-    can only extend) while the old contribution lingers as a
+    """Cached per-(domain, zone) prefilter state: the kept rows and the
+    excluded-row summaries for one zone. An excluded-row change keeps the
+    entry SOUND by merging the row's new state (exact-direction: min/max/
+    presence can only extend) while the old contribution lingers as a
     conservative leftover; `stale` counts those leftovers so the zone
     re-scans before conservatism drifts into spurious escalations."""
 
@@ -230,14 +225,41 @@ class _ZoneEntry:
         # escalation.
         self.depleted = 0
         # Key of the K-th (worst) kept row per class at build time — the
-        # kept-set BOUNDARY. A merged row whose key beats it would have
-        # been kept by a fresh selection (e.g. a node ADD whose name
-        # sorts before the roster's): the entry re-scans instead of
-        # parking a top-K row in the excluded summaries, where the next
-        # placement in the zone would escalate. None = the zone kept
-        # every fitting row, so ANY new fitting row belongs in the set.
+        # kept-set BOUNDARY. A merged row whose key beats it belongs in
+        # the kept set: it is INSERTED directly (the old K-th row evicts
+        # into the excluded summaries — O(K), ISSUE 15) instead of
+        # forcing the O(zone) re-scan. None = the zone kept every
+        # fitting row, so ANY new fitting row simply joins the set.
         self.last_key_e = last_key_e
         self.last_key_d = last_key_d
+
+
+class _DomCtx:
+    """Resident planning context for ONE window domain: the per-zone
+    entries, the assembled kept set, and the minima/K the entries were
+    built for. The FULL-domain context (`dom_mask is None`) reads its
+    per-zone availability totals live from the resident ZoneAggregates;
+    a SUBSET domain (a pooled partition's instance group) owns [Zb]
+    totals of its member rows, delta-maintained from the same dirty-row
+    feed — the per-partition analog of the aggregates."""
+
+    __slots__ = (
+        "dom_mask", "entries", "keep", "keep_real",
+        "min_dr", "min_er", "k", "zone_mem", "zone_cpu", "zcnt",
+    )
+
+    def __init__(self, dom_mask=None):
+        self.dom_mask = dom_mask  # None = the full valid mask
+        self.entries: dict[int, _ZoneEntry] = {}
+        self.keep: np.ndarray | None = None  # assembled padded keep
+        self.keep_real = 0
+        self.min_dr: np.ndarray | None = None  # None = COLD
+        self.min_er: np.ndarray | None = None
+        self.k = 0
+        # Subset domains only: event-maintained per-zone totals.
+        self.zone_mem: np.ndarray | None = None
+        self.zone_cpu: np.ndarray | None = None
+        self.zcnt: np.ndarray | None = None
 
 
 def _key_lt(a, b) -> bool:
@@ -248,16 +270,48 @@ def _key_lt(a, b) -> bool:
     return False
 
 
+def _merge_excluded(
+    entry, r: int, avail, min_dr, min_er, unsched, ready, name_rank
+) -> None:
+    """Fold one EXCLUDED row's current state into a zone entry's
+    summaries — presence / lexmin key / per-dim maxima, per class, exact
+    direction (joining a summary can only extend it). The single shared
+    body of the merge, boundary-insert eviction and depletion-refresh
+    paths: the certificate's summary contract lives here once."""
+    av = avail[r].astype(np.int64)
+    key = (
+        int(avail[r, MEM_DIM]),
+        int(avail[r, CPU_DIM]),
+        int(name_rank[r]),
+    )
+    if (av >= min_dr).all():
+        entry.has_d = True
+        if _key_lt(key, entry.key_d):
+            entry.key_d = key
+        entry.max_d = np.maximum(entry.max_d, av)
+    if (av >= min_er).all() and not unsched[r] and ready[r]:
+        entry.has_e = True
+        if _key_lt(key, entry.key_e):
+            entry.key_e = key
+        entry.max_e = np.maximum(entry.max_e, av)
+
+
 class PrunePlanner:
-    """O(K + changed) window planning over resident per-zone state.
+    """O(K + changed) window planning over resident per-(domain, zone)
+    state.
 
     Owns the per-zone RankIndex (priority orders), the ZoneAggregates
-    (availability totals) and the per-zone plan cache. The solver feeds it
-    the EXACT changed rows it already knows (pipelined-build delta rows,
-    static row-deltas, fetched placement rows); a serving path that cannot
-    name its rows marks the planner UNKNOWN and the next sync pays one
-    vectorized snapshot compare instead.
+    (availability totals), the full-domain plan context and one cached
+    context per subset domain (the pooled partition path). The solver
+    feeds it the EXACT changed rows it already knows (pipelined-build
+    delta rows, static row-deltas, fetched placement rows); a serving
+    path that cannot name its rows marks the planner UNKNOWN and the next
+    sync pays one vectorized snapshot compare instead.
     """
+
+    # Cached subset-domain contexts (pooled partitions): enough for a
+    # realistic instance-group fan-out; overflow clears the oldest-built.
+    _MAX_DOM_CTXS = 16
 
     def __init__(self, stats: dict | None = None):
         from spark_scheduler_tpu.core.feature_store import RankIndex
@@ -265,12 +319,12 @@ class PrunePlanner:
 
         self.index = RankIndex()
         self.agg = ZoneAggregates()
-        self._entries: dict[int, _ZoneEntry] = {}
-        self._min_dr: np.ndarray | None = None  # int64[3] at last full build
-        self._min_er: np.ndarray | None = None
-        self._k = 0
-        self._keep: np.ndarray | None = None  # assembled padded keep
-        self._keep_real = 0
+        self._full = _DomCtx(None)
+        self._dom_ctxs: dict = {}  # dom_key -> _DomCtx (subset domains)
+        # [N] bool exec-eligibility snapshot (~unschedulable & ready):
+        # distinguishes a RANK-only static relabel (benign for a kept
+        # row) from an eligibility flip (re-scan) at absorb time.
+        self._elig: np.ndarray | None = None
         # Pending change feed (drained at sync): explicit dirty rows,
         # static-delta rows, or None = unknown (snapshot compare).
         self._dirty: list | None = []
@@ -279,7 +333,8 @@ class PrunePlanner:
         for key in (
             "planner_rows_scanned", "planner_cold_rows",
             "planner_sweep_rows", "planner_resync_rows",
-            "planner_zone_rescans", "planner_merges", "plan_reuse",
+            "planner_zone_rescans", "planner_zone_refreshes",
+            "planner_merges", "planner_boundary_inserts", "plan_reuse",
         ):
             self.stats.setdefault(key, 0)
 
@@ -288,11 +343,8 @@ class PrunePlanner:
     def invalidate(self) -> None:
         self.index.invalidate()
         self.agg.invalidate()
-        self._entries.clear()
-        self._keep = None
-        self._min_dr = None  # next build is COLD (counter attribution)
-        self._min_er = None
-        self._k = 0
+        self._full = _DomCtx(None)  # next build is COLD (counter attribution)
+        self._dom_ctxs.clear()
         self._dirty = []
         self._static = []
 
@@ -313,10 +365,23 @@ class PrunePlanner:
         unpruned fetch): the next sync diff-scans the snapshots."""
         self._dirty = None
 
+    def reset_plan_entries(self) -> None:
+        """Drop every cached kept set / excluded summary while KEEPING
+        the resident index and aggregates (re-scans are O(zone), not the
+        O(N log N) cold rebuild). Called after a certificate escalation:
+        conservative drift (depletion-refresh carry-overs, stale merge
+        leftovers) may have caused it, and re-scanning to exactness
+        guarantees an escalation can never loop on the same stale entry."""
+        self._full.entries.clear()
+        self._full.keep = None
+        for ctx in self._dom_ctxs.values():
+            ctx.entries.clear()
+            ctx.keep = None
+
     # -- sync ----------------------------------------------------------------
 
     def sync(self, host, num_zones: int) -> None:
-        """Bring the resident index/aggregates/cache up to the CURRENT
+        """Bring the resident index/aggregates/contexts up to the CURRENT
         host view, in O(changed) when the change feed is exact."""
         avail = np.asarray(host.available)
         zid = np.asarray(host.zone_id)
@@ -331,6 +396,14 @@ class PrunePlanner:
         ):
             self._rebuild(avail, name_rank, zid, valid, num_zones)
             return
+        if self._elig is None or self._elig.shape[0] != n:
+            # Eligibility snapshot as of THIS sync's entry (pre-absorb):
+            # initialized here — never inside absorb, where host already
+            # reflects the very events being classified.
+            self._elig = (
+                ~np.asarray(host.unschedulable, bool)
+                & np.asarray(host.ready, bool)
+            ).copy()
         if self._dirty is None:
             dirty = self.agg.diff_rows(avail)
             self.stats["planner_resync_rows"] += n
@@ -355,17 +428,25 @@ class PrunePlanner:
         if all_dirty.size > max(1024, n // 4):
             self._rebuild(avail, name_rank, zid, valid, num_zones)
             return
-        self._classify(all_dirty, static, avail, zid, valid, host)
+        self._absorb(all_dirty, static, avail, zid, valid, host)
         self.index.update_rows(avail, name_rank, all_dirty, zone_id=zid)
         self.agg.update_rows(avail, zid, valid, all_dirty)
+        if self._elig is not None and all_dirty.size:
+            rows = all_dirty[all_dirty < self._elig.shape[0]]
+            self._elig[rows] = (
+                ~np.asarray(host.unschedulable, bool)[rows]
+                & np.asarray(host.ready, bool)[rows]
+            )
 
     def _rebuild(self, avail, name_rank, zid, valid, num_zones) -> None:
         self.index.rebuild(avail, name_rank, zid, num_zones)
         self.agg.rebuild(avail, zid, valid, num_zones)
-        self._entries.clear()
-        self._keep = None
+        self._full.entries.clear()
+        self._full.keep = None
+        self._dom_ctxs.clear()
         self._dirty = []
         self._static = []
+        self._elig = None  # re-snapshotted lazily at the next absorb
 
     # Conservative-leftover budget per zone entry: each absorbed
     # excluded-row change leaves the row's OLD contribution behind in the
@@ -374,34 +455,49 @@ class PrunePlanner:
     # the drift causes spurious escalations.
     _STALE_BUDGET = 32
 
-    def _classify(self, all_dirty, static, avail, zid, valid, host) -> None:
-        """Absorb the changed rows into the per-zone cache, BEFORE the
-        snapshots move:
+    def _absorb(self, all_dirty, static, avail, zid, valid, host) -> None:
+        """Absorb the changed rows into every cached plan context, BEFORE
+        the snapshots move:
 
           benign  — a non-static change to a KEPT row: the excluded-row
                     summaries depend only on excluded rows, so the entry
                     stands verbatim (the steady-serving case: gang
                     placements land on kept rows);
-          merge   — any change to a NON-KEPT row (node add/update/delete,
-                    external usage churn, eligibility flips): the row's
-                    NEW state merges exactly (joining a summary can only
+          insert  — a change to a NON-KEPT row whose key BEATS the kept
+                    boundary (a node ADD whose name sorts first): the row
+                    is inserted into the kept order directly and the old
+                    K-th row evicts into the excluded summaries — O(K),
+                    no re-scan (ISSUE 15 tentpole (c));
+          merge   — any other change to a NON-KEPT row: the row's NEW
+                    state merges exactly (joining a summary can only
                     extend min/max/presence), while its old contribution
                     lingers as a conservative leftover — sound by the
                     certificate's over-approximation contract. Leftovers
                     are budgeted (`_STALE_BUDGET`) per zone;
           rescan  — a STATIC flip on a kept row (validity/zone/rank of a
-                    kept row breaks the `total − kept` offset identity)
-                    or an exhausted leftover budget: drop the zone's
-                    entry; the next plan re-scans just that zone.
+                    kept row breaks the `total − kept` offset identity),
+                    kept-set depletion past the budget, or an exhausted
+                    leftover budget: drop the zone's entry; the next plan
+                    re-scans just that zone.
         """
-        if not self._entries:
+        ctxs = [self._full] + list(self._dom_ctxs.values())
+        live = [
+            c for c in ctxs
+            if c.entries or (c.dom_mask is not None and c.zcnt is not None)
+        ]
+        if not live:
             return
         if all_dirty.size > 4096:
             # A bulk churn burst (resync after a dense fetch, a huge
-            # delta): dropping every entry is cheaper and exact — the
-            # next plan re-scans the zones it needs.
-            self._entries.clear()
-            self._keep = None
+            # delta): dropping every context is cheaper and exact — the
+            # next plan re-scans the zones (or domains) it needs.
+            self._full.entries.clear()
+            self._full.keep = None
+            self._dom_ctxs.clear()
+            return
+        n = avail.shape[0]
+        all_dirty = all_dirty[all_dirty < n]
+        if not all_dirty.size:
             return
         old_zone = self.agg.zone_of(all_dirty)
         new_zone = zid[all_dirty].astype(np.int32)
@@ -413,9 +509,59 @@ class PrunePlanner:
         unsched = np.asarray(host.unschedulable, bool)
         ready = np.asarray(host.ready, bool)
         name_rank = np.asarray(host.name_rank)
+        # A kept row's static flip forces a zone re-scan ONLY when it
+        # breaks the `total − kept` offset identity (zone move, validity
+        # flip) or the row's exec eligibility. Rank/label relabels — the
+        # name-rank REBALANCE a node-ADD burst scatters over the insert
+        # point's neighborhood — leave sums, membership, eligibility and
+        # the excluded summaries exact: treating them as re-scans made
+        # every burst add O(zone) again (the pre-ISSUE-15 ADD-burst p99).
+        elig_new = ~unsched[all_dirty] & ready[all_dirty]
+        keeps_identity = (
+            (old_zone == new_zone)
+            & (was_valid == np.asarray(valid, bool)[all_dirty])
+            & (self._elig[all_dirty] == elig_new)
+        )
+        for ctx in live:
+            if ctx.dom_mask is not None and ctx.zcnt is not None:
+                # Per-domain totals: subtract the rows' old contribution
+                # (agg snapshots — not yet updated this sync) and add the
+                # new, restricted to domain members.
+                sel = all_dirty[ctx.dom_mask[all_dirty]]
+                if sel.size:
+                    self._ctx_totals_update(ctx, sel, avail, zid, valid)
+            if not ctx.entries:
+                continue
+            self._absorb_ctx(
+                ctx, all_dirty, old_zone, new_zone, was_valid, is_static,
+                keeps_identity, avail, valid, unsched, ready, name_rank,
+            )
+
+    def _ctx_totals_update(self, ctx, rows, avail, zid, valid) -> None:
+        old_v = self.agg.valid_of(rows)
+        ov = rows[old_v]
+        if ov.size:
+            oz = self.agg.zone_of(ov)
+            np.add.at(ctx.zcnt, oz, -1)
+            np.add.at(ctx.zone_mem, oz, -self.agg.mem_of(ov))
+            np.add.at(ctx.zone_cpu, oz, -self.agg.cpu_of(ov))
+        nv = rows[np.asarray(valid, bool)[rows]]
+        if nv.size:
+            nz = np.asarray(zid)[nv]
+            np.add.at(ctx.zcnt, nz, 1)
+            np.add.at(ctx.zone_mem, nz, avail[nv, MEM_DIM].astype(np.int64))
+            np.add.at(ctx.zone_cpu, nz, avail[nv, CPU_DIM].astype(np.int64))
+
+    def _absorb_ctx(
+        self, ctx, all_dirty, old_zone, new_zone, was_valid, is_static,
+        keeps_identity, avail, valid, unsched, ready, name_rank,
+    ) -> None:
+        dm = ctx.dom_mask
         for i, r in enumerate(all_dirty):
+            if dm is not None and not dm[r]:
+                continue
             oz, nz = int(old_zone[i]), int(new_zone[i])
-            entry = self._entries.get(nz)
+            entry = ctx.entries.get(nz)
             in_keep = False
             if entry is not None and entry.keep.size:
                 p = np.searchsorted(entry.keep, r)
@@ -423,98 +569,303 @@ class PrunePlanner:
                     p < entry.keep.size and entry.keep[p] == r
                 )
             if in_keep:
-                if not is_static[i]:
-                    # Benign: kept-row value churn. But track DEPLETION —
-                    # a kept row that no longer fits either class minimum
-                    # is dead weight, and a zone serving mostly-depleted
-                    # kept rows while fresh excluded capacity exists will
-                    # fail its certificate; refresh first.
+                if not is_static[i] or keeps_identity[i]:
+                    # Benign: kept-row value churn, or a static relabel
+                    # (name/label rank) that leaves zone and validity —
+                    # the offset identity's inputs — untouched. Track
+                    # DEPLETION either way: a kept row that no longer
+                    # fits either class minimum (or lost exec
+                    # eligibility) is dead weight, and a zone serving
+                    # mostly-depleted kept rows while fresh excluded
+                    # capacity exists will fail its certificate;
+                    # refresh first.
                     av = avail[r]
-                    if self._min_dr is not None and not (
-                        (av >= self._min_dr).all()
-                        or (av >= self._min_er).all()
+                    if ctx.min_dr is not None and (
+                        not (
+                            (av >= ctx.min_dr).all()
+                            or (av >= ctx.min_er).all()
+                        )
+                        or (is_static[i] and (unsched[r] or not ready[r]))
                     ):
                         entry.depleted += 1
                         # Aggressive on purpose: a zone serving depleted
                         # kept rows ranks FIRST (lowest totals), so the
                         # full solve would reach for its excluded rows
-                        # almost immediately — one O(zone) re-scan is
-                        # far cheaper than the escalation it prevents.
-                        if entry.depleted > max(1, self._k // 8):
-                            self._entries.pop(nz, None)
-                            self._keep = None
+                        # almost immediately. The refresh re-picks the
+                        # kept set by an EARLY-EXIT walk of the order —
+                        # O(K + consumed prefix), not O(zone) — far
+                        # cheaper than the escalation it prevents.
+                        if entry.depleted > max(1, ctx.k // 8):
+                            self._refresh_zone(
+                                ctx, nz, entry, avail, valid, unsched,
+                                ready, name_rank,
+                            )
                     continue
-                # Static flip (validity/zone/rank) of a KEPT row: the
-                # offset identity needs every kept row live — re-scan.
-                self._entries.pop(nz, None)
-                self._keep = None
+                # Zone move / validity flip of a KEPT row: the offset
+                # identity needs every kept row live in its zone —
+                # re-scan.
+                ctx.entries.pop(nz, None)
+                ctx.keep = None
                 continue
             # Non-kept row: merge its new state (exact direction), note
             # the leftover. A zone move leaves its old zone's summaries
             # as leftovers too.
             if oz != nz:
-                old_entry = self._entries.get(oz)
+                old_entry = ctx.entries.get(oz)
                 if old_entry is not None:
                     kp = old_entry.keep
                     p = np.searchsorted(kp, r) if kp.size else 0
                     if kp.size and p < kp.size and kp[p] == r:
                         # The moved row was KEPT under its old zone: the
                         # old entry's offset identity is broken — re-scan.
-                        self._entries.pop(oz, None)
-                        self._keep = None
+                        ctx.entries.pop(oz, None)
+                        ctx.keep = None
                     else:
                         old_entry.stale += 1
                         if old_entry.stale > self._STALE_BUDGET:
-                            self._entries.pop(oz, None)
-                            self._keep = None
+                            ctx.entries.pop(oz, None)
+                            ctx.keep = None
             if entry is None:
                 continue
-            if bool(valid[r]) and self._merge_row(
-                entry, int(r), avail, unsched, ready, name_rank
-            ):
-                # The row beats the kept boundary: a fresh selection
-                # would keep it — re-scan the zone.
-                self._entries.pop(nz, None)
-                self._keep = None
-                continue
+            if bool(valid[r]):
+                self._merge_row(
+                    ctx, entry, int(r), avail, unsched, ready, name_rank
+                )
             if not was_valid[i]:
                 # A brand-new valid row (node ADD) merged EXACTLY — it
                 # has no old contribution, so no leftover to budget.
                 continue
+            if is_static[i] and keeps_identity[i]:
+                # Rank/label-only relabel of an excluded row (the ADD
+                # burst's rebalance neighborhood): sums, counts and
+                # per-dim maxima are untouched; only the lexmin keys'
+                # NAME component can go conservative-stale. Charging the
+                # leftover budget made every ~32 relabels force an
+                # O(zone) re-scan — a steady add stream relabels
+                # hundreds. Certificate soundness is unaffected (stale
+                # keys only over-approximate).
+                continue
             entry.stale += 1
             if entry.stale > self._STALE_BUDGET:
-                self._entries.pop(nz, None)
-                self._keep = None
+                ctx.entries.pop(nz, None)
+                ctx.keep = None
 
     def _merge_row(
-        self, entry, r, avail, unsched, ready, name_rank
-    ) -> bool:
-        """Merge one non-kept row's NEW state into the zone entry.
-        Returns True when the row BEATS the kept-set boundary — a fresh
-        selection would have kept it, so the caller must drop the entry
-        (re-scan) instead of parking a top-K row among the excluded."""
+        self, ctx, entry, r, avail, unsched, ready, name_rank
+    ) -> None:
+        """Absorb one non-kept row's NEW state into the zone entry. A row
+        BEATING a class's kept-set boundary is inserted into that class's
+        kept order directly (evicting the tail into the excluded
+        summaries — O(K), no re-scan); anything else merges into the
+        excluded summaries (exact direction)."""
         av = avail[r].astype(np.int64)
         key = (
             int(avail[r, MEM_DIM]),
             int(avail[r, CPU_DIM]),
             int(name_rank[r]),
         )
-        if (av >= self._min_dr).all():
-            if entry.last_key_d is None or _key_lt(key, entry.last_key_d):
-                return True
-            entry.has_d = True
-            if _key_lt(key, entry.key_d):
-                entry.key_d = key
-            entry.max_d = np.maximum(entry.max_d, av)
-        if (av >= self._min_er).all() and not unsched[r] and ready[r]:
-            if entry.last_key_e is None or _key_lt(key, entry.last_key_e):
-                return True
-            entry.has_e = True
-            if _key_lt(key, entry.key_e):
-                entry.key_e = key
-            entry.max_e = np.maximum(entry.max_e, av)
+        fits_d = bool((av >= ctx.min_dr).all())
+        fits_e = bool(
+            (av >= ctx.min_er).all() and not unsched[r] and ready[r]
+        )
+        ins_d = fits_d and (
+            entry.last_key_d is None or _key_lt(key, entry.last_key_d)
+        )
+        ins_e = fits_e and (
+            entry.last_key_e is None or _key_lt(key, entry.last_key_e)
+        )
+        if ins_d or ins_e:
+            self._boundary_insert(
+                ctx, entry, r, key, ins_d, ins_e,
+                avail, unsched, ready, name_rank,
+            )
+            return
+        _merge_excluded(
+            entry, r, avail, ctx.min_dr, ctx.min_er,
+            unsched, ready, name_rank,
+        )
         self.stats["planner_merges"] += 1
-        return False
+
+    def _boundary_insert(
+        self, ctx, entry, r, key, ins_d, ins_e,
+        avail, unsched, ready, name_rank,
+    ) -> None:
+        """Insert a boundary-beating row into the kept order (tentpole
+        (c)): O(K) — the row takes its key position per class, the old
+        K-th row evicts into the excluded summaries exactly (an evicted
+        row joins a summary for the first time, so there is no leftover
+        to budget), and the class boundary key refreshes from the new
+        tail. The assembled window keep is invalidated (reassembled in
+        O(K) at the next plan); the per-zone summaries stay exact."""
+        self.stats["planner_boundary_inserts"] += 1
+        evicted: list[int] = []
+        for cls, ins in (("d", ins_d), ("e", ins_e)):
+            if not ins:
+                continue
+            kept = entry.kept_d if cls == "d" else entry.kept_e
+            mem = avail[kept, MEM_DIM].astype(np.int64)
+            cpu = avail[kept, CPU_DIM].astype(np.int64)
+            nr = name_rank[kept].astype(np.int64)
+            after = (mem > key[0]) | (
+                (mem == key[0])
+                & ((cpu > key[1]) | ((cpu == key[1]) & (nr > key[2])))
+            )
+            pos = int(np.argmax(after)) if bool(after.any()) else int(kept.size)
+            new = np.insert(kept, pos, np.int32(r))
+            if new.size > ctx.k:
+                evicted.append(int(new[-1]))
+                new = new[: ctx.k]
+            if new.size >= ctx.k:
+                last = int(new[-1])
+                lk = (
+                    int(avail[last, MEM_DIM]),
+                    int(avail[last, CPU_DIM]),
+                    int(name_rank[last]),
+                )
+            else:
+                lk = None
+            if cls == "d":
+                entry.kept_d, entry.last_key_d = new, lk
+            else:
+                entry.kept_e, entry.last_key_e = new, lk
+        entry.keep = np.unique(
+            np.concatenate([entry.kept_e, entry.kept_d])
+        )
+        keep = entry.keep
+        for ev in evicted:
+            p = np.searchsorted(keep, ev)
+            if p < keep.size and keep[p] == ev:
+                continue  # still kept via the other class
+            _merge_excluded(
+                entry, ev, avail, ctx.min_dr, ctx.min_er,
+                unsched, ready, name_rank,
+            )
+        ctx.keep = None
+
+    def _refresh_zone(
+        self, ctx, z, entry, avail, valid, unsched, ready, name_rank
+    ) -> None:
+        """Depletion refresh (ISSUE 15 residual (d)): re-pick the zone's
+        kept rows by walking the resident order with EARLY EXIT — the
+        depleted (most-consumed) rows sort FIRST in the order, so the
+        walk costs O(K + consumed prefix), not O(zone). Rows leaving the
+        kept set merge into the excluded summaries exactly; everything
+        beyond the scanned prefix keeps its old (excluded) contribution
+        — conservative, and budgeted like any other leftover, so the
+        exact O(zone) re-scan still runs when conservatism accumulates.
+        """
+        zo = self.index.zone_order(z)
+        k = ctx.k
+        if zo.size <= max(4096, 8 * k):
+            # Small zone: the exact re-scan costs about the same as the
+            # walk — take exactness (no conservative carry-over).
+            ctx.entries.pop(z, None)
+            ctx.keep = None
+            return
+        dm = ctx.dom_mask
+        sel_e: list = []
+        sel_d: list = []
+        n_e = n_d = 0
+        pos = 0
+        step = max(512, 4 * k)
+        scanned = 0
+        while pos < zo.size and (n_e <= k or n_d <= k):
+            chunk = zo[pos:pos + step]
+            pos += step
+            scanned += int(chunk.size)
+            live = (
+                valid[chunk] if dm is None else (dm[chunk] & valid[chunk])
+            )
+            chunk = chunk[live]
+            if not chunk.size:
+                continue
+            av = avail[chunk]
+            fd = (av >= ctx.min_dr).all(axis=1)
+            fe = (
+                (av >= ctx.min_er).all(axis=1)
+                & ~unsched[chunk]
+                & ready[chunk]
+            )
+            if fd.any():
+                sel_d.append(chunk[fd])
+                n_d += int(fd.sum())
+            if fe.any():
+                sel_e.append(chunk[fe])
+                n_e += int(fe.sum())
+        self.stats["planner_rows_scanned"] += scanned
+        self.stats["planner_zone_refreshes"] = (
+            self.stats.get("planner_zone_refreshes", 0) + 1
+        )
+        fit_d = (
+            np.concatenate(sel_d).astype(np.int32)
+            if sel_d
+            else np.empty(0, np.int32)
+        )
+        fit_e = (
+            np.concatenate(sel_e).astype(np.int32)
+            if sel_e
+            else np.empty(0, np.int32)
+        )
+
+        def _key_of(r: int):
+            return (
+                int(avail[r, MEM_DIM]),
+                int(avail[r, CPU_DIM]),
+                int(name_rank[r]),
+            )
+
+        old_keep = entry.keep
+        entry.kept_d = fit_d[:k]
+        entry.kept_e = fit_e[:k]
+        entry.keep = np.unique(
+            np.concatenate([entry.kept_e, entry.kept_d])
+        )
+        entry.depleted = 0
+        entry.stale += 1  # conservative carry-over: budget the drift
+        entry.last_key_d = (
+            _key_of(int(entry.kept_d[k - 1]))
+            if entry.kept_d.size >= k
+            else None
+        )
+        entry.last_key_e = (
+            _key_of(int(entry.kept_e[k - 1]))
+            if entry.kept_e.size >= k
+            else None
+        )
+        # First fitting row past each kept prefix joins the lexmin/max
+        # conservatively (it is the class's new excluded best within the
+        # scanned prefix; beyond-scan rows were excluded before and keep
+        # their old contributions).
+        if fit_d.size > k:
+            _merge_excluded(
+                entry, int(fit_d[k]), avail, ctx.min_dr, ctx.min_er,
+                unsched, ready, name_rank,
+            )
+        if fit_e.size > k:
+            _merge_excluded(
+                entry, int(fit_e[k]), avail, ctx.min_dr, ctx.min_er,
+                unsched, ready, name_rank,
+            )
+        # Rows LEAVING the kept set merge in exactly (first membership in
+        # the excluded summaries — their current state).
+        if old_keep.size and entry.keep.size:
+            p = np.clip(
+                np.searchsorted(entry.keep, old_keep),
+                0, entry.keep.size - 1,
+            )
+            gone = old_keep[entry.keep[p] != old_keep]
+        else:
+            gone = old_keep
+        for r in gone:
+            r = int(r)
+            if bool(valid[r]) and (dm is None or bool(dm[r])):
+                _merge_excluded(
+                    entry, r, avail, ctx.min_dr, ctx.min_er,
+                    unsched, ready, name_rank,
+                )
+        if entry.stale > self._STALE_BUDGET:
+            ctx.entries.pop(z, None)  # exact re-scan at the next plan
+        ctx.keep = None
 
     # -- planning ------------------------------------------------------------
 
@@ -524,6 +875,77 @@ class PrunePlanner:
     ) -> PrunePlan | None:
         """O(K + changed) plan for a window whose shared domain is the
         full valid mask (the resident aggregates' coverage)."""
+        return self._plan_ctx(
+            self._full, host,
+            cand_per_req=cand_per_req, drv_arr=drv_arr, exc_arr=exc_arr,
+            counts=counts, num_zones=num_zones, top_k=top_k, slack=slack,
+        )
+
+    def plan_with_masks(
+        self, host, *, dom_mask, cand_per_req, drv_arr, exc_arr, counts,
+        num_zones, top_k, slack, dom_key=None,
+    ) -> PrunePlan | None:
+        """Plan for a window whose shared domain is a SUBSET of the
+        cluster (instance-group pinned domains — the pooled partition
+        path). The FIRST plan per domain pays one vectorized O(N) sweep
+        to derive the domain's per-zone membership and totals (counted in
+        `planner_sweep_rows`); the resulting context is cached under
+        `dom_key` and every later window plans in O(K + changed) exactly
+        like the full domain — including kept-set reuse, which keys the
+        solver's per-partition statics-gather reuse (ISSUE 15 tentpole
+        (b)). Reuse requires the SAME dom_mask object: a domain
+        MEMBERSHIP change re-keys the mask and cold-starts the context."""
+        ctx = self._dom_ctxs.get(dom_key) if dom_key is not None else None
+        if ctx is not None and ctx.dom_mask is not dom_mask:
+            dm = np.asarray(dom_mask, bool)
+            if ctx.dom_mask.shape == dm.shape and np.array_equal(
+                ctx.dom_mask, dm
+            ):
+                # A node event ELSEWHERE re-keyed the mask object without
+                # changing this domain's content (an add/delete in another
+                # instance group flips `valid` rows outside the domain):
+                # adopt the new object and keep the context. One O(N)
+                # compare per node event per domain — never per window.
+                ctx.dom_mask = dm
+            else:
+                ctx = None  # membership changed: cold-start fresh
+        if ctx is None:
+            ctx = self._cold_dom_ctx(host, dom_mask, num_zones)
+            if dom_key is not None:
+                while len(self._dom_ctxs) >= self._MAX_DOM_CTXS:
+                    # Evict the oldest-built context only — clearing the
+                    # whole cache would cold-start every warm domain.
+                    self._dom_ctxs.pop(next(iter(self._dom_ctxs)))
+                self._dom_ctxs[dom_key] = ctx
+        return self._plan_ctx(
+            ctx, host,
+            cand_per_req=cand_per_req, drv_arr=drv_arr, exc_arr=exc_arr,
+            counts=counts, num_zones=num_zones, top_k=top_k, slack=slack,
+        )
+
+    def _cold_dom_ctx(self, host, dom_mask, num_zones) -> _DomCtx:
+        """One vectorized sweep deriving a subset domain's per-zone
+        membership counts and availability totals — the context's only
+        O(N) moment (legacy `planner_sweep_rows` semantics)."""
+        avail = np.asarray(host.available)
+        zone_id = np.asarray(host.zone_id)
+        valid = np.asarray(host.valid)
+        n = avail.shape[0]
+        self.stats["planner_sweep_rows"] += n
+        ctx = _DomCtx(np.asarray(dom_mask, bool))
+        live = ctx.dom_mask & valid
+        lz = zone_id[live]
+        ctx.zcnt = np.bincount(lz, minlength=num_zones).astype(np.int64)
+        ctx.zone_mem = np.zeros(num_zones, np.int64)
+        ctx.zone_cpu = np.zeros(num_zones, np.int64)
+        np.add.at(ctx.zone_mem, lz, avail[live, MEM_DIM].astype(np.int64))
+        np.add.at(ctx.zone_cpu, lz, avail[live, CPU_DIM].astype(np.int64))
+        return ctx
+
+    def _plan_ctx(
+        self, ctx, host, *, cand_per_req, drv_arr, exc_arr, counts,
+        num_zones, top_k, slack,
+    ) -> PrunePlan | None:
         t0 = _time.perf_counter()
         avail = np.asarray(host.available)
         valid = np.asarray(host.valid)
@@ -536,7 +958,7 @@ class PrunePlanner:
         # row set) stable across window-demand jitter at the cost of at
         # most 2x extra kept rows.
         k = _bucket(max(int(top_k), int(np.ceil(demand * slack))), 1)
-        agg = self.agg
+        full = ctx.dom_mask is None
         # Cache-key drift: a LOWER per-dim minimum demand or a LARGER K
         # widens the relevant-row sets, which the cached excluded
         # summaries cannot soundly describe — full re-scan.
@@ -544,36 +966,37 @@ class PrunePlanner:
         # invalidate — invalidate() resets the cached minima). Everything
         # else (K/minima widening, churn-dropped entries) counts as rows
         # SCANNED, so the CI O(K) assertion sees every incremental sweep.
-        cold = self._min_dr is None
+        cold = ctx.min_dr is None
         if cold or (
-            k > self._k
-            or (min_dr < self._min_dr).any()
-            or (min_er < self._min_er).any()
+            k > ctx.k
+            or (min_dr < ctx.min_dr).any()
+            or (min_er < ctx.min_er).any()
         ):
-            self._entries.clear()
-            self._keep = None
-            self._min_dr = min_dr
-            self._min_er = min_er
-            self._k = k
+            ctx.entries.clear()
+            ctx.keep = None
+            ctx.min_dr = min_dr
+            ctx.min_er = min_er
+            ctx.k = k
         counter = "planner_cold_rows" if cold else "planner_rows_scanned"
         unsched = np.asarray(host.unschedulable, bool)
         ready = np.asarray(host.ready, bool)
         name_rank = np.asarray(host.name_rank)
-        zones = np.flatnonzero(agg.cnt > 0)
-        changed = self._keep is None
+        zcnt = self.agg.cnt if full else ctx.zcnt
+        zones = np.flatnonzero(zcnt > 0)
+        changed = ctx.keep is None
         for z in zones:
-            if int(z) not in self._entries:
+            if int(z) not in ctx.entries:
                 self._rescan_zone(
-                    int(z), avail, valid, unsched, ready, name_rank,
+                    ctx, int(z), avail, valid, unsched, ready, name_rank,
                     counter,
                 )
                 changed = True
-        dom_rows = int(agg.cnt.sum())
+        dom_rows = int(zcnt.sum())
         if changed:
             keeps = [
-                self._entries[int(z)].keep
+                ctx.entries[int(z)].keep
                 for z in zones
-                if int(z) in self._entries
+                if int(z) in ctx.entries
             ]
             keep_real = (
                 np.sort(np.concatenate(keeps)).astype(np.int32)
@@ -582,16 +1005,16 @@ class PrunePlanner:
             )
             k_real = int(keep_real.shape[0])
             if k_real == 0 or k_real >= 0.7 * dom_rows:
-                self._keep = None
+                ctx.keep = None
                 return None
             kp = _bucket(k_real, 64)
             keep_padded = np.full(kp, keep_real[0], np.int32)
             keep_padded[:k_real] = keep_real
-            self._keep = keep_padded
-            self._keep_real = k_real
+            ctx.keep = keep_padded
+            ctx.keep_real = k_real
         else:
-            keep_padded = self._keep
-            k_real = self._keep_real
+            keep_padded = ctx.keep
+            k_real = ctx.keep_real
             if k_real == 0 or k_real >= 0.7 * dom_rows:
                 return None
             self.stats["plan_reuse"] += 1
@@ -607,7 +1030,7 @@ class PrunePlanner:
         e_key_e = np.full((zb, 3), _I64_MAX, np.int64)
         e_key_d = np.full((zb, 3), _I64_MAX, np.int64)
         for z in zones:
-            entry = self._entries.get(int(z))
+            entry = ctx.entries.get(int(z))
             if entry is None:
                 continue
             if entry.has_e:
@@ -621,30 +1044,41 @@ class PrunePlanner:
 
         # Offsets: excluded sums = resident totals − Σ kept, O(K).
         t1 = _time.perf_counter()
+        tot_mem = self.agg.mem if full else ctx.zone_mem
+        tot_cpu = self.agg.cpu if full else ctx.zone_cpu
         kept_avail = avail[keep_real_v].astype(np.int64)
         kz = zid[keep_real_v]
         kept_mem = np.zeros(zb, np.int64)
         kept_cpu = np.zeros(zb, np.int64)
         np.add.at(kept_mem, kz, kept_avail[:, MEM_DIM])
         np.add.at(kept_cpu, kz, kept_avail[:, CPU_DIM])
-        s_mem = agg.mem - kept_mem
-        s_cpu = agg.cpu - kept_cpu
-        present = agg.cnt > 0
+        s_mem = tot_mem - kept_mem
+        s_cpu = tot_cpu - kept_cpu
+        present = zcnt > 0
         mem_hi, mem_lo = split_zone_sums(s_mem)
         cpu_hi, cpu_lo = split_zone_sums(s_cpu)
         t2 = _time.perf_counter()
 
-        kept_mask = np.zeros(avail.shape[0], dtype=bool)
-        kept_mask[keep_real_v] = True
+        # Gather the per-request candidate masks onto the kept rows,
+        # deduplicated by mask identity — serving requests overwhelmingly
+        # share ONE candidate ticket, so the window pays one [K] gather
+        # instead of B (the 16-wide residual, ISSUE 15 tentpole (d)).
+        gather_memo: dict[int, np.ndarray] = {}
+        cand_kept = []
+        for c in cand_per_req:
+            g = gather_memo.get(id(c))
+            if g is None:
+                g = np.asarray(c)[keep_padded]
+                gather_memo[id(c)] = g
+            cand_kept.append(g)
         return PrunePlan(
             keep=keep_padded,
             k_real=k_real,
-            kept_mask=kept_mask,
-            dom_mask=valid,
+            dom_mask=valid if full else ctx.dom_mask,
             num_zones=zb,
             zone_base=(mem_hi, mem_lo, cpu_hi, cpu_lo, present),
-            zone_mem=agg.mem.copy(),
-            zone_cpu=agg.cpu.copy(),
+            zone_mem=np.asarray(tot_mem).copy(),
+            zone_cpu=np.asarray(tot_cpu).copy(),
             present=present,
             e_cnt_exec=e_cnt_e,
             e_max_exec=e_max_e,
@@ -652,7 +1086,7 @@ class PrunePlanner:
             e_cnt_drv=e_cnt_d,
             e_max_drv=e_max_d,
             e_key_drv=e_key_d,
-            cand_kept=[np.asarray(c)[keep_padded] for c in cand_per_req],
+            cand_kept=cand_kept,
             dom_rows=dom_rows,
             reused=not changed,
             plan_ms=(t2 - t0) * 1e3,
@@ -660,19 +1094,30 @@ class PrunePlanner:
         )
 
     def _rescan_zone(
-        self, z, avail, valid, unsched, ready, name_rank, counter,
+        self, ctx, z, avail, valid, unsched, ready, name_rank, counter,
     ) -> None:
         """Exact per-zone prefilter state from the zone's resident order:
         first K fitting rows per class, the first fitting row beyond them
         (the excluded lexmin by construction — the order IS sorted by the
-        key), and the per-dim maxima over the rest."""
+        key), and the per-dim maxima over the rest. Subset domains filter
+        the zone order through their membership mask and refresh their
+        zone totals exactly in the same pass."""
         zo = self.index.zone_order(z)
         self.stats[counter] += int(zo.shape[0])
         self.stats["planner_zone_rescans"] += 1
-        rows = zo[valid[zo]]
-        k = self._k
+        if ctx.dom_mask is None:
+            rows = zo[valid[zo]]
+        else:
+            rows = zo[ctx.dom_mask[zo] & valid[zo]]
+            # Re-derive this zone's domain totals exactly: after a churn
+            # drop the delta-maintained values are still exact, but the
+            # recompute is O(zone) and kills any possibility of drift.
+            ctx.zcnt[z] = rows.size
+            ctx.zone_mem[z] = int(avail[rows, MEM_DIM].astype(np.int64).sum())
+            ctx.zone_cpu[z] = int(avail[rows, CPU_DIM].astype(np.int64).sum())
+        k = ctx.k
         if not rows.size:
-            self._entries[z] = _ZoneEntry(
+            ctx.entries[z] = _ZoneEntry(
                 np.empty(0, np.int32), np.empty(0, np.int32),
                 False, False,
                 (_I64_MAX,) * 3, (_I64_MAX,) * 3,
@@ -681,9 +1126,9 @@ class PrunePlanner:
             )
             return
         av = avail[rows]
-        fit_d = (av >= self._min_dr).all(axis=1)
+        fit_d = (av >= ctx.min_dr).all(axis=1)
         fit_e = (
-            (av >= self._min_er).all(axis=1)
+            (av >= ctx.min_er).all(axis=1)
             & ~unsched[rows]
             & ready[rows]
         )
@@ -728,145 +1173,17 @@ class PrunePlanner:
                 int(name_rank[last]),
             )
 
-        self._entries[z] = _ZoneEntry(
+        ctx.entries[z] = _ZoneEntry(
             kept_e, kept_d, has_e, has_d, key_e, key_d, max_e, max_d,
             last_key_e=_last_key(sel_e), last_key_d=_last_key(sel_d),
-        )
-
-    # -- subset domains (legacy sweep) --------------------------------------
-
-    def plan_with_masks(
-        self, host, *, dom_mask, cand_per_req, drv_arr, exc_arr, counts,
-        num_zones, top_k, slack,
-    ) -> PrunePlan | None:
-        """The pre-ISSUE-12 vectorized O(N) planner, kept for windows whose
-        shared domain is a SUBSET of the cluster (instance-group pinned
-        domains): the resident aggregates cover the full valid mask only.
-        Counted in `planner_sweep_rows`."""
-        t0 = _time.perf_counter()
-        avail = np.asarray(host.available)
-        zone_id = np.asarray(host.zone_id)
-        n = avail.shape[0]
-        self.stats["planner_sweep_rows"] += n
-
-        min_dr = drv_arr.min(axis=0)
-        min_er = exc_arr.min(axis=0)
-        exec_elig = (
-            dom_mask
-            & ~np.asarray(host.unschedulable, bool)
-            & np.asarray(host.ready, bool)
-        )
-        fit_e = (avail >= min_er[None, :]).all(axis=1) & exec_elig
-        fit_d = (avail >= min_dr[None, :]).all(axis=1) & dom_mask
-
-        b = drv_arr.shape[0]
-        demand = int(counts.sum()) + b
-        k_per_zone = max(int(top_k), int(np.ceil(demand * slack)))
-
-        zb = num_zones
-        dom_zcnt = (
-            np.bincount(zone_id[dom_mask], minlength=zb)
-            if dom_mask.any()
-            else np.zeros(zb, np.int64)
-        )
-        zids = np.flatnonzero(dom_zcnt)
-        name_rank = np.asarray(host.name_rank)
-        # Per-zone top-K off the zone's resident order, separately for
-        # executor-capable and driver-capable rows: a per-zone prefix
-        # stays a prefix under any zone-rank permutation, so mid-window
-        # zone-rank drift cannot promote an excluded row past a kept one
-        # within its zone.
-        sel: list[np.ndarray] = []
-        per_zone: dict[int, tuple] = {}
-        for z in zids:
-            zo = self.index.zone_order(int(z))
-            fo = zo[fit_e[zo]]
-            do = zo[fit_d[zo]]
-            per_zone[int(z)] = (fo, do)
-            sel.append(fo[:k_per_zone])
-            sel.append(do[:k_per_zone])
-        kept_mask = np.zeros(n, dtype=bool)
-        if sel:
-            kept_mask[np.concatenate(sel)] = True
-        keep = np.flatnonzero(kept_mask).astype(np.int32)
-        k_real = len(keep)
-        dom_rows = int(dom_mask.sum())
-        if k_real == 0 or k_real >= 0.7 * dom_rows:
-            return None  # pruning buys nothing on this window
-
-        excl = dom_mask & ~kept_mask
-        e_rows = np.flatnonzero(excl)
-        e_zone = zone_id[e_rows]
-
-        # Device zone-sum offsets: ALL excluded domain rows.
-        s_mem = _zone_sum(e_zone, avail[e_rows, MEM_DIM], zb)
-        s_cpu = _zone_sum(e_zone, avail[e_rows, CPU_DIM], zb)
-        present = dom_zcnt > 0
-
-        # Whole-domain dispatch sums = kept sums + excluded sums.
-        zone_mem = s_mem.copy()
-        zone_cpu = s_cpu.copy()
-        kept_avail = avail[keep].astype(np.int64)
-        kept_zone = zone_id[keep]
-        np.add.at(zone_mem, kept_zone, kept_avail[:, MEM_DIM])
-        np.add.at(zone_cpu, kept_zone, kept_avail[:, CPU_DIM])
-
-        def _summaries(which: int):
-            cnt = np.zeros(zb, np.int64)
-            mx = np.full((zb, avail.shape[1]), _I64_MIN, np.int64)
-            key = np.full((zb, 3), _I64_MAX, np.int64)
-            for z, orders in per_zone.items():
-                zo = orders[which]
-                rel = zo[excl[zo]]  # relevant excluded, in priority order
-                if not rel.size:
-                    continue
-                cnt[z] = rel.size
-                mx[z] = avail[rel].max(axis=0)
-                fr = rel[0]  # first in order == the zone's lexmin key
-                key[z, 0] = avail[fr, MEM_DIM]
-                key[z, 1] = avail[fr, CPU_DIM]
-                key[z, 2] = name_rank[fr]
-            return cnt, mx, key
-
-        e_cnt_exec, e_max_exec, e_key_exec = _summaries(0)
-        e_cnt_drv, e_max_drv, e_key_drv = _summaries(1)
-
-        kp = _bucket(k_real, 64)
-        keep_padded = np.full(kp, keep[0], np.int32)
-        keep_padded[:k_real] = keep
-
-        t1 = _time.perf_counter()
-        mem_hi, mem_lo = split_zone_sums(s_mem)
-        cpu_hi, cpu_lo = split_zone_sums(s_cpu)
-        t2 = _time.perf_counter()
-        return PrunePlan(
-            keep=keep_padded,
-            k_real=k_real,
-            kept_mask=kept_mask,
-            dom_mask=dom_mask,
-            num_zones=zb,
-            zone_base=(mem_hi, mem_lo, cpu_hi, cpu_lo, present),
-            zone_mem=zone_mem,
-            zone_cpu=zone_cpu,
-            present=present,
-            e_cnt_exec=e_cnt_exec,
-            e_max_exec=e_max_exec,
-            e_key_exec=e_key_exec,
-            e_cnt_drv=e_cnt_drv,
-            e_max_drv=e_max_drv,
-            e_key_drv=e_key_drv,
-            cand_kept=[np.asarray(c)[keep_padded] for c in cand_per_req],
-            dom_rows=dom_rows,
-            reused=False,
-            plan_ms=(t2 - t0) * 1e3,
-            offset_ms=(t2 - t1) * 1e3,
         )
 
     def index_stats(self) -> dict:
         return {
             "index": self.index.stats(),
             "aggregates": self.agg.stats(),
-            "cached_zones": len(self._entries),
+            "cached_zones": len(self._full.entries),
+            "cached_domains": len(self._dom_ctxs),
         }
 
 
@@ -894,8 +1211,11 @@ def certify_window(
     reason names the first failed test (telemetry label).
 
     O(K + rows) since ISSUE 12: every input is either per-kept-row or
-    per-zone — the [N]-shaped lut/base of the original implementation is
-    gone (the caller gathers `base_kept` on the kept rows)."""
+    per-zone — the [N]-shaped lut/base/kept-mask of the original
+    implementation is gone (the caller gathers `base_kept` on the kept
+    rows; membership tests bisect the sorted keep)."""
+    keep = plan.keep[: plan.k_real]  # sorted ascending
+
     # The device offsets assumed excluded rows kept their host-view
     # availability; a prior window's placement on an excluded row breaks
     # that (the plan was built before the prior's placements were known).
@@ -905,12 +1225,17 @@ def certify_window(
     in_dom = plan.dom_mask[prior_rows]
     prior_rows = prior_rows[in_dom]
     prior_deltas = prior_deltas[in_dom]
-    if prior_rows.size and not plan.kept_mask[prior_rows].all():
-        return False, "prior-placed-excluded"
+    if prior_rows.size:
+        pp = np.clip(
+            np.searchsorted(keep, prior_rows), 0, max(keep.size - 1, 0)
+        )
+        if keep.size == 0 or not bool(
+            (keep[pp] == prior_rows).all()
+        ):
+            return False, "prior-placed-excluded"
 
     zone_id = np.asarray(host.zone_id)
     name_rank = np.asarray(host.name_rank)
-    keep = plan.keep[: plan.k_real]  # sorted ascending
 
     def to_local(g: np.ndarray) -> np.ndarray:
         """Global rows -> kept-local indices, -1 for non-kept."""

@@ -190,13 +190,30 @@ class _DaemonFetchPool:
         import queue as _queue
 
         self._q: "_queue.Queue" = _queue.Queue()
+        self._name = name
         self._threads = []
-        for i in range(workers):
-            t = threading.Thread(
-                target=self._run, daemon=True, name=f"{name}-{i}"
-            )
-            t.start()
-            self._threads.append(t)
+        for _ in range(workers):
+            self._spawn_worker()
+
+    def _spawn_worker(self) -> None:
+        t = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"{self._name}-{len(self._threads)}",
+        )
+        t.start()
+        self._threads.append(t)
+
+    def ensure_workers(self, n: int) -> None:
+        """Grow the pool to at least `n` daemon workers (never shrinks:
+        threads are parked on a queue and cost nothing idle). Lets the
+        solve pool size itself to the DEVICE pool that actually exists
+        instead of a hardcoded worst case (ISSUE 15 satellite)."""
+        while len(self._threads) < n:
+            self._spawn_worker()
+
+    @property
+    def worker_count(self) -> int:
+        return len(self._threads)
 
     def _run(self) -> None:
         while True:
@@ -416,7 +433,7 @@ _solve_pool: "_DaemonFetchPool | None" = None
 _solve_pool_lock = threading.Lock()
 
 
-def _shared_solve_pool() -> "_DaemonFetchPool":
+def _shared_solve_pool(min_workers: int = 2) -> "_DaemonFetchPool":
     """Process-wide worker pool for the multi-device engine's window solves.
 
     On backends whose dispatch is effectively synchronous (jax CPU runs the
@@ -424,11 +441,21 @@ def _shared_solve_pool() -> "_DaemonFetchPool":
     host threads; on async backends the worker just owns the block+fetch.
     Shared and daemon for the same reasons as the fetch pool (see
     _DaemonFetchPool): workers run stateless jit applies and device_get
-    calls, and per-solver pools would leak threads across rebuilt apps."""
+    calls, and per-solver pools would leak threads across rebuilt apps.
+
+    SIZED TO THE DEVICE POOL, not a hardcoded 8 (ISSUE 15 satellite): the
+    caller passes `min(8, 2 * pool_slots)` — two workers per slot keeps the
+    upload-N+1-while-N-solves overlap engaged at pipeline depth 2 — and the
+    pool grows monotonically to the largest request, so a pool-1 mesh
+    solver stops carrying 7 idle daemon threads."""
     global _solve_pool
     with _solve_pool_lock:
         if _solve_pool is None:
-            _solve_pool = _DaemonFetchPool(workers=8, name="window-solve")
+            _solve_pool = _DaemonFetchPool(
+                workers=max(1, min_workers), name="window-solve"
+            )
+        else:
+            _solve_pool.ensure_workers(min_workers)
         return _solve_pool
 
 
@@ -442,6 +469,7 @@ class _PoolSlot:
         "placement", "label", "is_mesh", "statics", "statics_epoch",
         "sub_statics", "uploads", "last_full_upload", "inflight",
         "quarantined", "quarantined_at", "last_probe", "failure_count",
+        "avail", "avail_epoch", "avail_token", "mirror",
     )
 
     def __init__(self, placement):
@@ -473,6 +501,21 @@ class _PoolSlot:
         self.quarantined_at = 0.0
         self.last_probe = 0.0
         self.failure_count = 0
+        # Per-slot delta-synced availability mirror (ISSUE 15): the last
+        # full-base replica this slot held, its availability epoch, and
+        # the pipeline-generation token it belongs to. A lagging slot
+        # whose missed epochs are all journaled catches up by ROW-SCATTER
+        # from the canonical base (the PR 11 epoch-journal pattern,
+        # extended from statics to availability) instead of re-shipping
+        # the full [N,3] base. INVARIANT: `avail` never aliases the
+        # pipeline's canonical buffer — the canonical is donated through
+        # solves, and a donated buffer must have exactly one referent.
+        self.avail = None
+        self.avail_epoch = -1
+        self.avail_token = -1
+        # Mirror sync counters: delta catch-ups (events + rows scattered),
+        # full re-ships ("dense" syncs), and zero-transfer reuses.
+        self.mirror = {"catchup": 0, "delta_rows": 0, "dense": 0, "reuse": 0}
 
     def _put(self, arr):
         if self.is_mesh:
@@ -594,6 +637,9 @@ class _PoolSlot:
         self.statics_epoch = -1
         self.sub_statics.clear()
         self.inflight = 0
+        self.avail = None
+        self.avail_epoch = -1
+        self.avail_token = -1
 
 
 class _DevicePool:
@@ -677,6 +723,7 @@ class _DevicePool:
                 "inflight": s.inflight,
                 "quarantined": s.quarantined,
                 "failures": s.failure_count,
+                "mirror": dict(s.mirror),
             }
             for s in self.slots
         }
@@ -725,12 +772,12 @@ class _WindowPart:
     __slots__ = (
         "future", "after_future", "req_ids", "requests", "row_drv",
         "row_exc", "row_skip", "idx", "slot", "rows", "idx_key", "apps",
-        "prune",
+        "prune", "base_kept",
     )
 
     def __init__(self, *, future, after_future, req_ids, requests, row_drv,
                  row_exc, row_skip, idx, slot, rows, idx_key=None,
-                 apps=None, prune=None):
+                 apps=None, prune=None, base_kept=None):
         self.future = future
         self.after_future = after_future
         self.req_ids = req_ids  # original positions in the window
@@ -750,6 +797,12 @@ class _WindowPart:
         # domain (core/prune.py): its after_future then carries a DELTA
         # (combined additively), and the fetch runs the certificate.
         self.prune = prune
+        # Gathered-part dispatch-time base: the [len(idx), 3] int64
+        # availability of this part's rows, captured AT DISPATCH (the
+        # resident host buffer mutates in place afterwards) — the
+        # compact fetch reconstructs in part-local space against this,
+        # never touching an [N]-wide array (ISSUE 15).
+        self.base_kept = base_kept
 
 
 @_partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
@@ -924,12 +977,12 @@ class WindowHandle:
     __slots__ = (
         "strategy", "blob", "blob_future", "requests", "flat_rows",
         "host_avail", "host_avail32", "host_schedulable", "priors",
-        "placements", "placement_rows", "n",
+        "placements", "placement_rows", "placement_vals", "n",
         "row_driver_req", "row_exec_req", "row_skippable", "seg_map",
         "info", "parts", "request_device", "dispatch_id", "dispatched_at",
         "fused_decisions", "released", "host_tensors", "use_fallback",
         "prune", "fallback_reason", "base_kept", "avail_gen",
-        "__weakref__",
+        "avail_note_epoch", "__weakref__",
     )
 
     def __init__(self, *, strategy, blob, requests, flat_rows, host_avail,
@@ -961,10 +1014,14 @@ class WindowHandle:
         self.host_avail32 = None
         self.host_schedulable = host_schedulable
         self.priors = priors  # tuple[WindowHandle] — fetched before this one
-        self.placements = None  # int64 [N,3], filled at fetch
-        # Rows `placements` is non-zero on (pruned fetches fill this) —
-        # lets later windows subtract priors sparsely at the 1M tier.
+        self.placements = None  # int64 [N,3], filled at DENSE fetches only
+        # Sparse committed placements (pruned and pooled fetches):
+        # `placement_rows` [P] sorted global rows + `placement_vals`
+        # [P,3] int64 — later windows subtract priors sparsely and the
+        # dense [N,3] placements tensor is never materialized on the hot
+        # path at the 1M tier (ISSUE 15).
         self.placement_rows = None
+        self.placement_vals = None
         self.n = n
         self.row_driver_req = None  # int64 [B,3], set after dispatch
         self.row_exec_req = None
@@ -1009,6 +1066,10 @@ class WindowHandle:
         # for the rare dense reconstructions).
         self.base_kept = None
         self.avail_gen = None
+        # Pooled idx-None dispatch: the availability epoch this window
+        # journaled as UNKNOWABLE — its fetch patches the entry with the
+        # exact commit rows so slot mirrors can cross the epoch.
+        self.avail_note_epoch = None
 
     def release_buffers(self) -> None:
         """Drop the dispatch's staging buffers: the device decision blob
@@ -1150,11 +1211,15 @@ class PlacementSolver:
         self._prune_top_k = int(prune_top_k)
         self._prune_slack = float(prune_slack)
         self._planner = None  # lazy core/prune.PrunePlanner
-        # Statics-gather reuse (ISSUE 12 tentpole (c)): the last pruned
-        # window's gathered statics sub-blob + device buffers, re-served
-        # while the kept row set is identical (plan reuse) and no static
-        # row-delta touched a kept row.
-        self._prune_gather_cache: dict | None = None
+        # Statics-gather reuse (ISSUE 12 tentpole (c), generalized per
+        # domain in ISSUE 15): gathered statics sub-blobs keyed by the
+        # kept-row array's identity (per-domain plan reuse re-serves the
+        # same keep object; each entry pins its keep, so ids cannot
+        # recycle), re-served while no static row-delta touches a kept
+        # row. Single-device entries also carry the device buffers; pool
+        # slots cache their device copies per (keep, generation).
+        self._prune_gather_cache: dict = {}
+        self._gather_gen = _itertools.count(1)
         # (domain key, epochs) -> "is the full valid mask" memo — gates
         # the planner's resident-aggregate path for named full-roster
         # domains without an O(N) compare per window.
@@ -1175,7 +1240,9 @@ class PlacementSolver:
             "planner_sweep_rows": 0,
             "planner_resync_rows": 0,
             "planner_zone_rescans": 0,
+            "planner_zone_refreshes": 0,
             "planner_merges": 0,
+            "planner_boundary_inserts": 0,
             "plan_reuse": 0,
             "gather_reuse": 0,
             "plan_ms": 0.0,
@@ -1223,6 +1290,12 @@ class PlacementSolver:
         # release their [K, ...] staging buffers even while view handles
         # are still parked in the serving pipeline.
         self._dispatch_seq = _itertools.count(1)
+        # Pipeline-generation tokens for the per-slot availability
+        # mirrors (ISSUE 15): a slot's resident full-base replica is only
+        # a valid catch-up base within the pipeline generation that wrote
+        # it — a full re-upload starts a new generation and every replica
+        # goes stale at once.
+        self._pipe_tokens = _itertools.count(1)
         self._fused_owners: "_weakref.WeakSet[WindowHandle]" = (
             _weakref.WeakSet()
         )
@@ -1276,6 +1349,12 @@ class PlacementSolver:
         from spark_scheduler_tpu.core.lru import LRUCache
 
         self._cand_cache: LRUCache = LRUCache(64)
+        # (domain mask, valid mask) -> their AND, identity-keyed with the
+        # operands pinned alive: the per-window `dom & valid` product is
+        # an O(N) allocation, and — more importantly — a STABLE result
+        # object is what lets the prune planner's per-domain contexts
+        # recognize an unchanged domain across windows (ISSUE 15).
+        self._dom_and_memo: LRUCache = LRUCache(32)
         # Per-names patch bases for the epoch-journal candidate-mask
         # patch (ISSUE 13): names-key -> (epoch, n, mask, unresolved
         # names, removed member names) — see _cand_try_patch.
@@ -1345,6 +1424,11 @@ class PlacementSolver:
         # (usage rows, static rows) the LAST build patched; None = the
         # build could not name them (full snapshot / python builder).
         self._last_build_rows: "tuple | None" = None
+        # Union of rows EVERY build patched since the pipelined statics
+        # last synced (None = some build could not name its rows): the
+        # O(changed) candidate set for _plan_static_delta's field diff —
+        # robust to solo builds interleaving between pipelined ones.
+        self._static_acc: "list | None" = []
         # `solver.build-oracle`: after every dirty-set mirror sync, run
         # the dense compare as an ORACLE and fail loudly if the event-fed
         # candidate set missed a changed row (equivalence suites turn
@@ -1369,6 +1453,10 @@ class PlacementSolver:
             "mirror_rows_compared": 0,
             "mirror_dense_syncs": 0,
             "dirty_rows": 0,
+            # Rows pooled fetches debited sparsely into the mirror +
+            # pending ledger (ISSUE 15 — the pooled path's O(placed)
+            # claim as a counter; /debug/state surfaces it).
+            "pooled_debit_rows": 0,
             "oracle_checks": 0,
         }
 
@@ -1413,7 +1501,7 @@ class PlacementSolver:
         topology-change contract."""
         if self._planner is not None:
             self._planner.invalidate()
-        self._prune_gather_cache = None
+        self._prune_gather_cache.clear()
 
     def _prune_note_rows(self, rows) -> None:
         """Feed EXACT changed rows to the planner (O(changed) sync)."""
@@ -1430,7 +1518,7 @@ class PlacementSolver:
         full re-upload of unchanged host state) re-serves WITHOUT paying
         the O(N log N) cold replan (ISSUE 13 tentpole (d)). Any build that
         could not name its rows keeps the hard invalidate."""
-        self._prune_gather_cache = None
+        self._prune_gather_cache.clear()
         planner = self._planner
         if planner is None:
             return
@@ -1449,6 +1537,31 @@ class PlacementSolver:
         the planner's next sync diff-scans the snapshots instead."""
         if self._planner is not None:
             self._planner.mark_unknown()
+
+    def _prune_gather_entry(self, host, plan) -> dict:
+        """Host-side gathered-statics cache entry for a plan's kept rows,
+        keyed by the keep array's IDENTITY (per-domain plan reuse
+        re-serves the same object; the entry pins it, so the id cannot
+        recycle). Entries drop on static row-deltas touching their kept
+        rows, full uploads, and close(); the device-side copies ride the
+        entry's generation (single-device: stored here; pool slots: in
+        their sub-statics cache)."""
+        cache = self._prune_gather_cache
+        ent = cache.get(id(plan.keep))
+        if ent is not None and ent["keep"] is plan.keep:
+            return ent
+        while len(cache) >= 17:
+            # Evict the oldest entry only: a >16-domain rotation must not
+            # wipe every warm gather (and every slot's generation-checked
+            # device copy) on each new keep set.
+            cache.pop(next(iter(cache)))
+        ent = {
+            "keep": plan.keep,
+            "statics_np": _gather_statics_host(host, plan.keep, plan.k_real),
+            "gen": next(self._gather_gen),
+        }
+        cache[id(plan.keep)] = ent
+        return ent
 
     def _plan_prune(
         self, host, dom_mask, cand_per_req, drv_arr, exc_arr, counts,
@@ -1486,6 +1599,10 @@ class PlacementSolver:
                 num_zones=self._num_zones_bucket(),
                 top_k=self._prune_top_k,
                 slack=self._prune_slack,
+                # Per-domain plan contexts (ISSUE 15 tentpole (b)): the
+                # pooled partition path re-serves cached kept sets per
+                # instance group instead of re-sweeping O(N) per window.
+                dom_key=dom_key,
             )
         if plan is not None:
             st = self.prune_stats
@@ -1549,6 +1666,11 @@ class PlacementSolver:
         st = self.prune_stats
         st["escalations"] += 1
         st["reasons"][reason] = st["reasons"].get(reason, 0) + 1
+        if self._planner is not None:
+            # Re-scan to exactness: the failed certificate may trace to
+            # conservative drift in a cached entry — an escalation must
+            # never loop on the same stale summaries (ISSUE 15).
+            self._planner.reset_plan_entries()
         if handle.info is not None:
             handle.info["prune_escalated"] = reason
         if self.telemetry is not None:
@@ -1566,18 +1688,26 @@ class PlacementSolver:
                 h.fallback_reason = "prune-escalation"
             self._pipe = None
 
-    def _prior_sparse(self, handle):
-        """(rows, summed deltas) of every still-relevant prior window's
-        placements — the certificate's excluded-row-integrity input in
-        sparse form (pruned priors carry their placement rows, so this is
-        O(placed), not O(N)). None when a prior's placements are unknown
-        (failed fetch), which the caller maps to an escalation."""
+    def _collect_priors(self, handle, strict: bool):
+        """Sparse union (rows, summed deltas) of in-flight prior windows'
+        committed placements — O(placed), not O(N): pruned/pooled priors
+        carry (placement_rows, placement_vals). `strict` (the
+        certificate's contract): a prior whose placements are UNKNOWN
+        (failed fetch) returns None — the caller escalates. Lenient (the
+        dense-base reconstruction contract): an unknown prior contributes
+        nothing — its capacity returns via the next full upload."""
         rows_list: list[np.ndarray] = []
         deltas_list: list[np.ndarray] = []
         for prior in handle.priors:
-            if prior.placements is None:
-                return None
             pr = prior.placement_rows
+            if pr is not None and prior.placement_vals is not None:
+                rows_list.append(pr)
+                deltas_list.append(prior.placement_vals)
+                continue
+            if prior.placements is None:
+                if strict:
+                    return None
+                continue
             if pr is None:
                 pr = np.flatnonzero(prior.placements.any(axis=1))
             rows_list.append(pr)
@@ -1594,6 +1724,37 @@ class PlacementSolver:
         np.add.at(out, inv, deltas)
         return uniq.astype(np.int64), out
 
+    def _prior_sparse(self, handle):
+        """The certificate's excluded-row-integrity input: strict prior
+        collection (None when any prior's placements are unknown, which
+        the caller maps to an escalation)."""
+        return self._collect_priors(handle, strict=True)
+
+    @staticmethod
+    def _commit_rows(requests, drivers, admitted, execs) -> np.ndarray:
+        """Global rows a window's COMMITTED placements touched, read
+        straight from the decision blob in O(B · emax):
+        `_reconstruct_requests` only mutates `placements` at each admitted
+        request's final (committing) row — its driver and executor
+        indices — so this is exactly the dense placement tensor's
+        support. Feeds the sparse mirror debit and the planner's
+        dirty-row feed on the dense fetch paths (ISSUE 15)."""
+        rows: list[int] = []
+        r = 0
+        for req in requests:
+            real = r + len(req.rows) - 1
+            r += len(req.rows)
+            if not bool(admitted[real]):
+                continue
+            d = int(drivers[real])
+            if d >= 0:
+                rows.append(d)
+            ev = np.asarray(execs[real])
+            rows.extend(int(x) for x in ev[ev >= 0])
+        if not rows:
+            return np.empty(0, np.int64)
+        return np.unique(np.asarray(rows, np.int64))
+
     def _dense_base(self, handle) -> np.ndarray:
         """The dense [N,3] int64 fetch-side base reconstruction (host view
         at dispatch minus in-flight priors' placements). Pruned handles
@@ -1605,9 +1766,13 @@ class PlacementSolver:
         else:
             base = self._avail_at_dispatch(handle).astype(np.int64)
         for prior in handle.priors:
+            pr = prior.placement_rows
+            if pr is not None and prior.placement_vals is not None:
+                if pr.size:
+                    base[pr] -= prior.placement_vals
+                continue
             if prior.placements is None:
                 continue
-            pr = prior.placement_rows
             if pr is not None:
                 if pr.size:
                     base[pr] -= prior.placements[pr]
@@ -1793,6 +1958,7 @@ class PlacementSolver:
                 avail_epoch=avail_epoch, avail_journal=avail_journal,
             )
         self._last_build_rows = None
+        self._acc_build_rows()
         self._note_consumers_unknown()
         for n in nodes:
             self.registry.intern(n.name)
@@ -1902,8 +2068,13 @@ class PlacementSolver:
                             "h2d", rows.nbytes + idx.nbytes
                         )
                 else:
+                    # COPY before upload: CPU device_put may zero-copy
+                    # an aligned buffer, and this one is patched in
+                    # place by the resident build (see the pipelined
+                    # full upload's aliasing note).
                     tensors = dataclasses.replace(
-                        dev["tensors"], available=jax.device_put(host.available)
+                        dev["tensors"],
+                        available=jax.device_put(host.available.copy()),
                     )
                     stats["full_uploads"] += 1
                     stats["upload_bytes"] += host.available.nbytes
@@ -1913,7 +2084,9 @@ class PlacementSolver:
                             "h2d", host.available.nbytes
                         )
         if tensors is None:
-            tensors = jax.device_put(host)
+            tensors = jax.device_put(
+                dataclasses.replace(host, available=host.available.copy())
+            )
             stats["full_uploads"] += 1
             stats["upload_bytes"] += _tensors_nbytes(host)
             self.last_state_upload = "full"
@@ -1941,7 +2114,7 @@ class PlacementSolver:
         self._dev = None
         self._snap_res = None  # resident host buffers
         self._avail_undo.clear()
-        self._prune_gather_cache = None  # release cached device statics
+        self._prune_gather_cache.clear()  # release cached device statics
         self._release_fused()
         self._release_pool()
 
@@ -1966,7 +2139,7 @@ class PlacementSolver:
         keeping the [K, ...] device blobs alive through parked view
         handles would be a restart-shaped leak."""
         self._pipe = None
-        self._prune_gather_cache = None  # release cached device statics
+        self._prune_gather_cache.clear()  # release cached device statics
         self._release_fused()
         self._release_pool()
         if self.telemetry is not None:
@@ -2124,6 +2297,9 @@ class PlacementSolver:
                     # The prune planner's O(changed) sync rides exactly
                     # this dirty set (plus fetched placement rows).
                     self._prune_note_rows(dirty)
+                    # ... and so do the pool slots' availability mirrors:
+                    # the canonical device base changes at these rows.
+                    self._avail_journal_note(p, dirty)
                     # Pad with a repeated index but ZERO delta rows: .add
                     # is cumulative, so padding must contribute nothing.
                     # The base is DONATED into the add — committed-base
@@ -2165,6 +2341,9 @@ class PlacementSolver:
                     # sync equally re-established mirror == host).
                     pending=[],
                 )
+                # Statics synced to `host`: restart the delta-diff
+                # candidate accumulator.
+                self._static_acc = []
                 return tensors
         if p is not None and p["unfetched"]:
             if self.telemetry is not None:
@@ -2172,7 +2351,19 @@ class PlacementSolver:
             raise PipelineDrainRequired(
                 "cluster topology changed with a window in flight"
             )
-        tensors = jax.device_put(host)
+        # Upload a COPY of the availability: jax's CPU device_put
+        # ZERO-COPIES a suitably-aligned numpy buffer, so device_put of
+        # the resident host buffer can leave the device base ALIASING
+        # memory the resident build then patches in place — the base
+        # absorbs the change by aliasing AND again via the next delta
+        # upload (double debit; reproduced on the pooled path whenever
+        # the allocator happened to align the buffer). Statics buffers
+        # are safe as-is: changed static rows always COW before the
+        # write, and same-value writes cannot skew an alias. One [N,3]
+        # int32 copy per FULL upload, never on the delta path.
+        tensors = jax.device_put(
+            dataclasses.replace(host, available=host.available.copy())
+        )
         tensors.host = host
         stats["full_uploads"] += 1
         stats["upload_bytes"] += _tensors_nbytes(host)
@@ -2186,6 +2377,7 @@ class PlacementSolver:
         # the O(N log N) cold replan.
         self._static_epoch += 1
         self._static_journal.clear()
+        self._static_acc = []  # fresh statics baseline on device
         self._prune_full_upload()
         if self.telemetry is not None:
             self.telemetry.on_transfer("h2d", _tensors_nbytes(host))
@@ -2201,23 +2393,167 @@ class PlacementSolver:
             # None = unknown (dense compare next build). Starts empty —
             # the mirror IS the host view at this instant.
             "pending": [],
+            # Availability epoch + journal for the per-slot device
+            # mirrors (ISSUE 15): each canonical-base mutation bumps the
+            # epoch and journals the rows it touched (None = unknowable,
+            # forcing a full re-ship across that epoch). Fresh pipeline
+            # generation: every slot replica from before is stale.
+            "avail_epoch": 0,
+            "avail_journal": {},
+            "token": next(self._pipe_tokens),
         }
         return tensors
+
+    def _avail_journal_note(self, p, rows) -> None:
+        """Bump the pipeline's availability epoch with the rows the
+        canonical device base just changed on — a window's kept/partition
+        rows at dispatch, a delta upload's dirty rows — or None when the
+        rows are unknowable (an unpruned whole-window commit). Pool-slot
+        mirrors catch up by scattering the journaled union; any gap or
+        None epoch in a slot's missed chain forces the full re-ship. A
+        journaled row set may be a SUPERSET of what actually changed:
+        catch-up scatters values gathered from the canonical base, so
+        extra rows are byte-identical no-ops. Returns the epoch (None
+        when no pool): an unknowable (None) entry can be PATCHED once the
+        window's fetch learns its exact commit rows — later catch-ups
+        then cross the epoch instead of full re-shipping."""
+        if self._pool is None or p is None:
+            return None
+        e = p["avail_epoch"] + 1
+        p["avail_epoch"] = e
+        j = p["avail_journal"]
+        j[e] = None if rows is None else np.asarray(rows)
+        while len(j) > 64:
+            j.pop(next(iter(j)))
+        return e
+
+    def _journal_rows_between(self, p, lo: int, hi: int):
+        """Union of journaled rows across epochs (lo, hi], or None when
+        the chain has a gap / an unknowable epoch."""
+        if lo == hi:
+            return np.empty(0, np.int64)
+        j = p["avail_journal"]
+        out = []
+        for e in range(lo + 1, hi + 1):
+            rows = j.get(e)
+            if rows is None:
+                return None
+            out.append(rows)
+        return np.unique(np.concatenate(out).astype(np.int64))
+
+    def _pool_full_base(self, p, slot, base, base_device):
+        """The full committed base, on `slot`, for a whole-window pooled
+        solve — via the slot's delta-synced availability MIRROR (ISSUE
+        15, the PR 11 statics epoch-journal pattern extended to
+        availability). The canonical base lives on one device; a
+        dispatch landing elsewhere used to re-ship the whole [N,3] — now
+        a slot holding a replica whose missed epochs are all journaled
+        catches up by scattering just the union of changed rows.
+
+        Donation invariant: the returned array is consumed by the solve,
+        so it must have no other referent. The canonical buffer is never
+        returned to a non-owner slot (they get a caught-up replica or a
+        fresh copy), and when the canonical migrates, the OLD buffer is
+        handed to the slot hosting it as that slot's mirror — p["avail"]
+        stops referencing it, so it is never donated again."""
+        tel = self.telemetry
+        if slot.is_mesh:
+            return slot.place_avail(base)
+        token, epoch = p["token"], p["avail_epoch"]
+        if base_device == slot.placement:
+            # Canonical already lives here; the solve donates it in
+            # place. Clear any stale replica — it must never alias the
+            # canonical, and after this solve the slot's state IS the
+            # new canonical.
+            slot.avail = None
+            slot.avail_epoch = -1
+            slot.mirror["reuse"] += 1
+            return base
+        # The canonical migrates to `slot`: hand the old buffer to the
+        # slot that hosts it as ITS mirror (it will catch up by scatter
+        # when the canonical comes back around).
+        for o in self._pool.slots:
+            if not o.is_mesh and o.placement == base_device:
+                o.avail = base
+                o.avail_epoch = epoch
+                o.avail_token = token
+                break
+        rep = slot.avail
+        rows = None
+        if (
+            rep is not None
+            and slot.avail_token == token
+            and 0 <= slot.avail_epoch <= epoch
+            and getattr(rep, "shape", None) == getattr(base, "shape", None)
+        ):
+            rows = self._journal_rows_between(p, slot.avail_epoch, epoch)
+        slot.avail = None
+        slot.avail_epoch = -1
+        if rows is not None:
+            if not rows.size:
+                slot.mirror["reuse"] += 1
+                return rep
+            idx = np.resize(rows, _bucket(len(rows), 16)).astype(np.int32)
+            vals = _take_rows(base, jax.device_put(idx, base_device))
+            out = _scatter_rows(
+                rep,
+                slot._put(idx),
+                jax.device_put(vals, slot.placement),
+            )
+            nbytes = idx.nbytes + int(getattr(vals, "nbytes", 0))
+            slot.mirror["catchup"] += 1
+            slot.mirror["delta_rows"] += int(rows.size)
+            if tel is not None:
+                tel.on_device_mirror(
+                    slot.label, "catchup", int(rows.size), nbytes
+                )
+            return out
+        slot.mirror["dense"] += 1
+        if tel is not None:
+            tel.on_device_mirror(
+                slot.label, "dense", int(base.shape[0]),
+                int(getattr(base, "nbytes", 0)),
+            )
+        return slot.place_avail(base)
 
     def _plan_static_delta(self, prev, host):
         """(changed field names, dirty rows) when the static drift between
         two same-shape host views is small enough to ship as a row
         scatter; None sends the caller to the full-upload/drain path.
-        Called only when at least one static field differs."""
+        Called only when at least one static field differs.
+
+        When the resident build NAMED its changed rows
+        (`_last_build_rows`), the diff runs over just those rows: the
+        statics copy-on-write only ever rewrites the named patch rows, so
+        they are a proven superset of every field difference — the
+        8-field O(N) compare per node event becomes O(changed) at the
+        million-node tier (ISSUE 15). A build that could not name its
+        rows keeps the dense diff."""
         n = host.available.shape[0]
-        rows_mask = np.zeros(n, dtype=bool)
+        acc = self._static_acc
+        cand = None
+        if acc is not None:
+            cand = (
+                np.unique(np.concatenate(acc)).astype(np.int64)
+                if acc
+                else np.empty(0, np.int64)
+            )
+            cand = cand[cand < n]
+            if not cand.size:
+                # A field differs but no build named a row since the
+                # last sync: inconsistent — take the dense diff.
+                cand = None
         changed: list[str] = []
+        sel = cand if cand is not None else slice(None)
+        rows_mask = np.zeros(
+            cand.shape[0] if cand is not None else n, dtype=bool
+        )
         for f in _STATIC_FIELDS:
             a = np.asarray(getattr(prev, f))
             b = np.asarray(getattr(host, f))
             if a is b:
                 continue
-            neq = a != b
+            neq = a[sel] != b[sel]
             if neq.ndim == 2:
                 neq = neq.any(axis=1)
             if not neq.any():
@@ -2226,7 +2562,10 @@ class PlacementSolver:
             rows_mask |= neq
         if not changed:
             return None
-        rows = np.flatnonzero(rows_mask)
+        rows = (
+            cand[rows_mask] if cand is not None
+            else np.flatnonzero(rows_mask)
+        )
         if len(rows) > max(32, n // 8):
             return None
         return changed, rows
@@ -2267,13 +2606,14 @@ class PlacementSolver:
             # flips) feed the planner as STATIC dirt: a kept row's static
             # flip re-scans its zone, a new row merges exactly.
             self._planner.note_static(rows)
-        cache = self._prune_gather_cache
-        if cache is not None and np.isin(rows, cache["keep"]).any():
-            # The cached statics sub-blob gathered rows that just
-            # changed: drop it (the kept set itself usually changes too,
+        for ck, ent in list(self._prune_gather_cache.items()):
+            # A cached statics sub-blob gathered rows that just changed:
+            # drop that entry (the kept set itself usually changes too,
             # but a static flip on a kept row with an unchanged keep must
-            # still force a re-gather).
-            self._prune_gather_cache = None
+            # still force a re-gather). Entries whose kept rows the delta
+            # missed keep serving.
+            if np.isin(rows, ent["keep"]).any():
+                self._prune_gather_cache.pop(ck, None)
         return out
 
     def _resolve_base(self, p) -> bool:
@@ -2470,6 +2810,18 @@ class PlacementSolver:
                 [i for i in idxs if i is not None and i < pad]
             ] = True
         if cacheable:
+            if (
+                cached is not None
+                and cached[1].shape[0] == pad
+                and np.array_equal(cached[1], request_mask)
+            ):
+                # Topology moved but membership did not (the routine
+                # node-UPDATE case): keep the OLD array object — mask
+                # identity is what keeps valid_req, the domain-AND memo
+                # and the planner's per-domain contexts stable across
+                # events (ISSUE 15). One O(N) bool compare per node
+                # event, never per window.
+                request_mask = cached[1]
             self._topo_request_mask = (
                 (topo, pad, len(nodes)), request_mask,
             )
@@ -2506,6 +2858,21 @@ class PlacementSolver:
             np.unique(np.concatenate(orows)),
             np.unique(np.concatenate(nrows)),
         )
+
+    def _acc_build_rows(self) -> None:
+        """Fold the build's named rows into the statics-delta candidate
+        accumulator (None = a build could not name rows: the next
+        _plan_static_delta falls back to the dense field diff)."""
+        rows = self._last_build_rows
+        if rows is None:
+            self._static_acc = None
+            return
+        if self._static_acc is None:
+            return
+        if rows[0].size:
+            self._static_acc.append(rows[0])
+        if rows[1].size:
+            self._static_acc.append(rows[1])
 
     def _note_consumer_rows(self, rows) -> None:
         """Rows the resident build just patched, appended to the device
@@ -2615,6 +2982,7 @@ class PlacementSolver:
         )
         valid_req = fields["valid"].view(np.bool_) & request_mask
         self._last_build_rows = None
+        self._acc_build_rows()
         self._note_consumers_unknown()
         if serving:
             self._snap_res = res = {
@@ -2709,15 +3077,23 @@ class PlacementSolver:
             res["mask"] = mask
             res["valid_req"] = f["valid"].view(np.bool_) & mask
         elif nrows.size:
-            vr = res["valid_req"].copy()
-            vr[nrows] = f["valid"].view(np.bool_)[nrows] & mask[nrows]
-            res["valid_req"] = vr
+            vals = f["valid"].view(np.bool_)[nrows] & mask[nrows]
+            if not np.array_equal(vals, res["valid_req"][nrows]):
+                # COW only when the valid mask actually moved: a static
+                # flip that leaves validity intact (unschedulable,
+                # labels) keeps the valid_req OBJECT stable — identity
+                # the domain-AND memo and the planner's per-domain
+                # contexts key on (ISSUE 15).
+                vr = res["valid_req"].copy()
+                vr[nrows] = vals
+                res["valid_req"] = vr
         # Planner feed classes: overhead rows change AVAILABILITY keys
         # (avail = alloc - usage - overhead), node rows are static dirt.
         self._last_build_rows = (
             np.union1d(arows, orows) if orows.size else arows,
             nrows,
         )
+        self._acc_build_rows()
         self.build_stats["incremental_builds"] += 1
         return self._res_tensors(res)
 
@@ -2958,7 +3334,23 @@ class PlacementSolver:
         ops = self.registry.journal_between(e0, epoch)
         if ops is None or len(ops) > 4096:
             return None
-        mask = mask0.copy()
+        # Copy-on-WRITE, not copy-on-patch: when no op actually flips a
+        # bit (the overwhelmingly common case — a node event elsewhere in
+        # the roster bumped the epoch, this domain's membership is
+        # untouched), the ORIGINAL mask object re-caches under the new
+        # epoch. Mask identity is load-bearing (ISSUE 15): the domain-AND
+        # memo and the planner's per-domain plan contexts key on it, so
+        # an unrelated node ADD must not cold-restart every partition's
+        # planning context.
+        mask = mask0
+        writable = False
+
+        def _w():
+            nonlocal mask, writable
+            if not writable:
+                mask = mask0.copy()
+                writable = True
+
         unresolved = set(unresolved0)
         removed = set(removed0)
         for op, nm, row in ops:
@@ -2967,12 +3359,15 @@ class PlacementSolver:
                 removed.discard(nm)
                 unresolved.discard(nm)
                 if row < n:
-                    mask[row] = member
+                    if bool(mask[row]) != member:
+                        _w()
+                        mask[row] = member
                 elif member:
                     return None  # member beyond the pad: rebuild
             else:  # remove
                 if row < n and mask[row]:
                     removed.add(nm)
+                    _w()
                     mask[row] = False
         # Membership deltas, oldest ticket first (each delta is relative
         # to its immediate base's content).
@@ -2980,7 +3375,8 @@ class PlacementSolver:
         for tk in reversed(lineage):
             for nm in tk.patch_removed:
                 row = index_of(nm)
-                if row is not None and row < n:
+                if row is not None and row < n and mask[row]:
+                    _w()
                     mask[row] = False
                 unresolved.discard(nm)
                 removed.discard(nm)
@@ -2990,11 +3386,29 @@ class PlacementSolver:
                 if row is None:
                     unresolved.add(nm)
                 elif row < n:
-                    mask[row] = True
+                    if not mask[row]:
+                        _w()
+                        mask[row] = True
                 else:
                     return None
-        mask.flags.writeable = False
+        if writable:
+            mask.flags.writeable = False
         return mask, unresolved, removed
+
+    def _and_valid(self, mask: np.ndarray, valid_np: np.ndarray) -> np.ndarray:
+        """Memoized `mask & valid` for window domains. Identity-keyed with
+        both operands pinned alive by the entry (id-recycle-safe): while
+        neither the candidate mask nor the valid mask changed object, the
+        SAME result object returns — which both skips the O(N) AND per
+        window and keys the planner's per-domain context reuse."""
+        key = (id(mask), id(valid_np))
+        hit = self._dom_and_memo.get(key)
+        if hit is not None and hit[0] is mask and hit[1] is valid_np:
+            return hit[2]
+        out = mask & valid_np
+        out.flags.writeable = False
+        self._dom_and_memo.put(key, (mask, valid_np, out))
+        return out
 
     def _num_zones_bucket(self) -> int:
         return _bucket(max(len(self.registry._zone_names), 1), 2)
@@ -3212,7 +3626,9 @@ class PlacementSolver:
                     key = ("id", id(dom_names))
                 dom = dom_memo.get(key)
                 if dom is None:
-                    dom = self.candidate_mask(tensors, dom_names) & valid_np
+                    dom = self._and_valid(
+                        self.candidate_mask(tensors, dom_names), valid_np
+                    )
                     dom_memo[key] = dom
             else:
                 dom = valid_np
@@ -3609,20 +4025,26 @@ class PlacementSolver:
         # bugfix): an unchanged kept row set (the planner re-served the
         # SAME keep array) whose gathered rows saw no static row-delta
         # re-serves the host gather AND the resident device sub-blob —
-        # zero host-array touches, zero re-upload. The cache is dropped by
+        # zero host-array touches, zero re-upload. Entries drop via
         # _apply_static_delta (rows ∩ keep), full uploads, and close().
-        cache = self._prune_gather_cache
-        gather_reused = (
-            cache is not None
-            and plan.reused
-            and cache["keep"] is keep
-        )
+        ent = self._prune_gather_entry(host, plan)
+        statics_np = ent["statics_np"]
+        gather_reused = "statics_dev" in ent
         if gather_reused:
-            statics_np = cache["statics_np"]
             self.prune_stats["gather_reuse"] += 1
-        else:
-            statics_np = _gather_statics_host(host, keep, plan.k_real)
-        cand_sub = np.stack([c[keep] for c in cand_rows])
+        # Per-request candidate gathers deduped by mask identity: serving
+        # requests overwhelmingly share ONE candidate ticket, so a
+        # 16-wide window pays one [K] gather from the [N] mask instead of
+        # B_rows of them (ISSUE 15 tentpole (d)).
+        cand_memo: dict[int, np.ndarray] = {}
+        cand_subs = []
+        for c in cand_rows:
+            s = cand_memo.get(id(c))
+            if s is None:
+                s = c[keep]
+                cand_memo[id(c)] = s
+            cand_subs.append(s)
+        cand_sub = np.stack(cand_subs)
         dom_sub = np.broadcast_to(
             np.asarray(dom_shared)[keep], (b, len(keep))
         )
@@ -3634,19 +4056,15 @@ class PlacementSolver:
             ):
                 _shim("h2d")
                 if gather_reused:
-                    idx_dev = cache["idx_dev"]
-                    statics_dev = cache["statics_dev"]
+                    idx_dev = ent["idx_dev"]
+                    statics_dev = ent["statics_dev"]
                 else:
                     idx_dev = jnp.asarray(keep)
                     statics_dev = tuple(
                         jax.device_put(f) for f in statics_np
                     )
-                    self._prune_gather_cache = {
-                        "keep": keep,
-                        "statics_np": statics_np,
-                        "statics_dev": statics_dev,
-                        "idx_dev": idx_dev,
-                    }
+                    ent["idx_dev"] = idx_dev
+                    ent["statics_dev"] = statics_dev
                 sub_avail = _take_rows(p["avail"], idx_dev)
                 zone_base_dev = tuple(
                     jnp.asarray(a) for a in plan.zone_base
@@ -3838,17 +4256,20 @@ class PlacementSolver:
             handle.row_skippable, base_loc, placements_loc,
             sched_kept, row_map=gmap,
         )
-        n_rows = host_avail32.shape[0]
-        placements = np.zeros((n_rows, host_avail32.shape[1]), np.int64)
-        np.add.at(placements, gmap, placements_loc)
-        prows = np.unique(gmap[placements_loc.any(axis=1)])
-        handle.placements = placements
+        # Sparse committed placements: the dense [N,3] tensor (a 24 MB
+        # calloc per window at 1M) is never materialized — later windows
+        # subtract priors through (placement_rows, placement_vals), and
+        # the rare dense consumers reconstruct on demand (ISSUE 15).
+        loc_rows = np.flatnonzero(placements_loc.any(axis=1))
+        prows = gmap[loc_rows]  # keep's real part is sorted: prows too
+        pvals = placements_loc[loc_rows]
         handle.placement_rows = prows
+        handle.placement_vals = pvals
         p = self._pipe
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
             if prows.size:
-                p["mirror"][prows] -= placements[prows]
+                p["mirror"][prows] -= pvals
                 if p.get("pending") is not None:
                     # Debited rows differ from the host view until the
                     # reservations write back: the mirror sync must keep
@@ -4041,7 +4462,10 @@ class PlacementSolver:
         tel = self.telemetry
         compiles_before = tel.compile_count() if tel is not None else None
         num_zones = self._num_zones_bucket()
-        solve_pool = _shared_solve_pool()
+        # Sized to the device pool (ISSUE 15 satellite): two workers per
+        # slot keeps the upload/solve double-buffer engaged at pipeline
+        # depth 2; a 1-slot mesh solver gets 2 workers, not 8.
+        solve_pool = _shared_solve_pool(min(8, 2 * len(pool.slots)))
         now = self._clock()
 
         # Quarantine gate: probe any quarantined slot whose interval
@@ -4086,6 +4510,11 @@ class PlacementSolver:
         base_device = next(iter(base.devices()))
         request_device: list = [None] * len(requests)
         parts: list[_WindowPart] = []
+        # Dispatch-time host availability reference (int32, NOT a copy):
+        # gathered parts capture their [k,3] base from it below, and the
+        # rare dense paths reconstruct via the undo journal — the per
+        # -window [N,3] int64 host_avail copy is gone (ISSUE 15).
+        havail32 = np.asarray(host.available)
 
         # Candidate pruning on the pooled engine: each partition (or the
         # whole window when it does not partition, provided its requests
@@ -4164,22 +4593,43 @@ class PlacementSolver:
                     host, epoch, self._clock, tel,
                     journal=self._static_journal,
                 )
-                sub_avail = slot.place_avail(base)
+                # Whole-window base via the slot's delta-synced
+                # availability mirror (ISSUE 15): a lagging slot catches
+                # up by row-scatter when its missed epochs are journaled.
+                sub_avail = self._pool_full_base(p, slot, base, base_device)
             elif prune_plan is not None:
-                # Fresh per-window upload of the small gathered statics:
-                # the keep set tracks availability, so the sub-replica
-                # cache could never hit (and a key-less hit would serve a
-                # different window's rows).
-                statics_np = _gather_statics_host(
-                    host, idx, prune_plan.k_real
-                )
-                statics = tuple(slot._put(f) for f in statics_np)
-                if tel is not None:
-                    tel.on_device_upload(
-                        slot.label, "full",
-                        sum(f.nbytes for f in statics_np),
-                    )
+                # Per-partition statics-gather reuse (ISSUE 15 tentpole
+                # (b)): the planner's per-domain contexts re-serve the
+                # SAME keep array across windows, so the gathered
+                # sub-blob caches host-side per keep identity and
+                # device-side per (keep, generation) on the slot — a
+                # reused plan pays zero host gather and zero re-upload.
+                t_gather = self._clock()
+                ent = self._prune_gather_entry(host, prune_plan)
+                skey = ("prune", id(prune_plan.keep))
+                cached = slot.sub_statics.get(skey)
+                if cached is not None and cached[0] == ent["gen"]:
+                    statics = cached[1]
+                    slot.uploads["reuse"] += 1
+                    self.prune_stats["gather_reuse"] += 1
+                    if tel is not None:
+                        tel.on_device_upload(slot.label, "reuse", 0)
+                        tel.on_prune_gather_reuse()
+                else:
+                    statics = tuple(slot._put(f) for f in ent["statics_np"])
+                    if len(slot.sub_statics) >= 64:
+                        slot.sub_statics.clear()
+                    slot.sub_statics[skey] = (ent["gen"], statics)
+                    slot.uploads["full"] += 1
+                    if tel is not None:
+                        tel.on_device_upload(
+                            slot.label, "full",
+                            sum(f.nbytes for f in ent["statics_np"]),
+                        )
                 sub_avail = slot.place_avail(_take_rows(base, jnp.asarray(idx)))
+                self.prune_stats["gather_ms"] += (
+                    self._clock() - t_gather
+                ) * 1e3
             else:
                 statics = slot.sub_replica(
                     host, idx_key, idx, epoch, self._clock, tel
@@ -4263,8 +4713,16 @@ class PlacementSolver:
                 row_exc=exc_g.astype(np.int64),
                 row_skip=skip_g, idx=idx, slot=slot, rows=b_g,
                 idx_key=idx_key, apps=apps_host, prune=prune_plan,
+                # Compact-fetch base: the part's rows' availability at
+                # dispatch (the resident buffer mutates afterwards).
+                base_kept=(
+                    havail32[idx].astype(np.int64)
+                    if idx is not None
+                    else None
+                ),
             )
 
+        note_epoch = None
         try:
             with tracer().span(
                 "solve-dispatch", strategy=strategy, nodes=n,
@@ -4293,10 +4751,18 @@ class PlacementSolver:
                                 ),
                             )
                         )
+                        # Commits land on kept rows only: journal them so
+                        # lagging slot mirrors catch up by scatter.
+                        self._avail_journal_note(p, head.idx)
                     else:
                         p["avail"] = _PendingBase(
                             lambda: head.after_future.result()
                         )
+                        # Unpruned whole window: the commit rows are
+                        # unknowable at dispatch — mirrors crossing this
+                        # epoch must full re-ship until the fetch patches
+                        # the entry with the exact rows.
+                        note_epoch = self._avail_journal_note(p, None)
                 else:
                     for key, req_ids in plan:
                         idx = np.flatnonzero(
@@ -4330,6 +4796,11 @@ class PlacementSolver:
                         return out
 
                     p["avail"] = _PendingBase(combine)
+                    # Partition scatters touch exactly the partitions'
+                    # gathered rows (pruned parts: their kept rows).
+                    self._avail_journal_note(
+                        p, np.concatenate([pt.idx for pt in parts])
+                    )
         except Exception as exc:
             if not classify_slot_failure(exc):
                 raise
@@ -4387,11 +4858,18 @@ class PlacementSolver:
             blob=None,
             requests=tuple(requests),
             flat_rows=[],
-            host_avail=np.array(np.asarray(host.available), dtype=np.int64),
+            # No dense dispatch-time copy (ISSUE 15): gathered parts
+            # carry their [k,3] base; the rare dense paths reconstruct
+            # via host_avail32 + the availability undo journal.
+            host_avail=None,
             host_schedulable=np.asarray(host.schedulable),
             priors=tuple(p["unfetched"]),
             n=n,
         )
+        handle.host_avail32 = havail32
+        handle.avail_gen = self._avail_gen
+        handle.avail_note_epoch = note_epoch
+        self._avail_handles.add(handle)
         handle.parts = parts
         handle.request_device = request_device
         handle.host_tensors = host  # slot-failure re-dispatch inputs
@@ -4494,12 +4972,22 @@ class PlacementSolver:
         # host later fails to create one of these reservations, its usage
         # never reaches the host view and the next delta restores the gang's
         # capacity on device automatically (self-correcting drift).
+        # The debit is SPARSE (ISSUE 15): the committed rows are read
+        # straight off the decision blob — exactly the support of
+        # `placements` — so the mirror subtracts O(placed) rows, the
+        # pending ledger stays exact (no dense compare next build), and
+        # the planner absorbs the rows instead of a snapshot diff.
+        prows = self._commit_rows(handle.requests, drivers, admitted, execs)
+        handle.placement_rows = prows
+        handle.placement_vals = placements[prows]
         p = self._pipe
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
-            p["mirror"] -= placements
-            p["pending"] = None  # dense debit: rows unknown to the ledger
-        self._prune_mark_unknown()
+            if prows.size:
+                p["mirror"][prows] -= placements[prows]
+                if p.get("pending") is not None:
+                    p["pending"].append(prows)
+        self._prune_note_rows(prows)
         self._note_dispatch_complete(handle)
         self._device_recovered()
         return decisions
@@ -4519,17 +5007,64 @@ class PlacementSolver:
     def _fetch_pooled(self, handle: "WindowHandle") -> list[WindowDecision]:
         """Fetch + reconstruct a pooled (possibly partitioned) window.
 
-        Partitions reconstruct against the SHARED global base in part
-        order — their committed rows are pairwise disjoint, so any order
-        yields the serialized window's exact base — and the decisions
-        reassemble into original request order."""
+        Partitions are row-disjoint, so any completion order yields the
+        serialized window's exact base. GATHERED parts (domain partitions
+        and pruned top-K parts) reconstruct in COMPACT part-local space
+        against the [k,3] base captured at dispatch and accumulate their
+        committed placements SPARSELY: the mirror debit then scatters
+        exactly the union of partition debit rows, the pending ledger
+        stays exact, and `mirror_dense_syncs` pins to 0 on the pooled
+        path (ISSUE 15 — nothing here touches an [N]-wide array). Only
+        an unpartitioned UNPRUNED window (idx None, a single part by
+        construction) pays the dense reconstruction; escalations and
+        greedy fallbacks materialize the dense base lazily.
+        """
         from spark_scheduler_tpu.tracing import tracer
 
         requests, n = handle.requests, handle.n
         tel = self.telemetry
         results: list = [None] * len(requests)
-        base = self._dense_base(handle)
-        placements = np.zeros_like(base)
+        # Lenient prior union for the compact reconstructions (the
+        # dense-base semantics: an unknown prior contributes nothing).
+        lp_rows, lp_vals = self._collect_priors(handle, strict=False)
+        sp_rows: list[np.ndarray] = []
+        sp_vals: list[np.ndarray] = []
+        dense: dict = {"base": None, "placements": None}
+        # False once an escalation / greedy-fallback part contributed
+        # placements the sparse lists do not cover (those flows kill the
+        # pipeline, so the debit never runs — but later windows must then
+        # read the DENSE placements, not an incomplete sparse support).
+        support_complete = True
+
+        def dense_base() -> np.ndarray:
+            # Lazy dense view for the idx-None part / escalations /
+            # greedy fallbacks: dispatch-time reconstruction minus the
+            # placements already committed by earlier compact parts —
+            # which must ALSO back-fill the dense placements tensor
+            # (support_complete=False publishes it as the handle's
+            # placements; later in-flight windows subtract it as a
+            # prior, and missing the earlier partitions' commits would
+            # let their re-solves double-book those rows).
+            if dense["base"] is None:
+                b = self._dense_base(handle)
+                pl = np.zeros_like(b)
+                for r_, v_ in zip(sp_rows, sp_vals):
+                    if r_.size:
+                        b[r_] -= v_
+                        pl[r_] += v_
+                dense["base"] = b
+                dense["placements"] = pl
+            return dense["base"]
+
+        def commit_sparse(rows, vals) -> None:
+            sp_rows.append(rows)
+            sp_vals.append(vals)
+            if dense["base"] is not None and rows.size:
+                dense["base"][rows] -= vals
+                dense["placements"][rows] += vals
+
+        strict_ps = None
+        strict_known = False
         with tracer().span(
             "solve", strategy=handle.strategy, nodes=n,
             window_requests=len(requests), batched=True,
@@ -4581,7 +5116,9 @@ class PlacementSolver:
                         tel.on_pipeline_event("fetch-failure")
                     self._quarantine_slot(part.slot, exc)
                     try:
-                        recovered = self._redispatch_part(handle, part, base)
+                        recovered = self._redispatch_part(
+                            handle, part, dense_base()
+                        )
                     except Exception:
                         for pt in handle.parts[part_i + 1:]:
                             pt.slot.inflight = max(0, pt.slot.inflight - 1)
@@ -4592,10 +5129,12 @@ class PlacementSolver:
                         raise
                     if isinstance(recovered, tuple):
                         # Greedy-fallback decisions for this part: apply
-                        # its placements to the shared base and move on.
+                        # its placements to the dense base and move on.
                         decs, ppl = recovered
-                        base -= ppl
-                        placements += ppl
+                        dense_base()
+                        dense["base"] -= ppl
+                        dense["placements"] += ppl
+                        support_complete = False
                         for rid, d in zip(part.req_ids, decs):
                             results[rid] = d
                         continue
@@ -4611,46 +5150,77 @@ class PlacementSolver:
                         out["solve_ms"], out["fetch_ms"],
                         inflight=part.slot.inflight,
                     )
-                drivers = blob[:, 0].astype(np.int64)
+                drivers_l = blob[:, 0].astype(np.int64)
                 admitted = blob[:, 1].astype(bool)
                 packed = blob[:, 2].astype(bool)
-                execs = blob[:, 3:].astype(np.int64)
-                if part.idx is not None:
-                    # Sub-cluster solve: map local node indices back to
-                    # the global index space (-1 stays -1).
-                    gmap = part.idx.astype(np.int64)
-                    drivers = np.where(
-                        drivers >= 0, gmap[np.clip(drivers, 0, None)], -1
+                execs_l = blob[:, 3:].astype(np.int64)
+                if part.idx is None:
+                    # Dense whole-window path: indices are global, the
+                    # reconstruction threads the dense base — exactly the
+                    # single-device unpruned fetch, with the debit rows
+                    # still read sparsely off the blob. (A whole window
+                    # has exactly ONE part, so the pre-recon placements
+                    # tensor holds no other part's commits and the prows
+                    # capture below is this part's alone.)
+                    base_d = dense_base()
+                    decisions = self._reconstruct_requests(
+                        part.requests, drivers_l, admitted, packed,
+                        execs_l, part.row_drv, part.row_exc,
+                        part.row_skip, base_d, dense["placements"],
+                        handle.host_schedulable,
                     )
-                    execs = np.where(
-                        execs >= 0, gmap[np.clip(execs, 0, None)], -1
+                    prows = self._commit_rows(
+                        part.requests, drivers_l, admitted, execs_l
                     )
+                    sp_rows.append(prows)
+                    sp_vals.append(dense["placements"][prows].copy())
+                    for rid, d in zip(part.req_ids, decisions):
+                        results[rid] = d
+                    continue
+                gmap = part.idx.astype(np.int64)
                 if part.prune is not None:
-                    # Two-tier certificate, per partition. Partitions are
-                    # domain-disjoint, so `base` at this point still holds
-                    # THIS part's domain rows at their dispatch values —
-                    # earlier parts only touched their own domains.
+                    # Two-tier certificate, per partition, in compact
+                    # space: the [k,3] base captured at dispatch minus
+                    # the (strict) prior deltas on this part's kept rows.
                     from spark_scheduler_tpu.core.prune import (
                         certify_window,
                     )
 
-                    ps = self._prior_sparse(handle)
-                    if ps is None:
-                        cert_ok, reason = False, "prior-unknown"
+                    if not strict_known:
+                        strict_ps = self._prior_sparse(handle)
+                        strict_known = True
+                    k_real = part.prune.k_real
+                    keep_real = part.prune.keep[:k_real]
+                    if strict_ps is None:
+                        cert_ok, reason, bk = False, "prior-unknown", None
                     else:
-                        prior_rows, prior_deltas = ps
-                        keep_real = part.prune.keep[: part.prune.k_real]
+                        prior_rows, prior_deltas = strict_ps
+                        bk = part.base_kept[:k_real].copy()
+                        if prior_rows.size:
+                            loc = np.searchsorted(keep_real, prior_rows)
+                            locc = np.clip(loc, 0, keep_real.size - 1)
+                            on_kept = keep_real[locc] == prior_rows
+                            if on_kept.any():
+                                bk[locc[on_kept]] -= prior_deltas[on_kept]
+                        drv_g = np.where(
+                            drivers_l >= 0,
+                            gmap[np.clip(drivers_l, 0, None)], -1,
+                        )
+                        exc_g = np.where(
+                            execs_l >= 0,
+                            gmap[np.clip(execs_l, 0, None)], -1,
+                        )
                         cert_ok, reason = certify_window(
                             part.prune,
                             strategy=handle.strategy,
                             requests=part.requests,
-                            drivers=drivers,
+                            drivers=drv_g,
                             admitted=admitted,
                             packed=packed,
-                            execs=execs,
+                            execs=exc_g,
                             drv64=part.row_drv,
                             exc64=part.row_exc,
-                            base_kept=base[keep_real].copy(),
+                            base_kept=bk.copy(),  # certify threads commits
                             host=handle.host_tensors,
                             prior_rows=prior_rows,
                             prior_deltas=prior_deltas,
@@ -4661,29 +5231,101 @@ class PlacementSolver:
                         # row-disjoint and stand), then invalidate the
                         # poisoned carry and the windows dispatched on it.
                         decs, ppl = self._escalation_decisions(
-                            handle.strategy, handle.host_tensors, base,
-                            part.requests,
+                            handle.strategy, handle.host_tensors,
+                            dense_base(), part.requests,
                         )
-                        base -= ppl
-                        placements += ppl
+                        dense["base"] -= ppl
+                        dense["placements"] += ppl
+                        support_complete = False
                         for rid, d in zip(part.req_ids, decs):
                             results[rid] = d
                         self._note_prune_escalation(handle, reason)
                         continue
+                    kp = gmap.shape[0]
+                    base_loc = np.zeros(
+                        (kp, part.base_kept.shape[1]), np.int64
+                    )
+                    base_loc[:k_real] = bk
+                    placements_loc = np.zeros_like(base_loc)
+                    sched_loc = np.asarray(handle.host_schedulable)[
+                        part.idx
+                    ]
+                    decisions = self._reconstruct_requests(
+                        part.requests, drivers_l, admitted, packed,
+                        execs_l, part.row_drv, part.row_exc,
+                        part.row_skip, base_loc, placements_loc,
+                        sched_loc, row_map=gmap,
+                    )
+                    loc = np.flatnonzero(placements_loc.any(axis=1))
+                    commit_sparse(gmap[loc], placements_loc[loc])
+                    for rid, d in zip(part.req_ids, decisions):
+                        results[rid] = d
+                    continue
+                # Unpruned gathered partition: compact reconstruction in
+                # the part's local row space (lenient priors — the
+                # dense-base semantics).
+                bk = part.base_kept.copy()
+                if lp_rows.size:
+                    loc = np.searchsorted(gmap, lp_rows)
+                    locc = np.clip(loc, 0, gmap.size - 1)
+                    on = gmap[locc] == lp_rows
+                    if on.any():
+                        bk[locc[on]] -= lp_vals[on]
+                placements_loc = np.zeros_like(bk)
+                sched_loc = np.asarray(handle.host_schedulable)[part.idx]
                 decisions = self._reconstruct_requests(
-                    part.requests, drivers, admitted, packed, execs,
+                    part.requests, drivers_l, admitted, packed, execs_l,
                     part.row_drv, part.row_exc, part.row_skip,
-                    base, placements, handle.host_schedulable,
+                    bk, placements_loc, sched_loc, row_map=gmap,
                 )
+                loc = np.flatnonzero(placements_loc.any(axis=1))
+                commit_sparse(gmap[loc], placements_loc[loc])
                 for rid, d in zip(part.req_ids, decisions):
                     results[rid] = d
-        handle.placements = placements
+        # Combined sparse support of this window's committed placements.
+        if sp_rows:
+            allr = np.concatenate(sp_rows)
+        else:
+            allr = np.empty(0, np.int64)
+        if allr.size:
+            allv = np.concatenate(sp_vals)
+            uniq, inv = np.unique(allr, return_inverse=True)
+            vals = np.zeros((uniq.size, allv.shape[1]), np.int64)
+            np.add.at(vals, inv, allv)
+        else:
+            uniq = np.empty(0, np.int64)
+            vals = np.empty((0, NUM_DIMS), np.int64)
+        if dense["placements"] is not None:
+            handle.placements = dense["placements"]
+        if support_complete:
+            handle.placement_rows = uniq
+            handle.placement_vals = vals
         p = self._pipe
         if p is not None and handle in p["unfetched"]:
             p["unfetched"].remove(handle)
-            p["mirror"] -= placements
-            p["pending"] = None  # dense debit: rows unknown to the ledger
-        self._prune_mark_unknown()
+            if uniq.size:
+                # Sparse pooled debit (ISSUE 15 tentpole (a)): scatter
+                # exactly the union of partition debit rows into the
+                # mirror and the pending ledger — the next build compares
+                # just these instead of a dense [N] sweep.
+                p["mirror"][uniq] -= vals
+                if p.get("pending") is not None:
+                    p["pending"].append(uniq)
+                self.build_stats["pooled_debit_rows"] += int(uniq.size)
+            self._prune_note_rows(uniq)
+            ne = handle.avail_note_epoch
+            if (
+                ne is not None
+                and p.get("avail_journal", {}).get(ne, 0) is None
+            ):
+                # The dispatch journaled this epoch as unknowable; the
+                # fetch just learned the exact commit rows — patch the
+                # entry so slot mirrors can catch up across it.
+                p["avail_journal"][ne] = uniq
+        else:
+            # Pipeline died mid-fetch (escalation / slot failure): the
+            # next build full-uploads host truth; the planner resyncs.
+            self._prune_mark_unknown()
         self._note_dispatch_complete(handle)
         self._device_recovered()
         return results

@@ -148,6 +148,15 @@ class ZoneAggregates:
     def valid_of(self, rows: np.ndarray) -> np.ndarray:
         return self._valid[rows]
 
+    def mem_of(self, rows: np.ndarray) -> np.ndarray:
+        """Snapshot available-memory of `rows` — the OLD contribution a
+        per-domain total must subtract before applying the new state
+        (core/prune.py domain plan contexts)."""
+        return self._mem[rows]
+
+    def cpu_of(self, rows: np.ndarray) -> np.ndarray:
+        return self._cpu[rows]
+
     def stats(self) -> dict:
         return {
             "rebuilds": self.rebuilds,

@@ -162,11 +162,20 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
         # full resident-snapshot mix.
         build = getattr(solver, "build_stats", None)
         if build is not None and build.get("builds"):
+            # `pooled_debit_rows` rides along (ISSUE 15): the rows pooled
+            # fetches debited sparsely — the pooled path's O(placed)
+            # mirror-sync evidence next to `mirror_dense_syncs`.
             block = dict(build)
             block["build_ms_mean"] = round(
                 build["build_ms"] / max(int(build["builds"]), 1), 4
             )
             out["build"] = block
+        # Multi-device engine: per-slot upload mix + the delta-synced
+        # availability-mirror counters (ISSUE 15 — catchup/delta_rows/
+        # dense per slot).
+        pool_stats = solver.device_pool_stats()
+        if pool_stats:
+            out["device_pool"] = pool_stats
         scale = getattr(solver, "scale_tier_stats", None)
         if scale is not None and any(scale.values()):
             out["scale_tier"] = dict(scale)

@@ -36,6 +36,19 @@ DEVICE_FETCH_MS = "foundry.spark.scheduler.solver.device.fetch.ms"
 DEVICE_RESIDENT_AGE = (
     "foundry.spark.scheduler.solver.device.resident.age.seconds"
 )
+# Per-slot delta-synced availability mirrors (ISSUE 15): rows scattered
+# by delta catch-ups, full availability re-ships ("dense" syncs — the
+# number the pooled tier drives to 0 on pruned traffic), and catch-up
+# events, each tagged device=<label>.
+DEVICE_MIRROR_DELTA_ROWS = (
+    "foundry.spark.scheduler.solver.device.mirror.delta.rows"
+)
+DEVICE_MIRROR_DENSE_SYNCS = (
+    "foundry.spark.scheduler.solver.device.mirror.dense.syncs"
+)
+DEVICE_MIRROR_CATCHUP = (
+    "foundry.spark.scheduler.solver.device.mirror.catchup"
+)
 # Device-slot quarantine/recovery (ISSUE 9, core/solver.py _DevicePool):
 # events tagged event=quarantine|reinstate|redispatch|probe-failed and a
 # live count of quarantined slots.
@@ -248,6 +261,26 @@ class SolverTelemetry:
         """One resident-replica decision on a pool slot: kind is
         "full" (statics re-uploaded) or "reuse" (resident copy served)."""
         self.registry.counter(DEVICE_UPLOADS, device=device, kind=kind).inc()
+        if nbytes > 0:
+            self.on_transfer("h2d", nbytes)
+
+    def on_device_mirror(
+        self, device: str, kind: str, rows: int, nbytes: int = 0
+    ) -> None:
+        """One per-slot availability-mirror sync (ISSUE 15): "catchup" =
+        a lagging slot scattered `rows` journaled rows instead of taking
+        the full [N,3] base; "dense" = the full re-ship (no replica, a
+        journal gap, or an unknowable epoch in the chain)."""
+        if kind == "catchup":
+            self.registry.counter(DEVICE_MIRROR_CATCHUP, device=device).inc()
+            if rows:
+                self.registry.counter(
+                    DEVICE_MIRROR_DELTA_ROWS, device=device
+                ).inc(int(rows))
+        else:
+            self.registry.counter(
+                DEVICE_MIRROR_DENSE_SYNCS, device=device
+            ).inc()
         if nbytes > 0:
             self.on_transfer("h2d", nbytes)
 

@@ -127,14 +127,16 @@ def test_dirty_set_build_matches_dense_oracle_under_churn(pool, prune):
         b = _serve(h_dense, live_b, iter(range(start, start + 2)))
         assert a == b, f"step {step} ({ev_a}): {a} vs {b}"
     bs = h_dirty.app.solver.build_stats
-    if prune and pool == 1:
-        # The dirty-set sync actually served (the oracle checked it).
-        # Pooled fetches debit the mirror densely (their placements
-        # reassemble across partitions), so the pool arm legitimately
-        # rides the dense fallback — the equivalence above is the claim
-        # there.
+    if prune:
+        # The dirty-set sync actually served (the oracle checked it) —
+        # on the pool arms too: pooled fetches debit the mirror SPARSELY
+        # since ISSUE 15 (the union of partition debit rows rides the
+        # pending ledger), so every arm stays on the event-fed sync.
         assert bs["dirty_rows"] > 0, bs
         assert bs["oracle_checks"] > 0, bs
+    # The dirty twin never fell back to a dense [N] mirror sweep — the
+    # pooled arms included (ISSUE 15 tentpole (a)).
+    assert bs["mirror_dense_syncs"] == 0, bs
     # The dense twin never took the dirty path.
     assert h_dense.app.solver.build_stats["dirty_rows"] == 0
     h_dirty.app.stop()
@@ -339,3 +341,373 @@ def test_dense_fallback_on_journal_gap_is_exact():
         assert a == b, f"step {step}: {a} vs {b}"
     h_a.app.stop()
     h_b.app.stop()
+
+
+# -------------------------- pooled / partitioned serving (ISSUE 15) ----------
+
+
+def _mk_grouped(pool, prune, *, dirty: bool, n0: int):
+    """Harness with nodes split across TWO instance groups, so 2-request
+    cross-group windows PARTITION across the device pool."""
+    kw = dict(binpack_algo="tightly-pack", fifo=False)
+    if pool > 1:
+        kw["solver_device_pool"] = pool
+    if prune:
+        kw["solver_prune_top_k"] = prune
+        kw["solver_prune_slack"] = 0.75
+    h = Harness(**kw)
+    h.add_nodes(
+        *[
+            new_node(
+                f"n{i:03d}", zone=f"zone{i % 2}",
+                instance_group=f"ig{i % 2}",
+            )
+            for i in range(n0)
+        ]
+    )
+    if dirty:
+        h.app.solver.build_oracle = True
+    else:
+        h.app.extender.features.journal_enabled = False
+    return h
+
+
+def _serve_grouped(h, live, seq):
+    """One 2-request window with the requests pinned to DIFFERENT
+    instance groups — the pooled partition path."""
+    names = list(live)
+    drivers = []
+    for g in ("ig0", "ig1"):
+        d = static_allocation_spark_pods(
+            f"pgd-{next(seq)}", 2, instance_group=g
+        )[0]
+        h.add_pods(d)
+        drivers.append(d)
+    t = h.extender.predicate_window_dispatch(
+        [ExtenderArgs(pod=d, node_names=names) for d in drivers]
+    )
+    return [
+        tuple(r.node_names)
+        for r in h.extender.predicate_window_complete(t)
+    ]
+
+
+@pytest.mark.parametrize("prune", [0, 4])
+def test_pooled_partitioned_churn_zero_dense_mirror_syncs(prune):
+    """Pool-2 partitioned serving under node-update churn debits the
+    mirror SPARSELY (ISSUE 15 tentpole (a)): decisions bit-match the
+    dense twin, `mirror_dense_syncs` stays 0, the pending ledger carries
+    the partition debit rows, and (pruned arm) the per-domain plan
+    contexts re-serve kept sets and gathered statics per partition."""
+    n0 = 48
+    h_dirty = _mk_grouped(2, prune, dirty=True, n0=n0)
+    h_dense = _mk_grouped(2, prune, dirty=False, n0=n0)
+    live = [f"n{i:03d}" for i in range(n0)]
+    # Lockstep per-harness app-id sequences: both twins see identical
+    # pod names, and no id is ever reused within a twin.
+    seq_a = iter(range(100_000))
+    seq_b = iter(range(100_000))
+    rng_a = np.random.default_rng(4051)
+    rng_b = np.random.default_rng(4051)
+    # Warm: cold featurize + the per-domain cold sweeps.
+    for _ in range(2):
+        a = _serve_grouped(h_dirty, live, seq_a)
+        b = _serve_grouped(h_dense, live, seq_b)
+        assert a == b
+    st = h_dirty.app.solver.prune_stats
+    sweep_after_warm = st["planner_sweep_rows"]
+    for step in range(8):
+        for h, rng in ((h_dirty, rng_a), (h_dense, rng_b)):
+            name = live[int(rng.integers(0, len(live)))]
+            cur = h.backend.get_node(name)
+            h.backend.update(
+                "nodes",
+                dataclasses.replace(
+                    cur, unschedulable=not cur.unschedulable
+                ),
+            )
+        for _ in range(2):
+            a = _serve_grouped(h_dirty, live, seq_a)
+            b = _serve_grouped(h_dense, live, seq_b)
+            assert a == b, f"step {step}: {a} vs {b}"
+    bs = h_dirty.app.solver.build_stats
+    assert bs["mirror_dense_syncs"] == 0, bs
+    assert bs["pooled_debit_rows"] > 0, bs
+    paths = h_dirty.app.solver.window_path_counts
+    assert paths.get("pool", 0) > 0, paths
+    if prune:
+        # Per-partition plan/gather reuse engaged (tentpole (b)), and
+        # churn never re-paid a per-domain O(N) sweep after the cold
+        # context builds.
+        assert st["windows"] > 0, st
+        assert st["plan_reuse"] > 0, st
+        assert st["gather_reuse"] > 0, st
+        assert st["planner_sweep_rows"] == sweep_after_warm, st
+        assert st["escalations"] == 0, st
+    h_dirty.app.stop()
+    h_dense.app.stop()
+
+
+def test_pooled_slot_failure_redispatch_keeps_sparse_debits():
+    """A slot dying mid-burst re-dispatches its partition on the
+    survivor byte-identically (ISSUE 9 contract) — and the recovery
+    never downgrades the mirror sync to a dense sweep (ISSUE 15)."""
+    from spark_scheduler_tpu.faults import (
+        FaultInjector,
+        FaultPlan,
+        FaultSpec,
+    )
+
+    h = _mk_grouped(2, 4, dirty=True, n0=32)
+    h2 = _mk_grouped(2, 4, dirty=False, n0=32)
+    live = [f"n{i:03d}" for i in range(32)]
+    seq_a = iter(range(0, 1000))
+    seq_b = iter(range(0, 1000))
+    outs_a, outs_b = [], []
+    for _ in range(2):  # warm: 2 partitioned windows = 4 dispatch events
+        outs_a.append(_serve_grouped(h, live, seq_a))
+    # `at` indexes the surface's MATCHING events from injector install:
+    # the first faulted window's second partition solve dies mid-burst.
+    plan = FaultPlan(
+        seed=0, name="pool-slot-kill",
+        specs=[
+            FaultSpec(
+                surface="device.dispatch", mode="error", at=[1], limit=1
+            )
+        ],
+    )
+    with FaultInjector(plan) as inj:
+        inj.install_device()
+        for _ in range(2):
+            outs_a.append(_serve_grouped(h, live, seq_a))
+    outs_a.append(_serve_grouped(h, live, seq_a))
+    for _ in range(5):
+        outs_b.append(_serve_grouped(h2, live, seq_b))
+    assert outs_a == outs_b, "slot-failure recovery diverged"
+    assert h.app.solver.redispatch_count >= 1
+    bs = h.app.solver.build_stats
+    assert bs["mirror_dense_syncs"] == 0, bs
+    h.app.stop()
+    h2.app.stop()
+
+
+@pytest.mark.parametrize("blocker_node", ["n004", "n005"])
+def test_pooled_partition_escalation_interleaving_matches_dense(
+    blocker_node,
+):
+    """In-flight churn between a partitioned pooled window's dispatch
+    and fetch starves its certificate: the partition escalates to the
+    exact re-solve, decisions still bit-match the unpruned single-device
+    twin, and the mirror never dense-sweeps. Parametrized over the
+    blocker's instance group so BOTH part orders run — in particular the
+    second-part escalation, where the first partition's sparse commits
+    must back-fill the lazily-materialized dense placements (a later
+    in-flight window subtracts them as priors; regression for the
+    double-booking found in review)."""
+    from spark_scheduler_tpu.models.reservations import (
+        new_resource_reservation,
+    )
+    from spark_scheduler_tpu.models.resources import Resources
+
+    outs = {}
+    for mode in ("dirty", "dense"):
+        kw = dict(binpack_algo="tightly-pack", fifo=False)
+        if mode == "dirty":
+            kw.update(
+                solver_device_pool=2,
+                solver_prune_top_k=1,
+                solver_prune_slack=0.01,
+            )
+        h = Harness(**kw)
+        h.add_nodes(
+            *[
+                new_node(
+                    f"n{i:03d}", zone=f"zone{i % 2}",
+                    instance_group=f"ig{i % 2}",
+                )
+                for i in range(32)
+            ]
+        )
+        if mode == "dirty":
+            h.app.solver.build_oracle = True
+        live = [f"n{i:03d}" for i in range(32)]
+        seq = iter(range(100))
+        _serve_grouped(h, live, seq)  # warm
+        ext = h.extender
+        drivers = []
+        for g in ("ig0", "ig1"):
+            d = static_allocation_spark_pods(
+                f"pe-{mode}-{g}", 2, instance_group=g
+            )[0]
+            h.add_pods(d)
+            drivers.append(d)
+        t1 = ext.predicate_window_dispatch(
+            [ExtenderArgs(pod=d, node_names=list(live)) for d in drivers]
+        )
+        # External churn between t1's dispatch and its fetch.
+        blocker = static_allocation_spark_pods(f"pe-{mode}-blk", 1)[0]
+        h.backend.add_pod(blocker)
+        rr = new_resource_reservation(
+            blocker_node, [blocker_node], blocker,
+            Resources.from_quantities("2", "2Gi"),
+            Resources.from_quantities("1", "1Gi"),
+        )
+        h.app.rr_cache.create(rr)
+        r1 = [
+            tuple(r.node_names)
+            for r in ext.predicate_window_complete(t1)
+        ]
+        r2 = _serve_grouped(h, live, seq)
+        outs[mode] = (r1, r2)
+        if mode == "dirty":
+            assert h.app.solver.prune_stats["escalations"] > 0, (
+                h.app.solver.prune_stats
+            )
+            assert h.app.solver.build_stats["mirror_dense_syncs"] == 0
+        h.app.stop()
+    assert outs["dirty"] == outs["dense"], outs
+
+
+def test_pool_slot_mirror_catches_up_by_row_scatter():
+    """Per-slot availability mirrors (ISSUE 15): a whole-window pooled
+    dispatch landing on a LAGGING slot catches up by scattering the
+    journaled rows instead of re-shipping the full [N,3] base — and the
+    fetch patches an unknowable epoch with its exact commit rows so
+    later catch-ups can cross it. Solver-level (the mirror is device
+    machinery, independent of the host journal)."""
+    from spark_scheduler_tpu.core.solver import (
+        PlacementSolver,
+        WindowRequest,
+    )
+    from spark_scheduler_tpu.models.kube import Node, ZONE_LABEL
+    from spark_scheduler_tpu.models.resources import Resources
+
+    one = Resources.from_quantities("1", "1Gi")
+    nodes = [
+        Node(
+            name=f"m{i:03d}",
+            allocatable=Resources.from_quantities(
+                "8", "8Gi", "1", round_up=False
+            ),
+            labels={ZONE_LABEL: f"z{i % 2}"},
+        )
+        for i in range(32)
+    ]
+    names = [n.name for n in nodes]
+    rng = np.random.default_rng(3)
+    wins = [
+        [
+            WindowRequest(
+                rows=[(one, one, int(rng.integers(1, 3)), False)],
+                driver_candidate_names=names,
+            )
+            for _ in range(3)
+        ]
+        for _ in range(8)
+    ]
+
+    def run(solver):
+        res = []
+        for w in wins:
+            t = solver.build_tensors_pipelined(nodes, {}, {})
+            h = solver.pack_window_dispatch("tightly-pack", t, w)
+            res.extend(solver.pack_window_fetch(h))
+        return res
+
+    base = run(PlacementSolver(use_native=False))
+    pooled = PlacementSolver(use_native=False, device_pool=2)
+    assert run(pooled) == base, "pooled decisions diverged"
+    mirrors = {
+        k: v["mirror"] for k, v in pooled.device_pool_stats().items()
+    }
+    catchups = sum(m["catchup"] for m in mirrors.values())
+    delta_rows = sum(m["delta_rows"] for m in mirrors.values())
+    dense = sum(m["dense"] for m in mirrors.values())
+    assert catchups >= 1, mirrors
+    assert delta_rows >= 1, mirrors
+    # Only the cold first touch of a slot may pay the full re-ship.
+    assert dense <= 2, mirrors
+
+
+def test_boundary_add_inserts_into_kept_set_without_rescan():
+    """A node ADD whose key beats a zone's kept boundary is INSERTED
+    into the kept order in O(K) — the old K-th row evicts into the
+    excluded summaries — instead of forcing the historical O(zone)
+    re-scan (ISSUE 15 tentpole (c)); the resulting plan equals a fresh
+    cold build's."""
+    from spark_scheduler_tpu.core.prune import PrunePlanner
+    from spark_scheduler_tpu.models.cluster import ClusterTensors
+
+    n, zb = 24, 2
+
+    def mk_host(valid):
+        return ClusterTensors(
+            available=avail,
+            schedulable=avail.copy(),
+            zone_id=zone_id,
+            name_rank=name_rank,
+            label_rank_driver=np.zeros(n, np.int32),
+            label_rank_executor=np.zeros(n, np.int32),
+            unschedulable=np.zeros(n, bool),
+            ready=np.ones(n, bool),
+            valid=valid,
+        )
+
+    avail = np.full((n, 3), 32, np.int32)  # equal keys: name rank decides
+    zone_id = (np.arange(n) % 2).astype(np.int32)
+    name_rank = (np.arange(n) + 10).astype(np.int32)
+    valid = np.ones(n, bool)
+    j = n - 1
+    valid[j] = False  # the future ADD
+    drv = np.asarray([[2, 4, 0]], np.int32)
+    exc = np.asarray([[1, 2, 0]], np.int32)
+    counts = np.asarray([2], np.int32)
+    cand = [np.ones(n, bool)]
+
+    planner = PrunePlanner()
+    host = mk_host(valid)
+    planner.sync(host, zb)
+    plan = planner.plan_full_domain(
+        host, cand_per_req=cand, drv_arr=drv, exc_arr=exc,
+        counts=counts, num_zones=zb, top_k=4, slack=0.3,
+    )
+    assert plan is not None
+    rescans0 = planner.stats["planner_zone_rescans"]
+    scanned0 = planner.stats["planner_rows_scanned"]
+
+    # The ADD: row j becomes valid with the BEST name rank in its zone.
+    valid[j] = True
+    name_rank[j] = 0
+    planner.note_static(np.asarray([j]))
+    host2 = mk_host(valid)
+    planner.sync(host2, zb)
+    plan2 = planner.plan_full_domain(
+        host2, cand_per_req=cand, drv_arr=drv, exc_arr=exc,
+        counts=counts, num_zones=zb, top_k=4, slack=0.3,
+    )
+    assert plan2 is not None
+    st = planner.stats
+    assert st["planner_boundary_inserts"] >= 1, st
+    assert st["planner_zone_rescans"] == rescans0, st
+    assert st["planner_rows_scanned"] == scanned0, st
+    keep2 = plan2.keep[: plan2.k_real]
+    assert j in keep2, keep2
+
+    # Exactness oracle: the inserted plan equals a fresh cold build.
+    fresh = PrunePlanner()
+    fresh.sync(host2, zb)
+    planf = fresh.plan_full_domain(
+        host2, cand_per_req=cand, drv_arr=drv, exc_arr=exc,
+        counts=counts, num_zones=zb, top_k=4, slack=0.3,
+    )
+    assert np.array_equal(keep2, planf.keep[: planf.k_real])
+    assert np.array_equal(plan2.zone_mem, planf.zone_mem)
+    assert np.array_equal(plan2.zone_cpu, planf.zone_cpu)
+    for a, b in zip(plan2.zone_base, planf.zone_base):
+        assert np.array_equal(a, b)
+    assert np.array_equal(plan2.e_cnt_exec > 0, planf.e_cnt_exec > 0)
+    assert np.array_equal(plan2.e_key_exec, planf.e_key_exec)
+    assert np.array_equal(plan2.e_max_exec, planf.e_max_exec)
+    assert np.array_equal(plan2.e_cnt_drv > 0, planf.e_cnt_drv > 0)
+    assert np.array_equal(plan2.e_key_drv, planf.e_key_drv)
+    assert np.array_equal(plan2.e_max_drv, planf.e_max_drv)
