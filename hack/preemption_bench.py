@@ -1,0 +1,163 @@
+"""Preemption-search A/B (policy subsystem): ONE batched masked-fit pass
+over all candidate eviction sets vs the sequential per-candidate loop it
+replaces, at 10k and 100k nodes.
+
+Both arms answer the same question the policy engine asks on a fit denial:
+"which prefix of the victim list, once evicted, admits this gang?" The
+batched arm is the shipping path — a single `solver.preemption_search` call
+whose vmapped kernel probes all C candidate sets in one device program. The
+sequential arm issues C single-candidate probes (the per-candidate kernel
+loop the kernel replaces), early-exiting at the first feasible prefix the
+way a host loop would. Feasible-index agreement is asserted between arms.
+
+One JSON line per (nodes, arm) on stdout; standalone:
+    python hack/preemption_bench.py
+Env: PREEMPT_BENCH_NODES="10000,100000"  PREEMPT_BENCH_REPS="20"
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import json
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+import numpy as np
+
+CANDIDATES = 8  # the policy default: max_evictions=8 nested prefixes
+EXECS = 28  # big gang: the minimal eviction set is the LAST prefix —
+# the sustained-pressure case, where a host loop pays every probe
+STRATEGY = "tightly-pack"
+
+
+def _nodes(n):
+    from spark_scheduler_tpu.models.kube import Node, ZONE_LABEL
+    from spark_scheduler_tpu.models.resources import Resources
+
+    alloc = Resources.from_quantities("8", "8Gi", "1", round_up=False)
+    return [
+        Node(
+            name=f"pb-n{i:06d}",
+            allocatable=alloc,
+            labels={ZONE_LABEL: f"z{i % 4}"},
+        )
+        for i in range(n)
+    ]
+
+
+def _freed_cum(rng, registry, rows, n, victim_res):
+    """[C, rows, 3] cumulative freed capacity: victim c releases a 4-slot
+    gang on a distinct same-zone node (nodes 0,4,8,... share z0), scattered
+    through the solver's registry index space exactly like the real
+    enumerator (policy/preemption.py freed_prefixes) — nested prefixes,
+    monotone. With a 29-slot requester, only a deep prefix admits it."""
+    step = np.zeros((CANDIDATES, rows, victim_res.shape[0]), dtype=np.int64)
+    picks = rng.choice(n // 4, size=CANDIDATES, replace=False) * 4
+    for c, i in enumerate(picks):
+        idx = registry.index_of(f"pb-n{i:06d}")
+        assert idx is not None and idx < rows
+        step[c, idx] = victim_res * 4
+    return np.cumsum(step, axis=0)
+
+
+def run(n, reps):
+    from spark_scheduler_tpu.core.solver import PlacementSolver
+    from spark_scheduler_tpu.models.resources import Resources
+
+    rng = np.random.default_rng(4242 + n)
+    nodes = _nodes(n)
+    names = [nd.name for nd in nodes]
+    one = Resources.from_quantities("1", "1Gi")
+
+    solver = PlacementSolver()
+    # Saturated cluster: every node fully used, so only freed capacity can
+    # admit the gang — the search has real work to do.
+    usage = {
+        nd.name: Resources.from_quantities("8", "8Gi", "0", round_up=False)
+        for nd in nodes
+    }
+    tensors = solver.build_tensors(nodes, usage, {})
+    freed = _freed_cum(
+        rng,
+        solver.registry,
+        tensors.available.shape[0],
+        n,
+        one.as_array().astype(np.int64),
+    )
+
+    def batched():
+        return solver.preemption_search(
+            STRATEGY, tensors, one, one, EXECS, names, freed
+        )[0]
+
+    def sequential():
+        # The per-candidate loop the batched kernel replaces: one
+        # single-candidate device probe per eviction set, early exit.
+        for c in range(CANDIDATES):
+            idx, _ = solver.preemption_search(
+                STRATEGY, tensors, one, one, EXECS, names, freed[c : c + 1]
+            )
+            if idx == 0:
+                return c  # early exit — the loop's best case
+        return -1
+
+    # Warmup (compilation) outside the clock, and the agreement check.
+    want = batched()
+    assert sequential() == want, "arms disagree on the minimal eviction set"
+
+    out = []
+    for label, fn in (("batched", batched), ("sequential", sequential)):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append((time.perf_counter() - t0) * 1e3)
+        out.append(
+            {
+                "nodes": n,
+                "arm": label,
+                "candidates": CANDIDATES,
+                "search_p50_ms": round(float(np.percentile(times, 50)), 2),
+                "search_mean_ms": round(float(np.mean(times)), 2),
+                "feasible_index": want,
+            }
+        )
+    solver.close()
+    return out
+
+
+def main():
+    node_counts = [
+        int(x)
+        for x in os.environ.get(
+            "PREEMPT_BENCH_NODES", "10000,100000"
+        ).split(",")
+    ]
+    reps = int(os.environ.get("PREEMPT_BENCH_REPS", "20"))
+    for n in node_counts:
+        rows = run(n, reps)
+        for r in rows:
+            print(json.dumps(r), flush=True)
+        b, s = rows[0], rows[1]
+        print(
+            json.dumps(
+                {
+                    "nodes": n,
+                    "speedup_p50": round(
+                        s["search_p50_ms"] / max(b["search_p50_ms"], 1e-9), 2
+                    ),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

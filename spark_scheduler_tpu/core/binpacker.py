@@ -23,8 +23,13 @@ class Binpacker:
 
 
 def select_binpacker(name: str) -> Binpacker:
-    """Unknown names fall back to tightly-pack, matching SelectBinpacker
-    (binpack.go:47-54)."""
-    if name not in BINPACK_FUNCTIONS:
-        name = TIGHTLY_PACK
+    """Resolve a configured algorithm name to its packer.
+
+    The reference silently falls back to tightly-pack on an unknown name
+    (binpack.go:47-54); here a typo'd config string raises an
+    `UnknownStrategyError` listing the valid names — the same error shape
+    the policy plug-board uses (policy/registry.py)."""
+    from spark_scheduler_tpu.policy.registry import resolve
+
+    resolve(name, BINPACK_FUNCTIONS, "binpack algorithm")
     return Binpacker(name=name, is_single_az=name in SINGLE_AZ_PACKERS)

@@ -203,6 +203,7 @@ class SparkSchedulerExtender:
         waste=None,
         recorder=None,
         clock=time.time,
+        policy=None,
     ):
         self._backend = backend
         self._pod_lister = pod_lister
@@ -219,6 +220,9 @@ class SparkSchedulerExtender:
         # Scheduling flight recorder (observability/recorder.py): every
         # decision below appends one explainable DecisionRecord.
         self._recorder = recorder
+        # Policy engine (policy/engine.py) — None keeps every hook below on
+        # the exact pre-policy branch (the FIFO byte-identity contract).
+        self._policy = policy
         self._clock = clock
         self._last_request: float = 0.0
         # HA lease handle (ha/lease.LeaseManager), set by the replica
@@ -859,25 +863,63 @@ class SparkSchedulerExtender:
         parsed_pending = pending_supplier()
 
         requests: list[WindowRequest] = []
+        kept: list[tuple] = []
+        now_policy = self._clock()
         for i, pod, res, args in window:
             rows: list[tuple] = []
             if self._config.fifo:
                 group = find_instance_group(
                     pod, self._pod_lister.instance_group_label
                 )
-                for ed, ed_group, ed_res, ed_skip in parsed_pending:
-                    if not SparkPodLister.is_earlier_driver(
-                        ed, ed_group, pod, group
-                    ):
-                        continue
-                    rows.append(
-                        (
-                            ed_res.driver_resources,
-                            ed_res.executor_resources,
-                            ed_res.min_executor_count,
-                            ed_skip,
-                        )
+                if self._policy is not None:
+                    # Policy window ordering (policy/ordering.py): blocker
+                    # rows by the configured strategy; a DRF cross-group
+                    # yield denies without consuming a solve (disjoint
+                    # domains — capacity rows cannot express it).
+                    blockers, hard = self._policy.ordering.blockers(
+                        pod, group, parsed_pending, now_policy
                     )
+                    if hard:
+                        msg = (
+                            "yielding to instance group with smaller "
+                            "dominant share"
+                        )
+                        self._demands.create_demand_for_application(pod, res)
+                        self._mark_outcome(
+                            pod, ROLE_DRIVER, FAILURE_EARLIER_DRIVER,
+                            timer_start,
+                        )
+                        self._record_decision(
+                            pod, ROLE_DRIVER, FAILURE_EARLIER_DRIVER, None,
+                            args.node_names, msg,
+                        )
+                        results[i] = self._fail(
+                            args, FAILURE_EARLIER_DRIVER, msg
+                        )
+                        continue
+                    for _ed, _ed_group, ed_res, ed_skip in blockers:
+                        rows.append(
+                            (
+                                ed_res.driver_resources,
+                                ed_res.executor_resources,
+                                ed_res.min_executor_count,
+                                ed_skip,
+                            )
+                        )
+                else:
+                    for ed, ed_group, ed_res, ed_skip in parsed_pending:
+                        if not SparkPodLister.is_earlier_driver(
+                            ed, ed_group, pod, group
+                        ):
+                            continue
+                        rows.append(
+                            (
+                                ed_res.driver_resources,
+                                ed_res.executor_resources,
+                                ed_res.min_executor_count,
+                                ed_skip,
+                            )
+                        )
             rows.append(
                 (
                     res.driver_resources,
@@ -886,6 +928,7 @@ class SparkSchedulerExtender:
                     False,
                 )
             )
+            kept.append((i, pod, res, args))
             requests.append(
                 WindowRequest(
                     rows=rows,
@@ -893,6 +936,8 @@ class SparkSchedulerExtender:
                     domain_node_names=domains[i],
                 )
             )
+        if len(kept) != len(window):
+            window[:] = kept  # t.window stays aligned with `requests`
 
         now = self._clock()
         phases["featurize_fifo_ms"] = (now - t_domains) * 1e3
@@ -925,10 +970,11 @@ class SparkSchedulerExtender:
         all_nodes, by_name, domains = t.all_nodes, t.by_name, t.domains
         commit_t0 = self._clock()
 
-        def record(k, pod, args, outcome, node, msg=""):
+        def record(k, pod, args, outcome, node, msg="", extra=None):
             self._record_decision(
                 pod, ROLE_DRIVER, outcome, node, args.node_names, msg,
                 ctx={
+                    **(extra or {}),
                     "featurize_ms": t.featurize_ms,
                     **t.featurize_phases,
                     "solve_ms": solve_ms,
@@ -974,6 +1020,7 @@ class SparkSchedulerExtender:
                 pod=f"{pod.namespace}/{pod.name}",
             ) as sp:
                 self._demands.create_demand_for_application(pod, res)
+                extra = None
                 if d.earlier_blocked:
                     outcome, msg = (
                         FAILURE_EARLIER_DRIVER,
@@ -984,9 +1031,21 @@ class SparkSchedulerExtender:
                         FAILURE_FIT,
                         "application does not fit to the cluster",
                     )
+                    pre = self._try_preempt_for(
+                        pod, res, args.node_names, domains[i]
+                    )
+                    if pre is not None:
+                        # Evictions freed capacity; this round still denies
+                        # and the pod's retry admits against the freed
+                        # cluster (the solo path re-solves inline instead).
+                        msg = (
+                            "application does not fit; preempted "
+                            f"{len(pre['evicted'])} lower-priority gang(s)"
+                        )
+                        extra = {"preemption": pre}
                 sp.tag("outcome", outcome)
                 self._mark_outcome(pod, ROLE_DRIVER, outcome, timer_start)
-                record(k, pod, args, outcome, None, msg)
+                record(k, pod, args, outcome, None, msg, extra)
                 results[i] = self._fail(args, outcome, msg)
 
         # One batched reservation write-back for the whole window: one
@@ -1080,6 +1139,48 @@ class SparkSchedulerExtender:
                 pod, role, outcome, self._clock() - timer_start
             )
 
+    def _try_preempt_for(
+        self, pod, res, candidate_names, domain_names
+    ) -> Optional[dict]:
+        """Vectorized preemption on a fit denial (policy subsystem): ONE
+        batched masked-fit pass over candidate eviction sets, then evict
+        the minimal feasible set through the normal teardown path and bump
+        the capacity epoch. Best-effort — any failure leaves the denial as
+        is. Returns the recorder payload (eviction set + costs) or None."""
+        if self._policy is None or self._policy.preemption is None:
+            return None
+        try:
+            snap = self.features.snapshot()
+            tensors = self._build_serving_tensors(snap)
+            domain_mask = (
+                self._solver.candidate_mask(tensors, list(domain_names))
+                if domain_names is not None
+                else None
+            )
+            result = self._policy.try_preempt(
+                self._solver,
+                self.binpacker.name,
+                tensors,
+                pod,
+                res,
+                candidate_names,
+                set(domain_names) if domain_names is not None else None,
+                domain_mask=domain_mask,
+            )
+        except Exception as exc:
+            from spark_scheduler_tpu.tracing import svc1log
+
+            svc1log().warn(
+                "preemption search failed; keeping fit denial",
+                pod=f"{pod.namespace}/{pod.name}",
+                error=repr(exc),
+            )
+            return None
+        if result is None:
+            return None
+        self._capacity_epoch += 1
+        return dataclasses.asdict(result)
+
     def _record_decision(
         self, pod, role, outcome, node, node_names, message="", ctx=None,
     ) -> None:
@@ -1150,6 +1251,7 @@ class SparkSchedulerExtender:
                 if isinstance(solve_info, dict)
                 else None
             ),
+            preemption=ctx.get("preemption"),
         )
 
     # ------------------------------------------------------------- plumbing
@@ -1223,7 +1325,25 @@ class SparkSchedulerExtender:
 
         earlier: Sequence[Pod] = ()
         if self._config.fifo:
-            earlier = self._pod_lister.list_earlier_drivers(driver)
+            if self._policy is not None:
+                group = find_instance_group(
+                    driver, self._config.instance_group_label
+                )
+                blockers, hard = self._policy.ordering.blockers(
+                    driver, group, self._parse_pending_drivers(), self._clock()
+                )
+                if hard:
+                    self._demands.create_demand_for_application(
+                        driver, app_resources
+                    )
+                    return (
+                        None,
+                        FAILURE_EARLIER_DRIVER,
+                        "yielding to instance group with smaller dominant share",
+                    )
+                earlier = [row[0] for row in blockers]
+            else:
+                earlier = self._pod_lister.list_earlier_drivers(driver)
             # None (not 0) when FIFO is off: the record must distinguish
             # "first in queue" from "queue never consulted".
             ctx["queue_position"] = len(earlier)
@@ -1251,6 +1371,22 @@ class SparkSchedulerExtender:
             ctx["solve_ms"] = (self._clock() - s0) * 1e3
             ctx["solve_info"] = self._solver.last_solve_info
             if packing is None:
+                if outcome == FAILURE_FIT and not ctx.get("preempted"):
+                    pre = self._try_preempt_for(
+                        driver,
+                        app_resources,
+                        node_names,
+                        [n.name for n in available_nodes],
+                    )
+                    if pre is not None:
+                        # Inline one-shot retry against the freed cluster
+                        # (the windowed path instead denies and lets the
+                        # pod's retry admit — see _complete_driver_window).
+                        ctx["preempted"] = True
+                        ctx["preemption"] = pre
+                        return self._select_driver_node(
+                            driver, node_names, ctx=ctx
+                        )
                 self._demands.create_demand_for_application(driver, app_resources)
                 return None, outcome, message
         else:
@@ -1279,6 +1415,19 @@ class SparkSchedulerExtender:
             ctx["solve_ms"] = (self._clock() - s0) * 1e3
             ctx["solve_info"] = self._solver.last_solve_info
             if not packing.has_capacity:
+                if not ctx.get("preempted"):
+                    pre = self._try_preempt_for(
+                        driver,
+                        app_resources,
+                        node_names,
+                        [n.name for n in available_nodes],
+                    )
+                    if pre is not None:
+                        ctx["preempted"] = True
+                        ctx["preemption"] = pre
+                        return self._select_driver_node(
+                            driver, node_names, ctx=ctx
+                        )
                 self._demands.create_demand_for_application(driver, app_resources)
                 return None, FAILURE_FIT, "application does not fit to the cluster"
 

@@ -3518,6 +3518,61 @@ class PlacementSolver:
     def can_batch(self, strategy: str) -> bool:
         return strategy in BATCHABLE_STRATEGIES
 
+    def preemption_search(
+        self,
+        strategy: str,
+        tensors,
+        driver_resources: Resources,
+        executor_resources: Resources,
+        executor_count: int,
+        driver_candidate_names: Sequence[str],
+        freed_cum: np.ndarray,  # [C, rows, 3] int — per-candidate freed capacity
+        domain_mask: np.ndarray | None = None,
+    ) -> tuple[int, dict]:
+        """Batched masked-fit probe over candidate eviction sets (policy
+        subsystem): candidate c's availability is the cluster plus
+        `freed_cum[c]` (in registry index space). ONE vmapped device program
+        solves all candidates (ops/packing.py preemption_batched_fit); with
+        nested prefixes the first feasible index is the minimal eviction
+        set. Returns (first feasible candidate index or -1, solve info)."""
+        from spark_scheduler_tpu.ops.packing import (
+            PREEMPTION_FILL,
+            preemption_batched_fit,
+        )
+
+        n = tensors.available.shape[0]
+        host = _host_view(tensors)
+        driver_mask = self.candidate_mask(tensors, driver_candidate_names)
+        if domain_mask is None:
+            domain_mask = np.asarray(host.valid)
+        emax = _bucket(max(executor_count, 1), 8)
+        c = freed_cum.shape[0]
+        freed = np.zeros((c, n, freed_cum.shape[2]), dtype=np.int32)
+        rows = min(freed_cum.shape[1], n)
+        freed[:, :rows, :] = freed_cum[:, :rows, :]
+        fill = PREEMPTION_FILL.get(strategy, "tightly-pack")
+        ok, _drv, _execs = preemption_batched_fit(
+            tensors,
+            jnp.asarray(freed),
+            jnp.asarray(driver_resources.as_array()),
+            jnp.asarray(executor_resources.as_array()),
+            jnp.int32(executor_count),
+            jnp.asarray(driver_mask),
+            jnp.asarray(domain_mask),
+            fill=fill,
+            emax=emax,
+            num_zones=self._num_zones_bucket(),
+        )
+        ok_host = np.asarray(ok)
+        idx = int(np.argmax(ok_host)) if bool(ok_host.any()) else -1
+        return idx, {
+            "path": "xla-batched-preemption",
+            "candidates": c,
+            "nodes": n,
+            "emax": emax,
+            "fill": fill,
+        }
+
     def pack_window(
         self,
         strategy: str,

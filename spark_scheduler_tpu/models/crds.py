@@ -66,7 +66,11 @@ def resource_reservation_crd(webhook_url: Optional[str] = None,
                                     "resources": _RESOURCES_SCHEMA,
                                 },
                             },
-                        }
+                        },
+                        # Gang priority class (policy subsystem); optional so
+                        # pre-policy objects — and all objects written with
+                        # the engine off — validate unchanged.
+                        "priorityClass": {"type": "string"},
                     },
                 },
                 "status": {

@@ -33,6 +33,12 @@ APP_ID_LABEL = "spark-app-id"
 # losslessly through this webhook too.
 RESERVATION_SPEC_ANNOTATION = "sparkscheduler.palantir.com/reservation-spec"
 DRIVER_RESERVATION = "driver"
+# Priority class of the gang (policy subsystem). Set on the driver pod by the
+# submitter; stamped onto the ResourceReservation at creation so the running
+# gang's tier survives driver-pod deletion and is visible to the preemption
+# search. Absent on both when the policy engine is off — objects stay
+# byte-identical to the pre-policy wire form.
+PRIORITY_CLASS_ANNOTATION = "spark-priority-class"
 
 
 def executor_reservation_name(i: int) -> str:
@@ -115,10 +121,15 @@ def new_resource_reservation(
             node, executor_resources.copy()
         )
     app_id = driver_pod.labels.get(APP_ID_LABEL, driver_pod.name)
+    annotations: dict[str, str] = {}
+    priority_class = (driver_pod.annotations or {}).get(PRIORITY_CLASS_ANNOTATION)
+    if priority_class is not None:
+        annotations[PRIORITY_CLASS_ANNOTATION] = priority_class
     return ResourceReservation(
         name=app_id,
         namespace=driver_pod.namespace,
         labels={APP_ID_LABEL: app_id},
+        annotations=annotations,
         owner_pod_uid=driver_pod.uid,
         spec=ReservationSpec(reservations),
         status=ReservationStatus(pods={DRIVER_RESERVATION: driver_pod.name}),

@@ -87,6 +87,10 @@ class DecisionRecord:
     # decision's window survived (None/0 = clean dispatch).
     degraded: Optional[bool] = None
     redispatches: Optional[int] = None
+    # Policy subsystem (ISSUE 16): eviction set + costs when a preemption
+    # search fired for this decision ({evicted, candidates, searched,
+    # cost, search_ms}); None on the (default) no-policy path.
+    preemption: Optional[dict] = None
 
     def to_dict(self) -> dict:
         out = dataclasses.asdict(self)
@@ -133,6 +137,7 @@ class FlightRecorder:
         dispatch_id: Optional[int] = None,
         degraded: Optional[bool] = None,
         redispatches: Optional[int] = None,
+        preemption: Optional[dict] = None,
     ) -> DecisionRecord:
         if (
             failed_nodes
@@ -172,6 +177,7 @@ class FlightRecorder:
             dispatch_id=dispatch_id,
             degraded=degraded,
             redispatches=redispatches,
+            preemption=preemption,
         )
         with self._lock:
             self._ring.append(rec)

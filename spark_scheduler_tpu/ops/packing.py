@@ -503,3 +503,78 @@ SINGLE_AZ_PACKERS = frozenset(
     {"single-az-tightly-pack", "single-az-minimal-fragmentation"}
 )
 DEFAULT_BINPACK = "tightly-pack"
+
+
+# ---------------------------------------------------------------------------
+# Vectorized preemption search (policy subsystem).
+# ---------------------------------------------------------------------------
+
+# The single-az fills run the plain inner fill per zone; for the preemption
+# *search* (a feasibility probe — the actual admission re-runs the real
+# strategy after eviction) each strategy maps to its plain inner fill.
+PREEMPTION_FILL = {
+    "tightly-pack": "tightly-pack",
+    "distribute-evenly": "distribute-evenly",
+    "minimal-fragmentation": "minimal-fragmentation",
+    "single-az-tightly-pack": "tightly-pack",
+    "single-az-minimal-fragmentation": "minimal-fragmentation",
+    "az-aware-tightly-pack": "tightly-pack",
+}
+
+
+@partial(jax.jit, static_argnames=("fill", "emax", "num_zones"))
+def preemption_batched_fit(
+    cluster: ClusterTensors,
+    freed_cum: jnp.ndarray,  # [C,N,3] i32 — capacity freed by each candidate eviction set
+    driver_req: jnp.ndarray,  # [3] i32
+    exec_req: jnp.ndarray,  # [3] i32
+    count: jnp.ndarray,  # i32 scalar
+    driver_candidate_mask: jnp.ndarray,  # [N] bool
+    domain_mask: jnp.ndarray,  # [N] bool
+    *,
+    fill: str,
+    emax: int,
+    num_zones: int,
+):
+    """Masked gang fit for ALL candidate eviction sets in one batched pass.
+
+    Candidate c's availability is `cluster.available + freed_cum[c]` — the
+    cluster with eviction set c's reservations released. The node priority
+    orders are availability-dependent (ops/sorting.py lexsorts on free
+    cpu/mem), so the whole per-candidate program — zone ranks, both priority
+    orders, and the `pack_one_app` feasibility identity — is vmapped over
+    the candidate axis and compiled once: no per-candidate Python loop over
+    kernel calls, which is what makes the search affordable at 100k nodes
+    (see PERFORMANCE.md).
+
+    Eligibility masks are availability-independent and computed once.
+    Returns (ok[C] bool, driver_node[C] i32, exec_nodes[C,Emax] i32). With
+    nested candidate sets (set c = victims[0..c]) the first ok index is the
+    minimal eviction set.
+    """
+    fill_fn = _FILLS[fill]
+    n = cluster.available.shape[0]
+    _check_cumsum_bound(n, emax)
+
+    domain = domain_mask & cluster.valid
+    driver_elig = domain & driver_candidate_mask
+    exec_elig = domain & ~cluster.unschedulable & cluster.ready
+
+    def fit_one(freed):
+        avail = cluster.available + freed
+        zrank = zone_ranks(cluster, domain, num_zones, available=avail)
+        d_order, _ = priority_order(
+            cluster, driver_elig, zrank, cluster.label_rank_driver, available=avail
+        )
+        e_order, _ = priority_order(
+            cluster, exec_elig, zrank, cluster.label_rank_executor, available=avail
+        )
+        d_rank = _rank_of_position(d_order)
+        driver_node, _one_hot, exec_nodes, ok = pack_one_app(
+            avail, exec_elig, driver_elig, d_order, d_rank, e_order,
+            driver_req, exec_req, count, fill_fn, emax,
+        )
+        return ok, driver_node, exec_nodes
+
+    ok, driver_node, exec_nodes = jax.vmap(fit_one)(freed_cum)
+    return ok, driver_node.astype(jnp.int32), exec_nodes.astype(jnp.int32)

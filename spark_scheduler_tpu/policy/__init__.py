@@ -1,0 +1,18 @@
+"""Policy subsystem: priority tiers, vectorized preemption search, DRF
+window ordering, and a pool-driven continuous defragmenter.
+
+Everything here is default-off: `build_scheduler_app` only constructs a
+`PolicyEngine` when `InstallConfig.policy_enabled` is set, and every hook in
+the extender takes the exact pre-policy branch when the engine is absent —
+the FIFO path stays byte-identical (pinned by
+tests/test_policy_identity.py).
+"""
+
+from spark_scheduler_tpu.policy.engine import PolicyConfig, PolicyEngine  # noqa: F401
+from spark_scheduler_tpu.policy.priority import (  # noqa: F401
+    PRIORITY_CLASS_ANNOTATION,
+    PRIORITY_CLASSES,
+    effective_priority,
+    pod_priority,
+)
+from spark_scheduler_tpu.policy.registry import UnknownStrategyError, resolve  # noqa: F401

@@ -63,6 +63,13 @@ class SchedulerApp:
         if self._background_started:
             return
         self._background_started = True
+        # Boot-heap freeze (ROADMAP item 5): everything constructed by
+        # build_scheduler_app is long-lived; freezing it keeps steady-state
+        # gen-2 collections from re-scanning the boot heap on the serving
+        # tail.
+        from spark_scheduler_tpu.server.runtime import freeze_boot_heap
+
+        freeze_boot_heap()
         if self.ingestion is not None:
             self.ingestion.start()
         self.rr_cache.start()
@@ -290,6 +297,35 @@ def build_scheduler_app(
         overhead_computer,
         config.instance_group_label,
     )
+    # Policy engine (ISSUE 16): constructed ONLY when enabled — with
+    # policy=None every extender hook takes the exact pre-policy branch,
+    # keeping the default FIFO path byte-identical.
+    policy = None
+    if config.policy_enabled:
+        from spark_scheduler_tpu.policy import PolicyConfig, PolicyEngine
+
+        policy = PolicyEngine(
+            PolicyConfig(
+                ordering=config.policy_ordering,
+                preemption=config.policy_preemption,
+                max_evictions=config.policy_max_evictions,
+                promote_after_s=config.policy_promote_after_s,
+                defrag=config.policy_defrag,
+                defrag_interval_s=config.policy_defrag_interval_s,
+                defrag_budget=config.policy_defrag_budget,
+                protected_class=config.policy_protected_class,
+            ),
+            backend=backend,
+            rr_cache=rr_cache,
+            pod_lister=pod_lister,
+            soft_store=soft_store,
+            reservation_manager=reservation_manager,
+            solver=solver,
+            clock=clock,
+            metrics_registry=(
+                metrics.registry if metrics is not None else None
+            ),
+        )
     extender = SparkSchedulerExtender(
         backend,
         pod_lister,
@@ -314,6 +350,7 @@ def build_scheduler_app(
         waste=waste,
         recorder=recorder,
         clock=clock,
+        policy=policy,
     )
     marker = UnschedulablePodMarker(
         backend,

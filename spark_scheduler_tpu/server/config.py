@@ -265,6 +265,29 @@ class InstallConfig:
     # a half-open probe. 0 failures disables the breaker.
     breaker_failure_threshold: int = 8
     breaker_reset_timeout_s: float = 5.0
+    # Policy engine (spark_scheduler_tpu/policy/, ISSUE 16): priority
+    # tiers, vectorized preemption search, DRF window ordering, and the
+    # pool-driven continuous defragmenter. OFF by default — with
+    # `policy.enabled: false` no PolicyEngine is constructed and every
+    # extender decision takes the exact pre-policy FIFO branch
+    # (byte-identity pinned by tests/test_policy_identity.py + CI).
+    #   policy:
+    #     enabled: true
+    #     ordering: fifo | priority | drf
+    #     preemption: true
+    #     max-evictions: 8
+    #     promote-after: 5m        # anti-starvation age promotion step
+    #     protected-class: system  # never evicted
+    #     defrag: {enabled, interval, budget}
+    policy_enabled: bool = False
+    policy_ordering: str = "fifo"
+    policy_preemption: bool = False
+    policy_max_evictions: int = 8
+    policy_promote_after_s: float = 300.0
+    policy_protected_class: str = "system"
+    policy_defrag: bool = False
+    policy_defrag_interval_s: float = 30.0
+    policy_defrag_budget: int = 4
 
     # Module-name markers of DONATED jitted programs (the persistent cache
     # key string is "<module_name>-<hash>"). Donation is invisible in the
@@ -398,6 +421,8 @@ class InstallConfig:
         ha_block = raw.get("ha") or {}
         extender_block = raw.get("extender") or {}
         retry_block = raw.get("retry") or {}
+        policy_block = raw.get("policy") or {}
+        defrag_block = policy_block.get("defrag") or {}
 
         def block_key(block, key, default):
             # Present-but-null keys (`device-pool:` with no value) must
@@ -563,6 +588,25 @@ class InstallConfig:
             breaker_reset_timeout_s=_parse_duration(
                 block_key(retry_block, "breaker-reset-timeout", 5.0)
             ),
+            policy_enabled=bool(block_key(policy_block, "enabled", False)),
+            policy_ordering=str(block_key(policy_block, "ordering", "fifo")),
+            policy_preemption=bool(
+                block_key(policy_block, "preemption", False)
+            ),
+            policy_max_evictions=int(
+                block_key(policy_block, "max-evictions", 8)
+            ),
+            policy_promote_after_s=_parse_duration(
+                block_key(policy_block, "promote-after", 300.0)
+            ),
+            policy_protected_class=str(
+                block_key(policy_block, "protected-class", "system")
+            ),
+            policy_defrag=bool(block_key(defrag_block, "enabled", False)),
+            policy_defrag_interval_s=_parse_duration(
+                block_key(defrag_block, "interval", 30.0)
+            ),
+            policy_defrag_budget=int(block_key(defrag_block, "budget", 4)),
         )
 
 

@@ -33,6 +33,7 @@ from spark_scheduler_tpu.models.demands import (
     convert_demand_to_v1alpha1,
 )
 from spark_scheduler_tpu.models.reservations import (
+    PRIORITY_CLASS_ANNOTATION,
     Reservation,
     ReservationSpec,
     ReservationStatus,
@@ -112,34 +113,55 @@ def _metadata_fields(raw: dict, *, with_annotations: bool = True) -> dict:
 
 
 def rr_v1beta2_to_wire(rr: ResourceReservation) -> dict:
-    """types_resource_reservation.go:40-102 (v1beta2 storage shape)."""
-    return {
+    """types_resource_reservation.go:40-102 (v1beta2 storage shape).
+
+    A gang's priority class (policy subsystem) is a first-class optional
+    spec field in v1beta2, emitted only when present so pre-policy objects
+    stay byte-identical; in v1beta1 it simply stays in annotations."""
+    spec: dict = {
+        "reservations": {
+            name: {"node": r.node, "resources": resources_to_quantity_map(r.resources)}
+            for name, r in rr.spec.reservations.items()
+        }
+    }
+    priority_class = rr.annotations.get(PRIORITY_CLASS_ANNOTATION)
+    if priority_class is not None:
+        spec["priorityClass"] = priority_class
+    wire = {
         "apiVersion": RR_V1BETA2,
         "kind": "ResourceReservation",
         "metadata": _metadata_to_wire(rr),
-        "spec": {
-            "reservations": {
-                name: {"node": r.node, "resources": resources_to_quantity_map(r.resources)}
-                for name, r in rr.spec.reservations.items()
-            }
-        },
+        "spec": spec,
         "status": {"pods": dict(rr.status.pods)},
     }
+    if priority_class is not None:
+        # The annotation is the in-model carrier; the wire carries the spec
+        # field only (no duplicate), matching how reservation-spec stashes
+        # are stripped on upgrade.
+        wire["metadata"].get("annotations", {}).pop(PRIORITY_CLASS_ANNOTATION, None)
+        if not wire["metadata"].get("annotations"):
+            wire["metadata"].pop("annotations", None)
+    return wire
 
 
 def rr_v1beta2_from_wire(raw: dict) -> ResourceReservation:
+    spec_raw = raw.get("spec") or {}
     reservations = {
         name: Reservation(
             node=r.get("node", ""),
             resources=resources_from_quantity_map(r.get("resources")),
         )
-        for name, r in ((raw.get("spec") or {}).get("reservations") or {}).items()
+        for name, r in (spec_raw.get("reservations") or {}).items()
     }
-    return ResourceReservation(
+    rr = ResourceReservation(
         spec=ReservationSpec(reservations),
         status=ReservationStatus(dict((raw.get("status") or {}).get("pods") or {})),
         **_metadata_fields(raw),
     )
+    priority_class = spec_raw.get("priorityClass")
+    if priority_class is not None:
+        rr.annotations.setdefault(PRIORITY_CLASS_ANNOTATION, str(priority_class))
+    return rr
 
 
 def rr_v1beta1_to_wire(rr1: ResourceReservationV1Beta1) -> dict:

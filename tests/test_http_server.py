@@ -281,8 +281,16 @@ def test_request_log_emits_structured_lines(server):
     request.2 line with method, path, status, duration, and the caller's b3
     trace id."""
     import io
+    import time
 
     from spark_scheduler_tpu.tracing import Svc1Logger, set_svc1log, svc1log
+
+    def _lines(stream):
+        return [
+            json.loads(l)
+            for l in stream.getvalue().splitlines()
+            if '"request.2"' in l
+        ]
 
     stream = io.StringIO()
     old_logger = svc1log()
@@ -297,14 +305,16 @@ def test_request_log_emits_structured_lines(server):
         with urllib.request.urlopen(req) as resp:
             assert resp.status == 200
         _request(server.port, "GET", "/nope")
+        # Both transports emit the line AFTER writing the response bytes,
+        # so the client can observe the response a beat before the log
+        # lands — wait for it before swapping the logger back.
+        deadline = time.monotonic() + 5.0
+        while len(_lines(stream)) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
     finally:
         server.set_request_log(False)
         set_svc1log(old_logger)
-    lines = [
-        json.loads(l)
-        for l in stream.getvalue().splitlines()
-        if '"request.2"' in l
-    ]
+    lines = _lines(stream)
     assert len(lines) == 2, stream.getvalue()
     live, missing = lines
     assert live["method"] == "GET" and live["path"] == "/status/liveness"
