@@ -1949,9 +1949,10 @@ def bench_recorder_overhead(rng):
     window, rounds, warmup = 8, 40, 6
     names = [f"ro{i}" for i in range(64)]
 
-    def make(flag):
+    def make(flag, trace_path=None):
         h = Harness(
-            binpack_algo="tightly-pack", fifo=True, flight_recorder=flag
+            binpack_algo="tightly-pack", fifo=True, flight_recorder=flag,
+            trace_path=trace_path,
         )
         h.add_nodes(
             *[new_node(name, zone=f"zone{i % 3}")
@@ -1979,33 +1980,55 @@ def bench_recorder_overhead(rng):
         _reset_cluster_state(h.backend, h.app)
         return dt_ms / window
 
-    h_on, h_off = make(True), make(False)
+    # Third arm (ISSUE 17): recorder + trace sink — every window journaled
+    # to JSONL on the serving path. Same 5% budget, same interleaving.
+    import tempfile
+
+    trace_path = os.path.join(
+        tempfile.mkdtemp(prefix="bench-trace-"), "trace.jsonl"
+    )
+    h_on, h_off, h_sink = make(True), make(False), make(True, trace_path)
     for _ in range(warmup):
         one_round(h_on)
         one_round(h_off)
-    on_lats, off_lats = [], []
+        one_round(h_sink)
+    on_lats, off_lats, sink_lats = [], [], []
     for _ in range(rounds):
         on_lats.append(one_round(h_on))
         off_lats.append(one_round(h_off))
+        sink_lats.append(one_round(h_sink))
     on_p50 = float(np.percentile(on_lats, 50))
     off_p50 = float(np.percentile(off_lats, 50))
+    sink_p50 = float(np.percentile(sink_lats, 50))
     overhead_pct = (on_p50 - off_p50) / off_p50 * 100.0
+    sink_pct = (sink_p50 - on_p50) / on_p50 * 100.0
     floor_pct = (
         (float(np.min(on_lats)) - float(np.min(off_lats)))
         / float(np.min(off_lats)) * 100.0
     )
+    sink_floor_pct = (
+        (float(np.min(sink_lats)) - float(np.min(on_lats)))
+        / float(np.min(on_lats)) * 100.0
+    )
+    h_sink.app.trace_writer.flush()  # drain the encode queue before stats
     detail = {
         "recorder_on_p50_ms_per_decision": round(on_p50, 4),
         "recorder_off_p50_ms_per_decision": round(off_p50, 4),
+        "recorder_sink_p50_ms_per_decision": round(sink_p50, 4),
         "overhead_floor_pct_min_based": round(floor_pct, 2),
+        "trace_sink_overhead_pct_vs_recorder_on": round(sink_pct, 2),
+        "trace_sink_floor_pct_min_based": round(sink_floor_pct, 2),
+        "trace_events": h_sink.app.trace_writer.stats()["events"],
+        "trace_write_errors": h_sink.app.trace_writer.stats()["write_errors"],
         "window": window,
         "rounds_measured": rounds,
         "decisions_recorded": h_on.app.recorder.stats()["total_recorded"],
         "note": (
-            "interleaved on/off predicate_batch rounds over 64 nodes, "
+            "interleaved on/off/sink predicate_batch rounds over 64 nodes, "
             "identical workload per arm"
         ),
     }
+    h_sink.app.trace_writer.close()
     # Budget: the recorder must stay within 5% of the recorder-off path;
     # vs_baseline 1.0 inside the budget, fractional when it blows it.
     vs = 1.0 if overhead_pct <= 5.0 else round(5.0 / overhead_pct, 2)
@@ -2021,6 +2044,28 @@ def bench_recorder_overhead(rng):
                 "unit": "pct",
                 "vs_baseline": vs,
                 "detail": detail,
+            }
+        ),
+        flush=True,
+    )
+    # Trace-sink budget (ISSUE 17 acceptance): sink-on vs recorder-on.
+    vs_sink = 1.0 if sink_pct <= 5.0 else round(5.0 / sink_pct, 2)
+    _record(
+        "trace_sink_overhead_pct",
+        round(sink_pct, 2), "pct", vs_sink,
+        detail={
+            "recorder_on_p50_ms_per_decision": round(on_p50, 4),
+            "recorder_sink_p50_ms_per_decision": round(sink_p50, 4),
+            "floor_pct_min_based": round(sink_floor_pct, 2),
+        },
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "trace_sink_overhead_pct",
+                "value": round(sink_pct, 2),
+                "unit": "pct",
+                "vs_baseline": vs_sink,
             }
         ),
         flush=True,

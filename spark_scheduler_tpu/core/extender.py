@@ -158,6 +158,7 @@ class WindowTicket:
         "args_list", "results", "roles", "timer_start", "window", "handle",
         "all_nodes", "by_name", "domains", "inflight_keys", "sync", "done",
         "epoch", "featurize_ms", "featurize_phases", "solve_started",
+        "trace_wid",
     )
 
     def __init__(self, args_list):
@@ -184,6 +185,11 @@ class WindowTicket:
         self.featurize_ms = 0.0
         self.featurize_phases: dict[str, float] = {}
         self.solve_started = 0.0
+        # Trace journal window id (replay/trace.TraceWriter): set when a
+        # trace sink journaled this ticket's dispatch; the complete phase
+        # journals its results under the same id. None = not journaled
+        # (no sink, or a sync ticket — the solo path self-journals).
+        self.trace_wid = None
 
 
 class SparkSchedulerExtender:
@@ -262,8 +268,67 @@ class SparkSchedulerExtender:
 
 
     # ------------------------------------------------------------------ API
+    #
+    # Trace capture (ISSUE 17): each public serving entry point is a thin
+    # wrapper journaling the request inputs + final results to the
+    # recorder's trace sink (replay/trace.TraceWriter). Sink-off cost is
+    # one attribute check per call. Window dispatches journal AFTER the
+    # dispatch succeeds — PipelineDrainRequired propagates un-journaled,
+    # so the caller's drain-and-retry appears in the trace exactly as the
+    # serialization the replay engine re-drives (drained results first,
+    # then the retried dispatch).
+
+    def _trace_sink(self):
+        rec = self._recorder
+        return getattr(rec, "sink", None) if rec is not None else None
 
     def predicate(self, args: ExtenderArgs) -> ExtenderFilterResult:
+        tw = self._trace_sink()
+        if tw is None:
+            return self._predicate_solo(args)
+        wid = tw.on_predicate([args], mode="solo")
+        res = self._predicate_solo(args)
+        tw.on_results(wid, [res])
+        return res
+
+    def predicate_window_dispatch(
+        self, args_list: Sequence[ExtenderArgs]
+    ) -> "WindowTicket":
+        t = self._window_dispatch(args_list)
+        tw = self._trace_sink()
+        if tw is not None and not t.sync and t.trace_wid is None:
+            t.trace_wid = tw.on_predicate(t.args_list, mode="window")
+        return t
+
+    def predicate_window_complete(
+        self, t: "WindowTicket"
+    ) -> list[ExtenderFilterResult]:
+        results = self._window_complete(t)
+        # Sync tickets route through self.predicate() inside
+        # _window_complete and self-journal there.
+        if t.trace_wid is not None:
+            tw = self._trace_sink()
+            if tw is not None:
+                tw.on_results(t.trace_wid, results)
+        return results
+
+    def predicate_windows_dispatch(
+        self, args_lists: Sequence[Sequence[ExtenderArgs]]
+    ) -> "list[WindowTicket]":
+        tickets = self._windows_dispatch(args_lists)
+        tw = self._trace_sink()
+        if tw is not None:
+            # Each fused sub-window journals as its own window dispatch,
+            # in claim order — replaying them as sequential pipelined
+            # dispatches is decision-equivalent by the fused==sequential
+            # pin. The len==1 path delegated to the public
+            # predicate_window_dispatch and already journaled.
+            for t in tickets:
+                if not t.sync and t.trace_wid is None:
+                    t.trace_wid = tw.on_predicate(t.args_list, mode="window")
+        return tickets
+
+    def _predicate_solo(self, args: ExtenderArgs) -> ExtenderFilterResult:
         from spark_scheduler_tpu.tracing import tracer
 
         pod = args.pod
@@ -326,7 +391,7 @@ class SparkSchedulerExtender:
             self.predicate_window_dispatch(args_list)
         )
 
-    def predicate_window_dispatch(
+    def _window_dispatch(
         self, args_list: Sequence[ExtenderArgs]
     ) -> "WindowTicket":
         """Phase 1: reconcile/compact, select the driver window, build the
@@ -375,7 +440,7 @@ class SparkSchedulerExtender:
             self._dispatch_driver_window(t, driver_ids)
         return t
 
-    def predicate_window_complete(
+    def _window_complete(
         self, t: "WindowTicket"
     ) -> list[ExtenderFilterResult]:
         """Phase 2: fetch + apply the window decisions (reservations,
@@ -467,7 +532,7 @@ class SparkSchedulerExtender:
                 self._serve_executor_window(t, run)
         return results
 
-    def predicate_windows_dispatch(
+    def _windows_dispatch(
         self, args_lists: Sequence[Sequence[ExtenderArgs]]
     ) -> "list[WindowTicket]":
         """Phase 1 of a FUSED K-window serve (the PredicateBatcher's

@@ -93,8 +93,15 @@ class DecisionRecord:
     preemption: Optional[dict] = None
 
     def to_dict(self) -> dict:
-        out = dataclasses.asdict(self)
+        # NOT dataclasses.asdict: its recursive deep-copy costs ~100x a
+        # shallow copy and rides the serving path via the trace sink.
+        out = dict(self.__dict__)
+        out["failed_nodes"] = dict(self.failed_nodes)
         out["phases"] = {k: round(v, 3) for k, v in self.phases.items()}
+        for key in ("solve", "demand", "preemption"):
+            v = out[key]
+            if v is not None:
+                out[key] = dict(v)
         return out
 
 
@@ -115,6 +122,14 @@ class FlightRecorder:
         self._seq = itertools.count(1)
         self.capacity = max(1, capacity)
         self.total_recorded = 0
+        # Durable trace sink (replay/trace.TraceWriter, ISSUE 17): when
+        # attached, every record is ALSO journaled to the trace stream —
+        # the ring stays the bounded query surface, the sink the durable
+        # one. None keeps record() on the exact pre-sink path.
+        self.sink = None
+
+    def attach_sink(self, sink) -> None:
+        self.sink = sink
 
     def record(
         self,
@@ -182,6 +197,9 @@ class FlightRecorder:
         with self._lock:
             self._ring.append(rec)
             self.total_recorded += 1
+        s = self.sink
+        if s is not None:
+            s.on_decision(rec)
         return rec
 
     def build_failure_map(self, node_names, reason: str) -> dict[str, str]:
@@ -207,18 +225,26 @@ class FlightRecorder:
         role: Optional[str] = None,
         namespace: Optional[str] = None,
         limit: int = 100,
+        instance_group: Optional[str] = None,
+        since_seq: Optional[int] = None,
     ) -> list[dict]:
         """Newest-first records matching the filters. `verdict` matches
-        exactly, or by prefix when it ends with '*' ("failure-*")."""
+        exactly, or by prefix when it ends with '*' ("failure-*");
+        `since_seq` keeps only records NEWER than that sequence number
+        (incident-triage tailing: poll with the last seq you saw)."""
         out: list[dict] = []
         with self._lock:
             records = list(self._ring)
         for rec in reversed(records):
+            if since_seq is not None and rec.seq <= since_seq:
+                continue
             if app is not None and rec.app_id != app:
                 continue
             if namespace is not None and rec.namespace != namespace:
                 continue
             if role is not None and rec.role != role:
+                continue
+            if instance_group is not None and rec.instance_group != instance_group:
                 continue
             if verdict is not None:
                 if verdict.endswith("*"):

@@ -110,6 +110,9 @@ def debug_state_snapshot(app, clock=time.time) -> dict:
     recorder = getattr(app, "recorder", None)
     if recorder is not None:
         out["flight_recorder"] = recorder.stats()
+    trace_writer = getattr(app, "trace_writer", None)
+    if trace_writer is not None:
+        out["trace"] = trace_writer.stats()
     features = getattr(getattr(app, "extender", None), "features", None)
     if features is not None:
         # Host feature store: how often per-window featurize actually

@@ -1,0 +1,43 @@
+"""Durable decision traces, deterministic replay, what-if simulation
+(ISSUE 17).
+
+  trace       versioned JSONL codec: TraceWriter (the FlightRecorder's
+              journaling sink) + TraceReader (torn-tail tolerant).
+  engine      backend-free deterministic replay (`replay_trace`) and the
+              config what-if differ (`what_if`).
+  generators  seed-deterministic synthetic workloads (diurnal / bursty /
+              churn) emitting the same trace format.
+
+CLI: `python -m spark_scheduler_tpu.replay --help`.
+"""
+
+from spark_scheduler_tpu.replay.engine import (
+    ReplayMismatchError,
+    ReplayReport,
+    replay_trace,
+    what_if,
+)
+from spark_scheduler_tpu.replay.generators import GENERATORS, generate
+from spark_scheduler_tpu.replay.trace import (
+    TRACE_VERSION,
+    TraceReader,
+    TraceWriter,
+    config_fingerprint,
+    config_from_fingerprint,
+    config_hash,
+)
+
+__all__ = [
+    "GENERATORS",
+    "ReplayMismatchError",
+    "ReplayReport",
+    "TRACE_VERSION",
+    "TraceReader",
+    "TraceWriter",
+    "config_fingerprint",
+    "config_from_fingerprint",
+    "config_hash",
+    "generate",
+    "replay_trace",
+    "what_if",
+]

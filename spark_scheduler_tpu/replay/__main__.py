@@ -1,0 +1,120 @@
+"""Trace replay CLI (ISSUE 17).
+
+  python -m spark_scheduler_tpu.replay info    TRACE
+  python -m spark_scheduler_tpu.replay verify  TRACE [--strict]
+  python -m spark_scheduler_tpu.replay whatif  TRACE --set binpack-algo=distribute-evenly [...]
+  python -m spark_scheduler_tpu.replay generate {diurnal|bursty|churn} OUT --seed N [...]
+  python -m spark_scheduler_tpu.replay run     TRACE OUT
+
+`verify` re-drives a captured trace and exits non-zero on any decision
+divergence. `run` replays an input-only (generated) trace with binding
+and re-captures it through the live TraceWriter wiring — its output is a
+full captured trace that `verify` can then pin. `--set` takes repeated
+`field=value` pairs (JSON parsed, falling back to raw string; dashes OK).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from spark_scheduler_tpu.replay.engine import replay_trace, what_if
+from spark_scheduler_tpu.replay.generators import GENERATORS, generate
+from spark_scheduler_tpu.replay.trace import TraceReader, config_hash
+
+
+def _parse_sets(pairs: list[str]) -> dict:
+    out = {}
+    for p in pairs:
+        key, sep, raw = p.partition("=")
+        if not sep:
+            raise SystemExit(f"--set expects field=value, got {p!r}")
+        try:
+            out[key] = json.loads(raw)
+        except ValueError:
+            out[key] = raw
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m spark_scheduler_tpu.replay")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("info", help="print a trace's header + event census")
+    p.add_argument("trace")
+
+    p = sub.add_parser("verify", help="replay; report decision divergence")
+    p.add_argument("trace")
+    p.add_argument("--strict", action="store_true",
+                   help="raise on first summary of mismatches")
+
+    p = sub.add_parser("whatif", help="replay base vs overridden config")
+    p.add_argument("trace")
+    p.add_argument("--set", dest="sets", action="append", default=[],
+                   metavar="FIELD=VALUE", required=True)
+
+    p = sub.add_parser("generate", help="emit a synthetic workload trace")
+    p.add_argument("kind", choices=sorted(GENERATORS))
+    p.add_argument("out")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--nodes", type=int, default=None)
+    p.add_argument("--binpack-algo", default=None)
+
+    p = sub.add_parser("run", help="replay with binding; re-capture output")
+    p.add_argument("trace")
+    p.add_argument("out")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "info":
+        r = TraceReader(args.trace)
+        census: dict[str, int] = {}
+        for ev in r.events():
+            k = ev.get("k", "?")
+            census[k] = census.get(k, 0) + 1
+        print(json.dumps({
+            "version": r.header.get("v"),
+            "source": r.header.get("source"),
+            "config_hash": config_hash(r.header["config"]),
+            "meta": r.header.get("meta"),
+            "events": census,
+            "torn_tail": r.torn_tail,
+            "malformed": r.malformed,
+        }, indent=2, sort_keys=True))
+        return 0
+
+    if args.cmd == "verify":
+        rep = replay_trace(args.trace, strict=args.strict)
+        print(json.dumps(rep.summary(), indent=2, sort_keys=True))
+        if rep.mismatches:
+            for m in rep.mismatches[:10]:
+                print(f"MISMATCH {m}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.cmd == "whatif":
+        print(json.dumps(what_if(args.trace, _parse_sets(args.sets)),
+                         indent=2, sort_keys=True))
+        return 0
+
+    if args.cmd == "generate":
+        sizing = {}
+        if args.nodes is not None:
+            sizing["n_nodes"] = args.nodes
+        if args.binpack_algo is not None:
+            sizing["binpack_algo"] = args.binpack_algo
+        stats = generate(args.kind, args.out, args.seed, **sizing)
+        print(json.dumps(stats, indent=2, sort_keys=True))
+        return 0
+
+    if args.cmd == "run":
+        rep = replay_trace(args.trace, record_path=args.out)
+        print(json.dumps(rep.summary(), indent=2, sort_keys=True))
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

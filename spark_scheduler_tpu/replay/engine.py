@@ -1,0 +1,528 @@
+"""Deterministic trace replay + what-if simulation (ISSUE 17, parts b+c).
+
+`replay_trace` boots a backend-free harness — an InMemoryBackend plus the
+full real scheduler from `build_scheduler_app`, under the trace header's
+recorded config — and re-drives the extender event-for-event:
+
+  * node/pod events apply to the backend (tolerantly: an `add` of an
+    existing object becomes an update, a `delete` of a missing one is
+    skipped — mid-life traces bootstrap-journal the world they attached
+    to, so the stream is self-contained either way);
+  * `predicate` events dispatch serving windows through the SAME two-phase
+    API the live serving loop used (`predicate_window_dispatch` /
+    `predicate_window_complete`), completing each window at its recorded
+    `result` event — so backend events that landed between a window's
+    dispatch and its completion replay in the exact pipelined
+    interleaving, epoch bumps and in-flight dedup included;
+  * recorded `result` rows are compared against the replayed verdict /
+    placement / normalized failure map — any divergence is a
+    ReplayMismatch (strict mode raises).
+
+The clock is the trace's: every event's recorded wall time drives a
+monotonic-max ReplayClock the whole app reads, so age thresholds and the
+resync-gap heuristic see what the live run saw.
+
+What-if (`what_if`) replays the same trace twice — once under the
+recorded config, once under overrides — and diffs the two runs:
+placement changes, per-arm p50/p99 decision latency (both re-measured
+in-process, so the comparison is apples-to-apples), denial counts, and
+final-state utilization/fragmentation. Bind events are re-pointed at the
+replaying arm's OWN placements (a pod the variant placed on node Y binds
+to Y, not the recorded X), so each arm's world stays self-consistent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+from spark_scheduler_tpu.replay.trace import (
+    ALL_NODES,
+    TraceReader,
+    config_from_fingerprint,
+    config_hash,
+    encode_result,
+)
+
+# Config fields every replay pins regardless of what the trace recorded:
+# the harness is backend-free (no kube ingestion, no WAL, no HA group, no
+# background loops) and must not re-write the trace it is reading.
+FORCED_FIELDS = dict(
+    sync_writes=True,
+    kube_api_url=None,
+    conversion_webhook_url=None,
+    durable_store_path=None,
+    runtime_config_path=None,
+    metrics_log=None,
+    jax_compilation_cache_dir=None,
+    cert_file=None,
+    key_file=None,
+    ha_enabled=False,
+    autoscaler_enabled=False,
+    debug_routes=False,
+    trace_path=None,
+)
+
+
+class ReplayClock:
+    """Monotonic-max clock fed by event timestamps: the whole replayed app
+    reads the wall time the LIVE run saw at this point of the stream."""
+
+    def __init__(self, t0: float = 0.0):
+        self._t = t0
+
+    def __call__(self) -> float:
+        return self._t
+
+    def set(self, t) -> None:
+        if isinstance(t, (int, float)) and t > self._t:
+            self._t = float(t)
+
+
+class ReplayMismatchError(AssertionError):
+    pass
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    """One replay arm's outcome."""
+
+    config_hash: str = ""
+    events: int = 0
+    decisions: int = 0
+    compared: int = 0
+    mismatches: list = dataclasses.field(default_factory=list)
+    uncompared_windows: int = 0
+    verdict_counts: dict = dataclasses.field(default_factory=dict)
+    denials: int = 0
+    # (namespace, pod_name) -> node, for every placed decision
+    placements: dict = dataclasses.field(default_factory=dict)
+    latencies_ms: list = dataclasses.field(default_factory=list)
+    torn_tail: bool = False
+    malformed: int = 0
+    utilization: dict = dataclasses.field(default_factory=dict)
+    fragmentation: dict = dataclasses.field(default_factory=dict)
+    overcommit: int = 0
+
+    def latency_ms(self, q: float) -> Optional[float]:
+        if not self.latencies_ms:
+            return None
+        xs = sorted(self.latencies_ms)
+        return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3)
+
+    def summary(self) -> dict:
+        return {
+            "config_hash": self.config_hash,
+            "events": self.events,
+            "decisions": self.decisions,
+            "compared": self.compared,
+            "mismatches": len(self.mismatches),
+            "uncompared_windows": self.uncompared_windows,
+            "verdicts": dict(self.verdict_counts),
+            "denials": self.denials,
+            "latency_p50_ms": self.latency_ms(0.50),
+            "latency_p99_ms": self.latency_ms(0.99),
+            "utilization": self.utilization,
+            "fragmentation": self.fragmentation,
+            "overcommit": self.overcommit,
+            "torn_tail": self.torn_tail,
+            "malformed": self.malformed,
+        }
+
+
+class _Pending:
+    """A dispatched-but-uncompleted replay window."""
+
+    __slots__ = ("wid", "ticket", "candidates", "bind", "t0")
+
+    def __init__(self, wid, ticket, candidates, bind, t0):
+        self.wid = wid
+        self.ticket = ticket
+        self.candidates = candidates
+        self.bind = bind
+        self.t0 = t0
+
+
+def replay_trace(
+    trace_path: str,
+    overrides: Optional[dict] = None,
+    strict: bool = False,
+    record_path: Optional[str] = None,
+    progress=None,
+) -> ReplayReport:
+    """Re-drive one trace. `overrides` switches the run into what-if
+    territory (an altered config — recorded results are then informational
+    and comparison is skipped); `record_path` re-captures the replay
+    through the normal TraceWriter wiring, which is how generated
+    input-only traces become full captured traces (`run` mode)."""
+    from spark_scheduler_tpu.core.extender import ExtenderArgs
+    from spark_scheduler_tpu.core.solver import PipelineDrainRequired
+    from spark_scheduler_tpu.server.app import build_scheduler_app
+    from spark_scheduler_tpu.server.kube_io import node_from_k8s, pod_from_k8s
+    from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
+
+    reader = TraceReader(trace_path)
+    header = reader.header
+    compare = not overrides
+    config = config_from_fingerprint(
+        header["config"],
+        overrides=overrides,
+        forced={**FORCED_FIELDS, "trace_path": record_path},
+    )
+    report = ReplayReport(config_hash=config_hash(header["config"]))
+
+    backend = InMemoryBackend()
+    backend.register_crd(DEMAND_CRD)
+    clock = ReplayClock(float(header.get("t") or 0.0))
+    app = build_scheduler_app(backend, config, clock=clock)
+    ext = app.extender
+    meta = header.get("meta") or {}
+    if meta.get("resync_suppressed"):
+        ext._last_request = float("inf")
+        # carry the suppression into a re-capture trace (its header is
+        # written by build_scheduler_app, which doesn't know this meta)
+        if app.trace_writer is not None:
+            app.trace_writer.emit_meta(resync_suppressed=True)
+
+    roster: list[str] = []  # mirror of the WRITER's roster, for "*"
+    pending: list[_Pending] = []
+    parked: dict[int, tuple] = {}  # wid -> (results, candidates, ms)
+    placed: dict[tuple, str] = {}
+
+    def expand(names) -> list[str]:
+        return list(roster) if names == ALL_NODES else list(names)
+
+    def note_results(p: _Pending, results, ms: float) -> None:
+        per_decision = ms / max(1, len(results))
+        for args, res in zip(p.ticket.args_list, results):
+            report.decisions += 1
+            report.latencies_ms.append(per_decision)
+            report.verdict_counts[res.outcome] = (
+                report.verdict_counts.get(res.outcome, 0) + 1
+            )
+            if res.outcome.startswith("failure"):
+                report.denials += 1
+            key = (args.pod.namespace, args.pod.name)
+            if res.node_names:
+                placed[key] = res.node_names[0]
+                report.placements[key] = res.node_names[0]
+            if p.bind and res.node_names:
+                cur = backend.get("pods", args.pod.namespace, args.pod.name)
+                if cur is not None and not cur.node_name:
+                    backend.bind_pod(cur, res.node_names[0])
+
+    def force_complete(p: _Pending) -> None:
+        t0 = time.perf_counter()
+        results = ext.predicate_window_complete(p.ticket)
+        ms = (time.perf_counter() - t0 + p.t0) * 1e3
+        note_results(p, results, ms)
+        parked[p.wid] = (results, p.candidates, ms)
+
+    def dispatch(args_list, candidates, wid, bind) -> None:
+        t0 = time.perf_counter()
+        for _ in range(4):
+            try:
+                ticket = ext.predicate_window_dispatch(args_list)
+                break
+            except PipelineDrainRequired:
+                # The live loop drained and retried here too; its drained
+                # results are already behind us in the stream (journaled
+                # before this predicate event), so the pending list SHOULD
+                # be empty — but mirror the contract defensively.
+                if not pending:
+                    raise
+                force_complete(pending.pop(0))
+        else:
+            raise AssertionError("dispatch kept raising PipelineDrainRequired")
+        p = _Pending(wid, ticket, candidates, bind, time.perf_counter() - t0)
+        if bind and "result" not in bind_modes:
+            # Input-only (generated) trace: no result event will arrive —
+            # complete immediately so binds land before the next event.
+            results = ext.predicate_window_complete(p.ticket)
+            ms = (time.perf_counter() - t0) * 1e3
+            note_results(p, results, ms)
+        else:
+            pending.append(p)
+
+    # Input-only traces (generators) carry bind-predicates and no result
+    # events; captured traces carry result events (and re-captured "run"
+    # traces both). Sniff which shape this stream is once, up front.
+    bind_modes: set = set()
+    events = list(reader.events())
+    for ev in events:
+        if ev.get("k") == "result":
+            bind_modes.add("result")
+            break
+
+    for ev in events:
+        report.events += 1
+        if progress is not None and report.events % 5000 == 0:
+            progress(report.events)
+        clock.set(ev.get("t"))
+        k = ev.get("k")
+        if k == "node":
+            op = ev["op"]
+            if op == "delete":
+                name = ev["name"]
+                if name in roster:
+                    roster.remove(name)
+                if backend.get("nodes", "", name) is not None:
+                    backend.delete("nodes", "", name)
+            else:
+                node = node_from_k8s(ev["node"])
+                if op == "add" and node.name not in roster:
+                    roster.append(node.name)
+                if backend.get("nodes", "", node.name) is None:
+                    backend.add_node(node)
+                else:
+                    backend.update("nodes", node)
+        elif k == "pod":
+            op = ev["op"]
+            if op == "delete":
+                if backend.get("pods", ev["ns"], ev["name"]) is not None:
+                    backend.delete("pods", ev["ns"], ev["name"])
+            else:
+                pod = pod_from_k8s(ev["pod"])
+                if pod.node_name:
+                    # Re-point binds at THIS arm's placement so the world
+                    # stays self-consistent under what-if configs (under
+                    # the recorded config the two coincide bit-for-bit).
+                    own = placed.get((pod.namespace, pod.name))
+                    if own is not None and own != pod.node_name:
+                        pod = dataclasses.replace(pod, node_name=own)
+                if backend.get("pods", pod.namespace, pod.name) is None:
+                    backend.add_pod(pod)
+                else:
+                    backend.update_pod(pod)
+        elif k == "rr":
+            from spark_scheduler_tpu.store.durable import _rr_from_record
+
+            rr = _rr_from_record(ev["rr"])
+            if app.rr_cache.get(rr.namespace, rr.name) is None:
+                app.rr_cache.create(rr)
+        elif k == "rr_delete":
+            if app.rr_cache.get(ev["ns"], ev["name"]) is not None:
+                app.rr_cache.delete(ev["ns"], ev["name"])
+            # Directives are INPUTS the backend subscriptions can't see
+            # (the writer only watches nodes/pods — scheduler-originated
+            # RR writes must stay un-journaled). Forward them into a
+            # re-capture trace by hand or its verify run would drift.
+            if app.trace_writer is not None:
+                app.trace_writer.emit_rr_delete(ev["ns"], ev["name"])
+        elif k == "reconcile":
+            app.reconciler.sync_resource_reservations_and_demands()
+            if app.trace_writer is not None:
+                app.trace_writer.emit_reconcile()
+        elif k == "meta":
+            if ev.get("resync_suppressed"):
+                ext._last_request = float("inf")
+            if app.trace_writer is not None:
+                app.trace_writer.emit_meta(
+                    **{a: b for a, b in ev.items() if a not in ("k", "s", "t")}
+                )
+        elif k == "predicate":
+            wid = ev["w"]
+            candidates = [expand(r["nodes"]) for r in ev["reqs"]]
+
+            def resolve(r):
+                if "ref" in r:
+                    ns, name = r["ref"]
+                    pod = backend.get("pods", ns, name)
+                    if pod is None:
+                        raise AssertionError(
+                            f"trace ref to unknown pod {ns}/{name}"
+                        )
+                    return pod
+                return pod_from_k8s(r["pod"])
+
+            args_list = [
+                ExtenderArgs(pod=resolve(r), node_names=c)
+                for r, c in zip(ev["reqs"], candidates)
+            ]
+            bind = bool(ev.get("bind"))
+            if ev.get("mode") == "solo":
+                t0 = time.perf_counter()
+                res = ext.predicate(args_list[0])
+                ms = (time.perf_counter() - t0) * 1e3
+                p = _Pending(wid, None, candidates, bind, 0.0)
+                p.ticket = type("T", (), {"args_list": args_list})()
+                note_results(p, [res], ms)
+                parked[wid] = ([res], candidates, ms)
+            else:
+                dispatch(args_list, candidates, wid, bind)
+        elif k == "result":
+            wid = ev["w"]
+            if wid in parked:
+                results, candidates, ms = parked.pop(wid)
+            else:
+                # Completions are FIFO: anything older than this wid in
+                # the pipeline completes (parking its results) first.
+                while pending and pending[0].wid != wid:
+                    force_complete(pending.pop(0))
+                if not pending:
+                    continue  # result for a window we never saw dispatch
+                p = pending.pop(0)
+                t0 = time.perf_counter()
+                results = ext.predicate_window_complete(p.ticket)
+                ms = (time.perf_counter() - t0 + p.t0) * 1e3
+                note_results(p, results, ms)
+                candidates = p.candidates
+            if compare:
+                for i, (res, rec) in enumerate(zip(results, ev["res"])):
+                    got = encode_result(res, candidates[i])
+                    if got != rec:
+                        report.mismatches.append(
+                            {
+                                "window": wid,
+                                "index": i,
+                                "recorded": rec,
+                                "replayed": got,
+                            }
+                        )
+                report.compared += len(ev["res"])
+        # decision events are informational (the recorder's own records
+        # ride the replayed app's recorder) — skipped.
+
+    while pending:
+        report.uncompared_windows += 1
+        force_complete(pending.pop(0))
+
+    report.torn_tail = reader.torn_tail
+    report.malformed = reader.malformed
+    _final_state_metrics(app, backend, report)
+    if record_path and app.trace_writer is not None:
+        app.trace_writer.close()
+    app.solver.close()
+    if strict and report.mismatches:
+        raise ReplayMismatchError(
+            f"{len(report.mismatches)} replay mismatches "
+            f"(of {report.compared} compared decisions); first: "
+            f"{report.mismatches[0]}"
+        )
+    return report
+
+
+def _final_state_metrics(app, backend, report: ReplayReport) -> None:
+    """End-of-trace cluster posture: reserved utilization, stranded free
+    capacity on partially-used nodes (the fragmentation proxy a binpack
+    strategy moves), and the over-commit invariant."""
+    from spark_scheduler_tpu.testing.harness import overcommit_violations
+
+    nodes = backend.list_nodes()
+    if not nodes:
+        return
+    usage = app.reservation_manager.get_reserved_resources()
+    total = {"cpu": 0.0, "memory": 0.0}
+    used = {"cpu": 0.0, "memory": 0.0}
+    stranded = {"cpu": 0.0, "memory": 0.0}
+    for n in nodes:
+        total["cpu"] += n.allocatable.cpu_milli
+        total["memory"] += n.allocatable.mem_kib
+        u = usage.get(n.name)
+        if u is None:
+            continue
+        used["cpu"] += u.cpu_milli
+        used["memory"] += u.mem_kib
+        if u.cpu_milli > 0 or u.mem_kib > 0:
+            stranded["cpu"] += max(0, n.allocatable.cpu_milli - u.cpu_milli)
+            stranded["memory"] += max(0, n.allocatable.mem_kib - u.mem_kib)
+    report.utilization = {
+        r: round(used[r] / total[r], 4) if total[r] else 0.0 for r in total
+    }
+    report.fragmentation = {
+        r: round(stranded[r] / total[r], 4) if total[r] else 0.0
+        for r in total
+    }
+    try:
+        report.overcommit = len(overcommit_violations(app, backend))
+    except Exception:
+        report.overcommit = -1
+
+
+# ----------------------------------------------------------------- what-if
+
+
+def what_if(trace_path: str, overrides: dict) -> dict:
+    """Replay under the recorded config AND under `overrides`; emit the
+    structured diff report (ISSUE 17 part c). The base arm's mismatch
+    count doubles as the report's confidence check: a non-zero base
+    mismatch means the trace itself doesn't replay cleanly and every
+    delta should be read with suspicion."""
+    base = replay_trace(trace_path)
+    variant = replay_trace(trace_path, overrides=overrides)
+
+    same = changed = 0
+    moves = []
+    for key, node in base.placements.items():
+        v = variant.placements.get(key)
+        if v is None:
+            continue
+        if v == node:
+            same += 1
+        else:
+            changed += 1
+            if len(moves) < 50:
+                moves.append(
+                    {"pod": f"{key[0]}/{key[1]}", "base": node, "variant": v}
+                )
+    only_base = sum(
+        1 for k in base.placements if k not in variant.placements
+    )
+    only_variant = sum(
+        1 for k in variant.placements if k not in base.placements
+    )
+
+    def delta(a, b):
+        if a is None or b is None:
+            return None
+        return round(b - a, 4)
+
+    return {
+        "trace": trace_path,
+        "overrides": dict(overrides),
+        "base_config_hash": base.config_hash,
+        "base_mismatches": len(base.mismatches),
+        "decisions": {"base": base.decisions, "variant": variant.decisions},
+        "verdicts": {
+            "base": dict(base.verdict_counts),
+            "variant": dict(variant.verdict_counts),
+        },
+        "denials": {
+            "base": base.denials,
+            "variant": variant.denials,
+            "delta": variant.denials - base.denials,
+        },
+        "placements": {
+            "same": same,
+            "changed": changed,
+            "only_base": only_base,
+            "only_variant": only_variant,
+            "moves_sample": moves,
+        },
+        "latency_ms": {
+            "base": {"p50": base.latency_ms(0.5), "p99": base.latency_ms(0.99)},
+            "variant": {
+                "p50": variant.latency_ms(0.5),
+                "p99": variant.latency_ms(0.99),
+            },
+            "p50_delta": delta(base.latency_ms(0.5), variant.latency_ms(0.5)),
+            "p99_delta": delta(base.latency_ms(0.99), variant.latency_ms(0.99)),
+        },
+        "utilization": {
+            "base": base.utilization,
+            "variant": variant.utilization,
+            "cpu_delta": delta(
+                base.utilization.get("cpu"), variant.utilization.get("cpu")
+            ),
+        },
+        "fragmentation": {
+            "base": base.fragmentation,
+            "variant": variant.fragmentation,
+            "cpu_delta": delta(
+                base.fragmentation.get("cpu"),
+                variant.fragmentation.get("cpu"),
+            ),
+        },
+        "overcommit": {"base": base.overcommit, "variant": variant.overcommit},
+    }

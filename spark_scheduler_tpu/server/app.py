@@ -52,6 +52,7 @@ class SchedulerApp:
     runtime_manager: object | None = None  # RuntimeConfigManager when configured
     autoscaler: object | None = None  # ElasticAutoscaler when enabled
     recorder: object | None = None  # FlightRecorder when flight_recorder is on
+    trace_writer: object | None = None  # replay.TraceWriter when trace_path set
     _background_started: bool = False
 
     def start_background(self) -> None:
@@ -93,6 +94,8 @@ class SchedulerApp:
         self.rr_cache.stop()
         self.demand_cache.flush()
         self.demand_cache.stop()
+        if self.trace_writer is not None:
+            self.trace_writer.close()
         self.solver.close()
 
 
@@ -267,6 +270,47 @@ def build_scheduler_app(
         solver.telemetry = SolverTelemetry(
             metrics.registry if metrics is not None else None
         )
+    trace_writer = None
+    if config.trace_path:
+        if recorder is None:
+            import warnings
+
+            warnings.warn(
+                "trace.path set but the flight recorder is disabled — "
+                "decision tracing requires flight-recorder: true; "
+                "no trace will be written",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        else:
+            # Durable decision trace (ISSUE 17): header (config
+            # fingerprint) -> bootstrap journal of the pre-existing world
+            # -> live event hooks. The sink rides the recorder, so the
+            # extender's capture wrappers cost one attribute check when
+            # tracing is off.
+            from spark_scheduler_tpu.replay.trace import TraceWriter
+
+            trace_writer = TraceWriter(
+                config.trace_path,
+                clock=clock,
+                decisions=config.trace_decisions,
+                epoch_fn=lambda: getattr(backend, "nodes_version", None),
+            )
+            trace_writer.write_header(config)
+            trace_writer.bootstrap(backend)
+            recorder.attach_sink(trace_writer)
+            backend.subscribe(
+                "nodes",
+                on_add=trace_writer.on_node_add,
+                on_update=trace_writer.on_node_update,
+                on_delete=trace_writer.on_node_delete,
+            )
+            backend.subscribe(
+                "pods",
+                on_add=trace_writer.on_pod_add,
+                on_update=trace_writer.on_pod_update,
+                on_delete=trace_writer.on_pod_delete,
+            )
     # Degraded-mode controller (ISSUE 9): when no device slot can serve,
     # the solver consults this policy — host greedy fallback or
     # 503+Retry-After shedding. Readiness and /debug/state reflect it.
@@ -463,6 +507,7 @@ def build_scheduler_app(
         ingestion=ingestion,
         autoscaler=autoscaler,
         recorder=recorder,
+        trace_writer=trace_writer,
     )
     if config.runtime_config_path:
         from spark_scheduler_tpu.server.runtime import RuntimeConfigManager

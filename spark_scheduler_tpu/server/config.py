@@ -221,6 +221,19 @@ class InstallConfig:
     # cost measured; False strips both for the control measurement.
     flight_recorder: bool = True
     flight_recorder_capacity: int = 2048
+    # Durable decision trace (spark_scheduler_tpu/replay/, ISSUE 17): when
+    # a path is set (and the flight recorder is on), a TraceWriter journals
+    # every input a decision consumed — node/pod events, predicate
+    # requests, the config fingerprint — plus the answered verdicts, as a
+    # versioned JSONL stream `python -m spark_scheduler_tpu.replay` can
+    # re-execute bit-identically or what-if under an altered config.
+    #   trace: {path, decisions}
+    # `decisions: true` additionally journals the informational
+    # DecisionRecord copies (replay never needs them — the result events
+    # carry every compared verdict — and they roughly double the
+    # serving-path encode cost, so they are opt-in).
+    trace_path: Optional[str] = None
+    trace_decisions: bool = False
     # Active-active HA (spark_scheduler_tpu/ha/): run this process as one
     # replica of a lease-elected group. The replica starts as a warm
     # standby (caches tailed hot from backend events / the shared WAL) and
@@ -419,6 +432,7 @@ class InstallConfig:
         solver_block = raw.get("solver") or {}
         mesh_block = solver_block.get("mesh") or {}
         ha_block = raw.get("ha") or {}
+        trace_block = raw.get("trace") or {}
         extender_block = raw.get("extender") or {}
         retry_block = raw.get("retry") or {}
         policy_block = raw.get("policy") or {}
@@ -544,6 +558,8 @@ class InstallConfig:
             flight_recorder_capacity=int(
                 raw.get("flight-recorder-capacity", 2048)
             ),
+            trace_path=trace_block.get("path", raw.get("trace-path")),
+            trace_decisions=bool(block_key(trace_block, "decisions", False)),
             ha_enabled=bool(block_key(ha_block, "enabled", False)),
             ha_replica_id=str(
                 block_key(ha_block, "replica-id", "replica-0")

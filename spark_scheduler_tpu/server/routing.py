@@ -346,6 +346,11 @@ class SchedulerRoutes(SyncRoutes):
             return json_response(200, {"spans": tracer().finished_spans()})
         if path == "/debug/decisions" and s.debug_routes:
             return self._debug_decisions(req)
+        if path == "/debug/trace" and s.debug_routes:
+            tw = getattr(s.app, "trace_writer", None)
+            if tw is None:
+                return json_response(404, {"error": "trace sink disabled"})
+            return json_response(200, tw.stats())
         if path == "/debug/state" and s.debug_routes:
             from spark_scheduler_tpu.observability import debug_state_snapshot
 
@@ -390,6 +395,26 @@ class SchedulerRoutes(SyncRoutes):
                     if isinstance(v, (int, float))
                 }
             )
+            recorder = getattr(s.app, "recorder", None)
+            if recorder is not None:
+                # ring-overflow drops are THE signal that forensic history
+                # is being lost — export alongside the other ring stats
+                extra.update(
+                    {
+                        f"foundry.spark.scheduler.recorder.{k}": v
+                        for k, v in recorder.stats().items()
+                        if isinstance(v, (int, float))
+                    }
+                )
+            tw = getattr(s.app, "trace_writer", None)
+            if tw is not None:
+                extra.update(
+                    {
+                        f"foundry.spark.scheduler.trace.{k}": v
+                        for k, v in tw.stats().items()
+                        if isinstance(v, (int, float))
+                    }
+                )
             return text_response(
                 200,
                 render_prometheus(snap, extra_gauges=extra),
@@ -398,6 +423,12 @@ class SchedulerRoutes(SyncRoutes):
         snap["predicate_batcher"] = s.batcher.stats()
         snap["server_transport"] = s.transport_stats()
         snap["server_ingest"] = getattr(s, "ingest_stats", dict)()
+        recorder = getattr(s.app, "recorder", None)
+        if recorder is not None:
+            snap["flight_recorder"] = recorder.stats()
+        tw = getattr(s.app, "trace_writer", None)
+        if tw is not None:
+            snap["trace"] = tw.stats()
         return json_response(200, snap)
 
     def _debug_decisions(self, req: Request) -> Response:
@@ -408,15 +439,24 @@ class SchedulerRoutes(SyncRoutes):
             limit = int(req.q("limit") or 100)
         except ValueError:
             return json_response(400, {"error": "bad limit"})
+        since_seq = req.q("since_seq")
+        if since_seq is not None:
+            try:
+                since_seq = int(since_seq)
+            except ValueError:
+                return json_response(400, {"error": "bad since_seq"})
         return json_response(
             200,
             {
                 "decisions": recorder.query(
-                    app=req.q("app"),
+                    # `app_id` aliases `app` (the label the records carry)
+                    app=req.q("app") or req.q("app_id"),
                     verdict=req.q("verdict"),
                     role=req.q("role"),
                     namespace=req.q("namespace"),
                     limit=limit,
+                    instance_group=req.q("instance_group"),
+                    since_seq=since_seq,
                 ),
                 "recorder": recorder.stats(),
             },

@@ -60,11 +60,14 @@ class SoakClock:
 class Soak:
     def __init__(
         self, rng, strategy, n_nodes: int = 12, elastic: bool = False,
-        backend=None,
+        backend=None, trace_path=None,
     ):
         self.rng = rng
         self.elastic = elastic
         self.clock = SoakClock() if elastic else None
+        # Decision-trace capture (ISSUE 17): route the whole run through
+        # the live TraceWriter wiring so CI can replay it bit-identically.
+        trace_kw = {"trace_path": trace_path} if trace_path else {}
         # same_az under single-az strategies: without it the extender's
         # zone-restriction gate (is_single_az AND same-az-dynalloc config)
         # stays False and the zone-restricted executor-reschedule ladder —
@@ -91,8 +94,10 @@ class Soak:
             # Injected backend (e.g. a DurableBackend so the chaos matrix
             # can fault the WAL surface); default in-memory.
             backend=backend,
+            **trace_kw,
             **elastic_kw,
         )
+        self.trace = self.h.app.trace_writer
         self.node_seq = 0
         self.nodes: dict[str, object] = {}
         for _ in range(n_nodes):
@@ -247,6 +252,11 @@ class Soak:
         rr = self.h.get_reservation("namespace", app_id)
         if rr is not None:
             self.h.app.rr_cache.delete(rr.namespace, rr.name)
+            if self.trace is not None:
+                # Operator-initiated RR deletion is an INPUT: the trace
+                # writer's backend hooks only watch nodes/pods (scheduler-
+                # originated RR writes are outputs), so journal it here.
+                self.trace.emit_rr_delete(rr.namespace, rr.name)
 
     def op_node_churn(self):
         self.drain()  # topology changes force a drain in the serving loop
@@ -285,6 +295,8 @@ class Soak:
         self.drain()
         if self.ext._reconciler is not None:
             self.ext._reconciler.sync_resource_reservations_and_demands()
+            if self.trace is not None:
+                self.trace.emit_reconcile()
 
     def op_write_fault(self):
         """One faulted reservation write: the request fails internal and
@@ -490,6 +502,11 @@ class Soak:
 
     def run(self, steps):
         ops = self.OPS + (self.ELASTIC_OPS if self.elastic else ())
+        if self.trace is not None:
+            # Injected faults are not part of the replayable input surface
+            # (replay has no FaultInjector schedule), so a recorded soak
+            # drives every op EXCEPT write faults.
+            ops = tuple(o for o in ops if o[0] != "write_fault")
         names = [name for name, w, _ in ops for _ in range(w)]
         fns = {name: fn for name, _, fn in ops}
         while self.steps < steps:

@@ -122,6 +122,8 @@ def test_debug_decisions_metrics_and_state_smoke(server):
     snap.pop("predicate_batcher", None)
     snap.pop("server_transport", None)  # stats surface, not a registry series
     snap.pop("server_ingest", None)  # ditto (ingest-lane stats surface)
+    snap.pop("flight_recorder", None)  # ditto (ring stats surface)
+    snap.pop("trace", None)  # ditto (trace-sink stats surface)
     assert any(
         name.startswith("foundry.spark.scheduler.solver.") for name in snap
     ), sorted(snap)
@@ -241,3 +243,110 @@ def test_recorder_ring_is_bounded():
     newest = rec.query(limit=100)
     assert len(newest) == 8
     assert newest[0]["pod_name"] == "p19" and newest[-1]["pod_name"] == "p12"
+
+
+def test_decision_filters_instance_group_and_since_seq(server):
+    """ISSUE 17 satellite: /debug/decisions grows app_id / instance_group /
+    since_seq filters (incident triage: tail by last-seen seq)."""
+    port = server.port
+    backend = server.app.backend
+    names = [f"n{i}" for i in range(4)]
+    for i in range(3):
+        pods = static_allocation_spark_pods(f"filt-{i}", 1)
+        backend.add_pod(pods[0])
+        assert _post_predicate(port, pods[0], names)["NodeNames"]
+
+    # app_id aliases app
+    status, _, body = _get(port, "/debug/decisions?app_id=filt-1")
+    assert status == 200
+    recs = json.loads(body)["decisions"]
+    assert recs and all(r["app_id"] == "filt-1" for r in recs)
+
+    # instance_group filter: everything here is in the default group
+    status, _, body = _get(
+        port, "/debug/decisions?instance_group=batch-medium-priority"
+    )
+    assert status == 200 and json.loads(body)["decisions"]
+    status, _, body = _get(port, "/debug/decisions?instance_group=nope")
+    assert status == 200 and json.loads(body)["decisions"] == []
+
+    # since_seq keeps only NEWER records; polling with the max seq
+    # returns nothing new
+    status, _, body = _get(port, "/debug/decisions?limit=100")
+    all_recs = json.loads(body)["decisions"]
+    top = max(r["seq"] for r in all_recs)
+    status, _, body = _get(port, f"/debug/decisions?since_seq={top - 1}")
+    newer = json.loads(body)["decisions"]
+    assert [r["seq"] for r in newer] == [top]
+    status, _, body = _get(port, f"/debug/decisions?since_seq={top}")
+    assert json.loads(body)["decisions"] == []
+    status, _, _ = _get(port, "/debug/decisions?since_seq=bogus")
+    assert status == 400
+
+
+def test_recorder_dropped_exported_on_metrics(server):
+    """ISSUE 17 satellite: ring-overflow drops ride /metrics as
+    foundry.spark.scheduler.recorder.dropped (both formats)."""
+    port = server.port
+    status, ctype, body = _get(
+        port, "/metrics", headers={"Accept": "text/plain"}
+    )
+    assert status == 200 and ctype.startswith("text/plain")
+    assert "foundry_spark_scheduler_recorder_dropped" in body.decode()
+    status, _, body = _get(port, "/metrics")
+    snap = json.loads(body)
+    assert snap["flight_recorder"]["dropped"] >= 0
+    # and /debug/state carries the same ring stats
+    status, _, body = _get(port, "/debug/state")
+    assert json.loads(body)["flight_recorder"]["dropped"] >= 0
+
+
+def test_debug_trace_route(tmp_path):
+    """/debug/trace surfaces the trace sink's counters when a trace is
+    being written, 404s when not, and stays gated without debug_routes."""
+    backend = InMemoryBackend()
+    backend.register_crd(DEMAND_CRD)
+    backend.add_node(new_node("n0"))
+    trace_path = str(tmp_path / "t.jsonl")
+    app = build_scheduler_app(
+        backend,
+        InstallConfig(
+            sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+            debug_routes=True,
+            trace_path=trace_path,
+        ),
+    )
+    srv = SchedulerHTTPServer(
+        app, MetricRegistry(), port=0, debug_routes=True,
+        request_timeout_s=120.0,
+    )
+    srv.start()
+    try:
+        status, _, body = _get(srv.port, "/debug/trace")
+        assert status == 200
+        stats = json.loads(body)
+        assert stats["path"] == trace_path
+        assert stats["events"] >= 2  # header + bootstrap node
+        assert stats["write_errors"] == 0
+        # Prometheus side carries the sink counters too
+        status, _, body = _get(
+            srv.port, "/metrics", headers={"Accept": "text/plain"}
+        )
+        assert "foundry_spark_scheduler_trace_write_errors" in body.decode()
+    finally:
+        srv.stop()
+
+    # no sink -> 404 even with debug routes on
+    backend2 = InMemoryBackend()
+    app2 = build_scheduler_app(backend2, InstallConfig(sync_writes=True))
+    srv2 = SchedulerHTTPServer(
+        app2, MetricRegistry(), port=0, debug_routes=True,
+        request_timeout_s=120.0,
+    )
+    srv2.start()
+    try:
+        status, _, _ = _get(srv2.port, "/debug/trace")
+        assert status == 404
+    finally:
+        srv2.stop()
