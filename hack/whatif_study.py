@@ -1,16 +1,20 @@
-"""What-if strategy study (replay subsystem): tightly-pack vs
-distribute-evenly on one generated multi-tenant trace at 10k nodes.
+"""What-if grid study (replay subsystem): the binpack plug-board × prune
+{on, off} swept over ONE generated multi-tenant trace in one batched
+multi-arm replay (replay/sweep.py, ISSUE 18).
 
-The trace is generated once (bursty multi-tenant, seeded), replayed under
-its recorded config (base arm — also the bit-identity confidence check),
-then replayed under `binpack-algo: distribute-evenly` via the what-if
-engine. The diff that comes back is the study: placement churn, denial
-delta, fragmentation delta, and per-arm replay latency (both arms
-re-measured in this process, so the latency comparison is fair).
+The trace is generated once (bursty multi-tenant, seeded), then every arm
+of the grid replays concurrently over one shared host build: arms whose
+configs differ only in identity-pinned knobs share a decision stream,
+compatible windows solve as stacked cross-arm device dispatches, and the
+sweep telemetry (streams, stacked dispatches, lane fallbacks, shared-build
+hits, windows/s) is part of the study output. The base arm doubles as the
+bit-identity confidence check against the recorded decisions.
 
 One JSON document on stdout; standalone:
     python hack/whatif_study.py
 Env: WHATIF_NODES="10000"  WHATIF_BURSTS="10"  WHATIF_SEED="7"
+     WHATIF_GRID="full" for 5 strategies x prune {on,off} (default is the
+     2-strategy CI-sized grid)  WHATIF_MARKDOWN="1" for the table too.
 """
 
 from __future__ import annotations
@@ -28,11 +32,21 @@ sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
 )
 
-from spark_scheduler_tpu.replay import generate, what_if
+from spark_scheduler_tpu.replay import generate, grid_arms, run_sweep
 
 NODES = int(os.environ.get("WHATIF_NODES", "10000"))
 BURSTS = int(os.environ.get("WHATIF_BURSTS", "10"))
 SEED = int(os.environ.get("WHATIF_SEED", "7"))
+FULL = os.environ.get("WHATIF_GRID", "") == "full"
+
+STRATEGIES_FULL = (
+    "tightly-pack",
+    "distribute-evenly",
+    "minimal-fragmentation",
+    "single-az-tightly-pack",
+    "single-az-minimal-fragmentation",
+)
+STRATEGIES_CI = ("tightly-pack", "distribute-evenly")
 
 
 def main() -> None:
@@ -50,26 +64,44 @@ def main() -> None:
     )
     gen_s = time.perf_counter() - t0
 
+    strategies = STRATEGIES_FULL if FULL else STRATEGIES_CI
+    arms = grid_arms(
+        {
+            "binpack_algo": list(strategies),
+            "solver_prune_top_k": [0, 64],
+        }
+    )
     t0 = time.perf_counter()
-    diff = what_if(trace, {"binpack-algo": "distribute-evenly"})
+    sweep = run_sweep(trace, arms)
     study_s = time.perf_counter() - t0
 
+    # The recorded config is arm 0 (tightly-pack, no explicit prune): its
+    # replay must bit-match the recorded decisions.
+    base_mismatches = sum(
+        len(r.mismatches) for r in sweep.reports[:1]
+    )
     doc = {
-        "study": "binpack-algo: tightly-pack (recorded) vs distribute-evenly",
+        "study": (
+            f"binpack plug-board x prune {{off,on}} grid, "
+            f"{len(arms)} arms / {sweep.telemetry['streams']} streams"
+        ),
         "nodes": NODES,
         "bursts": BURSTS,
         "seed": SEED,
         "trace_events": stats["events"],
         "trace_bytes": stats["bytes"],
         "generate_s": round(gen_s, 2),
-        "whatif_s": round(study_s, 2),
-        "diff": diff,
+        "sweep_s": round(study_s, 2),
+        "base_mismatches": base_mismatches,
+        "sweep": sweep.summary(),
     }
     json.dump(doc, sys.stdout, indent=2, default=str)
     print()
-    if diff["base_mismatches"]:
+    if os.environ.get("WHATIF_MARKDOWN"):
+        print(sweep.markdown(), file=sys.stderr)
+    if base_mismatches:
         print(
-            f"WARNING: base arm had {diff['base_mismatches']} mismatches — "
+            f"WARNING: base arm had {base_mismatches} mismatches — "
             "deltas suspect",
             file=sys.stderr,
         )

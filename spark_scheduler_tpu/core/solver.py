@@ -1302,6 +1302,20 @@ class PlacementSolver:
         # How the LAST pipelined/cached build reached the device
         # ("full" | "delta" | "reuse") — flight-recorder state_upload.
         self.last_state_upload: str | None = None
+        # Replay-sweep coordination (ISSUE 18, replay/sweep.py) — BOTH are
+        # None on every serving path. `_sweep_lane` lets the sweep driver
+        # intercept the pipelined XLA window solve and defer it into a
+        # cross-arm stacked dispatch (arm_stacked_fifo_pack); `_sweep_shared`
+        # is a cross-lane candidate-mask memo (the roster/registry state is
+        # arm-invariant, so lane 2..M reuse lane 1's mask build instead of
+        # re-walking the name->row map). `_row_bucket_quantum` stays 32 for
+        # serving (compile-cache coarseness on live traffic); sweep lanes
+        # drop it to 8 — under vmap padding rows EXECUTE (lax.cond lowers to
+        # select), so tight buckets are pure win there and the sweep
+        # pre-compiles its buckets up front anyway.
+        self._sweep_lane = None
+        self._sweep_shared: dict | None = None
+        self._row_bucket_quantum = 32
         # In-flight worker/fetch futures, cancelled (if unstarted) on
         # close() so repeated server restarts drain the shared pools'
         # queues instead of leaking device buffers through parked closures.
@@ -3268,8 +3282,18 @@ class PlacementSolver:
             if mask is not None:
                 return mask
             patched = self._cand_try_patch(names, n, epoch)
+            shared = self._sweep_shared
             if patched is not None:
                 mask, unresolved, removed = patched
+            elif shared is not None and key in shared:
+                # Replay sweep (ISSUE 18): the registry state is
+                # arm-invariant (node events are inputs, not decisions), so
+                # a sibling lane's mask for the same (n, epoch, ticket) is
+                # THIS lane's mask — reuse it instead of re-walking the
+                # name->row map. Validated by the same seqlock below.
+                mask, unresolved = shared[key]
+                removed = set()
+                shared["__hits__"] = shared.get("__hits__", 0) + 1
             else:
                 mask, unresolved = _build()
                 removed = set()
@@ -3277,6 +3301,8 @@ class PlacementSolver:
             # after it — otherwise the mask may mix old and new name->index
             # mappings; rebuild.
             if self.registry.epoch == epoch:
+                if shared is not None and key not in shared:
+                    shared[key] = (mask, unresolved)
                 self._cand_cache.put(key, mask)
                 self._cand_patch.put(
                     names, (epoch, n, mask, unresolved, removed)
@@ -3791,23 +3817,38 @@ class PlacementSolver:
                         emax=emax, num_zones=self._num_zones_bucket(),
                     )
                 else:
-                    row_bucket = _bucket(b, 32)
+                    row_bucket = _bucket(b, self._row_bucket_quantum)
                     apps = make_app_batch(
                         drv_arr,
                         exc_arr,
                         counts,
                         skippable=skip_arr,
-                        # Coarse row bucket (32): window row counts jitter with
-                        # load and FIFO depth; each distinct bucket is a fresh
-                        # XLA compile, which on a remote TPU stalls live
-                        # serving for seconds.
+                        # Coarse row bucket (32 on serving paths): window row
+                        # counts jitter with load and FIFO depth; each
+                        # distinct bucket is a fresh XLA compile, which on a
+                        # remote TPU stalls live serving for seconds.
                         pad_to=row_bucket,
                         driver_cand=np.stack(cand_rows),
                         domain=np.stack(dom_rows),
                         commit=commit,
                         reset=reset,
                     )
-                    if pipelined:
+                    if pipelined and self._sweep_lane is not None:
+                        # Replay sweep (ISSUE 18): don't solve yet — park the
+                        # window with the sweep coordinator, which stacks it
+                        # with the other arms' same-window payloads into ONE
+                        # arm-vmapped dispatch at the lockstep barrier. The
+                        # returned blob/avail are lazy stand-ins resolved at
+                        # flush (or singly, on a forced early fetch).
+                        blob, avail_after = self._sweep_lane.defer_window(
+                            self, apps,
+                            avail=tensors.available,
+                            statics=cluster_statics(tensors),
+                            host=host,
+                            fill=strategy, emax=emax,
+                            num_zones=self._num_zones_bucket(),
+                        )
+                    elif pipelined:
                         # Double-buffered committed base: the pipeline owns the
                         # availability buffer exclusively (nothing reads it
                         # after this dispatch), so DONATE it — available_after
@@ -3905,13 +3946,21 @@ class PlacementSolver:
         handle.dispatched_at = self._clock()
         if pipelined:
             p["unfetched"].append(handle)
-            # Start the device->host pull NOW on the fetch thread: over a
-            # tunneled device the transfer RTT dominates, and starting it at
-            # dispatch lets it elapse under the next window's host build.
-            handle.blob_future = _shared_fetch_pool().submit(
-                _shimmed_device_get, blob
-            )
-            self._track(handle.blob_future)
+            sweep_future = getattr(blob, "sweep_future", None)
+            if sweep_future is not None:
+                # Deferred sweep window: the coordinator fulfils the blob at
+                # its stacked flush (one grouped d2h for all arms) — no fetch
+                # thread, no per-arm device_get.
+                handle.blob_future = sweep_future
+            else:
+                # Start the device->host pull NOW on the fetch thread: over a
+                # tunneled device the transfer RTT dominates, and starting it
+                # at dispatch lets it elapse under the next window's host
+                # build.
+                handle.blob_future = _shared_fetch_pool().submit(
+                    _shimmed_device_get, blob
+                )
+                self._track(handle.blob_future)
         return handle
 
     def _make_fallback_handle(
@@ -4126,7 +4175,7 @@ class PlacementSolver:
                 )
                 apps = make_app_batch(
                     drv_arr, exc_arr, counts, skippable=skip_arr,
-                    pad_to=_bucket(b, 32),
+                    pad_to=_bucket(b, self._row_bucket_quantum),
                     driver_cand=cand_sub,
                     domain=dom_sub,
                     commit=commit, reset=reset,
@@ -4157,7 +4206,7 @@ class PlacementSolver:
         self.window_path_counts["xla-pruned"] = (
             self.window_path_counts.get("xla-pruned", 0) + 1
         )
-        row_bucket = _bucket(b, 32)
+        row_bucket = _bucket(b, self._row_bucket_quantum)
         info = {
             "path": "xla-pruned",
             "nodes": n,

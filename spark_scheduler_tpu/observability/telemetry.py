@@ -114,6 +114,22 @@ FEATURIZE_OVERHEAD_REFRESHES = (
 BUILD_MS = "foundry.spark.scheduler.solver.build.ms"
 BUILD_ROWS_COMPARED = "foundry.spark.scheduler.solver.build.rows.compared"
 BUILD_DIRTY_ROWS = "foundry.spark.scheduler.solver.build.dirty.rows"
+# Batched multi-arm replay sweeps (ISSUE 18, replay/sweep.py): arm/stream
+# shape of the last sweep, lockstep throughput, stacked cross-arm window
+# dispatches vs per-lane fallbacks, cross-lane candidate-mask memo hits,
+# and the XLA compile wall time booked out of the latency quantiles.
+# Published in the sweep report and surfaced under `/debug/trace`.
+REPLAY_ARMS = "foundry.spark.scheduler.replay.arms"
+REPLAY_STREAMS = "foundry.spark.scheduler.replay.streams"
+REPLAY_WINDOWS_PER_S = "foundry.spark.scheduler.replay.windows.per.s"
+REPLAY_SHARED_BUILD_HITS = (
+    "foundry.spark.scheduler.replay.shared.build.hits"
+)
+REPLAY_STACKED_DISPATCHES = (
+    "foundry.spark.scheduler.replay.stacked.dispatches"
+)
+REPLAY_LANE_FALLBACKS = "foundry.spark.scheduler.replay.lane.fallbacks"
+REPLAY_COMPILE_MS = "foundry.spark.scheduler.replay.compile.ms"
 
 # The one real-compile event (trace/lowering events also fire per compile
 # but would triple-count).

@@ -1,10 +1,14 @@
 """Durable decision traces, deterministic replay, what-if simulation
-(ISSUE 17).
+(ISSUE 17); batched multi-arm sweeps (ISSUE 18).
 
   trace       versioned JSONL codec: TraceWriter (the FlightRecorder's
               journaling sink) + TraceReader (torn-tail tolerant).
   engine      backend-free deterministic replay (`replay_trace`) and the
-              config what-if differ (`what_if`).
+              config what-if differ (`what_if`), factored into per-arm
+              `ReplayLane`s a multi-lane driver can interleave.
+  sweep       the grid driver: one trace, M config arms, lockstep lanes
+              over one shared host build with stacked cross-arm window
+              solves (`run_sweep` / `SweepReport` / `grid_arms`).
   generators  seed-deterministic synthetic workloads (diurnal / bursty /
               churn) emitting the same trace format.
 
@@ -18,6 +22,12 @@ from spark_scheduler_tpu.replay.engine import (
     what_if,
 )
 from spark_scheduler_tpu.replay.generators import GENERATORS, generate
+from spark_scheduler_tpu.replay.sweep import (
+    SweepReport,
+    grid_arms,
+    last_sweep_telemetry,
+    run_sweep,
+)
 from spark_scheduler_tpu.replay.trace import (
     TRACE_VERSION,
     TraceReader,
@@ -31,6 +41,7 @@ __all__ = [
     "GENERATORS",
     "ReplayMismatchError",
     "ReplayReport",
+    "SweepReport",
     "TRACE_VERSION",
     "TraceReader",
     "TraceWriter",
@@ -38,6 +49,9 @@ __all__ = [
     "config_from_fingerprint",
     "config_hash",
     "generate",
+    "grid_arms",
+    "last_sweep_telemetry",
     "replay_trace",
+    "run_sweep",
     "what_if",
 ]

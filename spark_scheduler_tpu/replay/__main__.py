@@ -1,8 +1,9 @@
-"""Trace replay CLI (ISSUE 17).
+"""Trace replay CLI (ISSUE 17; `sweep` grid driver ISSUE 18).
 
   python -m spark_scheduler_tpu.replay info    TRACE
   python -m spark_scheduler_tpu.replay verify  TRACE [--strict]
   python -m spark_scheduler_tpu.replay whatif  TRACE --set binpack-algo=distribute-evenly [...]
+  python -m spark_scheduler_tpu.replay sweep   TRACE --grid binpack-algo=tightly-pack,distribute-evenly [...]
   python -m spark_scheduler_tpu.replay generate {diurnal|bursty|churn} OUT --seed N [...]
   python -m spark_scheduler_tpu.replay run     TRACE OUT
 
@@ -11,6 +12,12 @@ divergence. `run` replays an input-only (generated) trace with binding
 and re-captures it through the live TraceWriter wiring — its output is a
 full captured trace that `verify` can then pin. `--set` takes repeated
 `field=value` pairs (JSON parsed, falling back to raw string; dashes OK).
+
+`sweep` replays ONE trace under the cartesian product of repeated
+`--grid field=v1,v2,...` axes (plus `--set` overrides common to every
+arm) concurrently over one shared host build — see replay/sweep.py.
+Default output is the JSON summary; `--markdown` prints the grid-study
+table instead.
 """
 
 from __future__ import annotations
@@ -37,6 +44,24 @@ def _parse_sets(pairs: list[str]) -> dict:
     return out
 
 
+def _parse_grid(pairs: list[str]) -> dict:
+    """`--grid field=v1,v2,...` -> {field: [v1, v2, ...]} with each value
+    JSON-parsed (falling back to raw string, same as `--set`)."""
+    grid: dict = {}
+    for p in pairs:
+        key, sep, raw = p.partition("=")
+        if not sep or not raw:
+            raise SystemExit(f"--grid expects field=v1,v2,..., got {p!r}")
+        vals = []
+        for v in raw.split(","):
+            try:
+                vals.append(json.loads(v))
+            except ValueError:
+                vals.append(v)
+        grid[key] = vals
+    return grid
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m spark_scheduler_tpu.replay")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -54,11 +79,26 @@ def main(argv=None) -> int:
     p.add_argument("--set", dest="sets", action="append", default=[],
                    metavar="FIELD=VALUE", required=True)
 
+    p = sub.add_parser("sweep", help="replay one trace under a config grid")
+    p.add_argument("trace")
+    p.add_argument("--grid", dest="grid", action="append", default=[],
+                   metavar="FIELD=V1,V2,...",
+                   help="grid axis; repeat for a cartesian product")
+    p.add_argument("--set", dest="sets", action="append", default=[],
+                   metavar="FIELD=VALUE",
+                   help="override applied to every arm")
+    p.add_argument("--no-accel", action="store_true",
+                   help="disable certified top-K prune acceleration")
+    p.add_argument("--markdown", action="store_true",
+                   help="print the grid-study markdown table, not JSON")
+
     p = sub.add_parser("generate", help="emit a synthetic workload trace")
     p.add_argument("kind", choices=sorted(GENERATORS))
     p.add_argument("out")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--nodes", type=int, default=None)
+    p.add_argument("--bursts", type=int, default=None,
+                   help="burst count (bursty kind only)")
     p.add_argument("--binpack-algo", default=None)
 
     p = sub.add_parser("run", help="replay with binding; re-capture output")
@@ -98,10 +138,25 @@ def main(argv=None) -> int:
                          indent=2, sort_keys=True))
         return 0
 
+    if args.cmd == "sweep":
+        from spark_scheduler_tpu.replay.sweep import grid_arms, run_sweep
+
+        grid = _parse_grid(args.grid)
+        base = _parse_sets(args.sets)
+        arms = grid_arms(grid, base) if grid else [base]
+        sw = run_sweep(args.trace, arms, accelerate=not args.no_accel)
+        if args.markdown:
+            print(sw.markdown())
+        else:
+            print(json.dumps(sw.summary(), indent=2, sort_keys=True))
+        return 0
+
     if args.cmd == "generate":
         sizing = {}
         if args.nodes is not None:
             sizing["n_nodes"] = args.nodes
+        if args.bursts is not None:
+            sizing["bursts"] = args.bursts
         if args.binpack_algo is not None:
             sizing["binpack_algo"] = args.binpack_algo
         stats = generate(args.kind, args.out, args.seed, **sizing)

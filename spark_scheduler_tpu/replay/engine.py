@@ -1,4 +1,5 @@
-"""Deterministic trace replay + what-if simulation (ISSUE 17, parts b+c).
+"""Deterministic trace replay + what-if simulation (ISSUE 17, parts b+c;
+lane-factored for the multi-arm sweep driver in ISSUE 18).
 
 `replay_trace` boots a backend-free harness — an InMemoryBackend plus the
 full real scheduler from `build_scheduler_app`, under the trace header's
@@ -22,20 +23,38 @@ The clock is the trace's: every event's recorded wall time drives a
 monotonic-max ReplayClock the whole app reads, so age thresholds and the
 resync-gap heuristic see what the live run saw.
 
-What-if (`what_if`) replays the same trace twice — once under the
-recorded config, once under overrides — and diffs the two runs:
-placement changes, per-arm p50/p99 decision latency (both re-measured
-in-process, so the comparison is apples-to-apples), denial counts, and
-final-state utilization/fragmentation. Bind events are re-pointed at the
-replaying arm's OWN placements (a pod the variant placed on node Y binds
-to Y, not the recorded X), so each arm's world stays self-consistent.
+The per-arm machinery lives in `ReplayLane`: one lane is one replayed
+scheduler app plus its event-step state (roster mirror, pending windows,
+placements). `replay_trace` drives a single lane event-by-event; the
+sweep driver (replay/sweep.py) drives M lanes in LOCKSTEP over one shared
+decoded stream — which is why the predicate step is split into a
+dispatch phase (`predicate_begin`) and a completion phase
+(`predicate_finish`): the sweep dispatches every arm's window first, so
+the coordinator can solve all arms as one stacked device dispatch, then
+completes them. Driving the two phases back-to-back is exactly the
+sequential replay.
+
+Per-window latencies subtract XLA compile time (measured via the
+process-wide jax.monitoring listener, observability/telemetry.py) and
+book it separately as `replay_compile_ms` — so a cold bucket's
+multi-second compile stops polluting the p99 of a study's latency
+quantiles (ISSUE 18 satellite; the 145 ms p99 vs 1.71 ms p50 tail in
+the original what-if study was compile, not solve).
+
+What-if (`what_if`) replays the same trace under the recorded config and
+under overrides — since ISSUE 18 as a thin 2-arm sweep — and diffs the
+two runs: placement changes, per-arm p50/p99 decision latency, denial
+counts, and final-state utilization/fragmentation. Bind events are
+re-pointed at the replaying arm's OWN placements (a pod the variant
+placed on node Y binds to Y, not the recorded X), so each arm's world
+stays self-consistent.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Optional
+from typing import Optional
 
 from spark_scheduler_tpu.replay.trace import (
     ALL_NODES,
@@ -63,6 +82,12 @@ FORCED_FIELDS = dict(
     debug_routes=False,
     trace_path=None,
 )
+
+
+def _compile_seconds() -> float:
+    from spark_scheduler_tpu.observability.telemetry import compile_stats
+
+    return compile_stats()["seconds"]
 
 
 class ReplayClock:
@@ -104,6 +129,10 @@ class ReplayReport:
     utilization: dict = dataclasses.field(default_factory=dict)
     fragmentation: dict = dataclasses.field(default_factory=dict)
     overcommit: int = 0
+    # XLA compile wall time booked during this arm's windows, kept OUT of
+    # latencies_ms (a cold padding bucket's compile is a one-time process
+    # cost, not a decision latency).
+    replay_compile_ms: float = 0.0
 
     def latency_ms(self, q: float) -> Optional[float]:
         if not self.latencies_ms:
@@ -123,12 +152,23 @@ class ReplayReport:
             "denials": self.denials,
             "latency_p50_ms": self.latency_ms(0.50),
             "latency_p99_ms": self.latency_ms(0.99),
+            "replay_compile_ms": round(self.replay_compile_ms, 3),
             "utilization": self.utilization,
             "fragmentation": self.fragmentation,
             "overcommit": self.overcommit,
             "torn_tail": self.torn_tail,
             "malformed": self.malformed,
         }
+
+    def decision_summary(self) -> dict:
+        """The deterministic subset of `summary()` — everything that is a
+        DECISION, nothing that is a wall-clock measurement. Two replays of
+        the same trace under the same config produce identical
+        decision_summary() dicts (the sweep-determinism pin)."""
+        s = self.summary()
+        for k in ("latency_p50_ms", "latency_p99_ms", "replay_compile_ms"):
+            s.pop(k)
+        return s
 
 
 class _Pending:
@@ -144,56 +184,73 @@ class _Pending:
         self.t0 = t0
 
 
-def replay_trace(
-    trace_path: str,
-    overrides: Optional[dict] = None,
-    strict: bool = False,
-    record_path: Optional[str] = None,
-    progress=None,
-) -> ReplayReport:
-    """Re-drive one trace. `overrides` switches the run into what-if
-    territory (an altered config — recorded results are then informational
-    and comparison is skipped); `record_path` re-captures the replay
-    through the normal TraceWriter wiring, which is how generated
-    input-only traces become full captured traces (`run` mode)."""
-    from spark_scheduler_tpu.core.extender import ExtenderArgs
-    from spark_scheduler_tpu.core.solver import PipelineDrainRequired
-    from spark_scheduler_tpu.server.app import build_scheduler_app
-    from spark_scheduler_tpu.server.kube_io import node_from_k8s, pod_from_k8s
-    from spark_scheduler_tpu.store.backend import DEMAND_CRD, InMemoryBackend
+class ReplayLane:
+    """One replay arm: a full backend-free scheduler app plus the
+    event-step state that drives it.
 
-    reader = TraceReader(trace_path)
-    header = reader.header
-    compare = not overrides
-    config = config_from_fingerprint(
-        header["config"],
-        overrides=overrides,
-        forced={**FORCED_FIELDS, "trace_path": record_path},
-    )
-    report = ReplayReport(config_hash=config_hash(header["config"]))
+    The event loop is factored into per-kind step methods so a caller can
+    interleave MULTIPLE lanes over one decoded stream (the sweep driver).
+    `predicate` is two phases — `predicate_begin` dispatches the window
+    (and, for solo-mode events, completes it too: solo predicates never
+    pipeline), `predicate_finish` completes immediate-bind windows or
+    parks the pending ticket. A sequential caller runs them back-to-back;
+    the lockstep sweep runs every lane's begin, flushes the stacked
+    cross-arm solve, then every lane's finish.
+    """
 
-    backend = InMemoryBackend()
-    backend.register_crd(DEMAND_CRD)
-    clock = ReplayClock(float(header.get("t") or 0.0))
-    app = build_scheduler_app(backend, config, clock=clock)
-    ext = app.extender
-    meta = header.get("meta") or {}
-    if meta.get("resync_suppressed"):
-        ext._last_request = float("inf")
-        # carry the suppression into a re-capture trace (its header is
-        # written by build_scheduler_app, which doesn't know this meta)
-        if app.trace_writer is not None:
-            app.trace_writer.emit_meta(resync_suppressed=True)
+    def __init__(
+        self,
+        header: dict,
+        config,
+        *,
+        compare: bool,
+        has_result_events: bool,
+        record_path: Optional[str] = None,
+        candidate_memo: Optional[dict] = None,
+    ):
+        from spark_scheduler_tpu.server.app import build_scheduler_app
+        from spark_scheduler_tpu.store.backend import (
+            DEMAND_CRD,
+            InMemoryBackend,
+        )
 
-    roster: list[str] = []  # mirror of the WRITER's roster, for "*"
-    pending: list[_Pending] = []
-    parked: dict[int, tuple] = {}  # wid -> (results, candidates, ms)
-    placed: dict[tuple, str] = {}
+        self.compare = compare
+        self.has_result_events = has_result_events
+        self.record_path = record_path
+        self.report = ReplayReport(config_hash=config_hash(header["config"]))
+        self.backend = InMemoryBackend()
+        self.backend.register_crd(DEMAND_CRD)
+        self.clock = ReplayClock(float(header.get("t") or 0.0))
+        self.app = build_scheduler_app(self.backend, config, clock=self.clock)
+        self.ext = self.app.extender
+        if candidate_memo is not None:
+            # Sweep mode: cross-lane candidate-mask memo (registry state is
+            # arm-invariant, so lane 2..M reuse lane 1's mask builds).
+            self.app.solver._sweep_shared = candidate_memo
+        meta = header.get("meta") or {}
+        if meta.get("resync_suppressed"):
+            self.ext._last_request = float("inf")
+            # carry the suppression into a re-capture trace (its header is
+            # written by build_scheduler_app, which doesn't know this meta)
+            if self.app.trace_writer is not None:
+                self.app.trace_writer.emit_meta(resync_suppressed=True)
 
-    def expand(names) -> list[str]:
-        return list(roster) if names == ALL_NODES else list(names)
+        self.roster: list[str] = []  # mirror of the WRITER's roster, for "*"
+        self.pending: list[_Pending] = []
+        self.parked: dict[int, tuple] = {}  # wid -> (results, candidates, ms)
+        self.placed: dict[tuple, str] = {}
 
-    def note_results(p: _Pending, results, ms: float) -> None:
+    # ------------------------------------------------------------- steps
+
+    def begin_event(self, ev: dict) -> None:
+        self.report.events += 1
+        self.clock.set(ev.get("t"))
+
+    def expand(self, names) -> list[str]:
+        return list(self.roster) if names == ALL_NODES else list(names)
+
+    def _note_results(self, p: _Pending, results, ms: float) -> None:
+        report, backend = self.report, self.backend
         per_decision = ms / max(1, len(results))
         for args, res in zip(p.ticket.args_list, results):
             report.decisions += 1
@@ -205,74 +262,158 @@ def replay_trace(
                 report.denials += 1
             key = (args.pod.namespace, args.pod.name)
             if res.node_names:
-                placed[key] = res.node_names[0]
+                self.placed[key] = res.node_names[0]
                 report.placements[key] = res.node_names[0]
             if p.bind and res.node_names:
                 cur = backend.get("pods", args.pod.namespace, args.pod.name)
                 if cur is not None and not cur.node_name:
                     backend.bind_pod(cur, res.node_names[0])
 
-    def force_complete(p: _Pending) -> None:
+    def _timed(self, fn):
+        """Run `fn`, returning (result, seconds) with XLA compile wall time
+        subtracted from the measurement and booked to replay_compile_ms."""
+        c0 = _compile_seconds()
         t0 = time.perf_counter()
-        results = ext.predicate_window_complete(p.ticket)
-        ms = (time.perf_counter() - t0 + p.t0) * 1e3
-        note_results(p, results, ms)
-        parked[p.wid] = (results, p.candidates, ms)
+        out = fn()
+        dt = time.perf_counter() - t0
+        dc = _compile_seconds() - c0
+        if dc > 0.0:
+            self.report.replay_compile_ms += dc * 1e3
+            dt = max(0.0, dt - dc)
+        return out, dt
 
-    def dispatch(args_list, candidates, wid, bind) -> None:
-        t0 = time.perf_counter()
-        for _ in range(4):
-            try:
-                ticket = ext.predicate_window_dispatch(args_list)
-                break
-            except PipelineDrainRequired:
-                # The live loop drained and retried here too; its drained
-                # results are already behind us in the stream (journaled
-                # before this predicate event), so the pending list SHOULD
-                # be empty — but mirror the contract defensively.
-                if not pending:
-                    raise
-                force_complete(pending.pop(0))
-        else:
-            raise AssertionError("dispatch kept raising PipelineDrainRequired")
-        p = _Pending(wid, ticket, candidates, bind, time.perf_counter() - t0)
-        if bind and "result" not in bind_modes:
+    def _force_complete(self, p: _Pending) -> None:
+        results, secs = self._timed(
+            lambda: self.ext.predicate_window_complete(p.ticket)
+        )
+        ms = (secs + p.t0) * 1e3
+        self._note_results(p, results, ms)
+        self.parked[p.wid] = (results, p.candidates, ms)
+
+    def predicate_begin(self, ev: dict, candidates=None) -> Optional[_Pending]:
+        """Dispatch one predicate event's window. Returns the pending
+        window for `predicate_finish`, or None when the event completed
+        entirely in this phase (solo mode). `candidates` lets the sweep
+        driver pass pre-expanded per-request candidate lists (shared
+        across lanes); None expands from this lane's own roster mirror."""
+        from spark_scheduler_tpu.core.extender import ExtenderArgs
+        from spark_scheduler_tpu.core.solver import PipelineDrainRequired
+        from spark_scheduler_tpu.server.kube_io import pod_from_k8s
+
+        wid = ev["w"]
+        if candidates is None:
+            candidates = [self.expand(r["nodes"]) for r in ev["reqs"]]
+        backend = self.backend
+
+        def resolve(r):
+            if "ref" in r:
+                ns, name = r["ref"]
+                pod = backend.get("pods", ns, name)
+                if pod is None:
+                    raise AssertionError(
+                        f"trace ref to unknown pod {ns}/{name}"
+                    )
+                return pod
+            return pod_from_k8s(r["pod"])
+
+        args_list = [
+            ExtenderArgs(pod=resolve(r), node_names=c)
+            for r, c in zip(ev["reqs"], candidates)
+        ]
+        bind = bool(ev.get("bind"))
+        if ev.get("mode") == "solo":
+            (res, secs) = self._timed(lambda: self.ext.predicate(args_list[0]))
+            ms = secs * 1e3
+            p = _Pending(wid, None, candidates, bind, 0.0)
+            p.ticket = type("T", (), {"args_list": args_list})()
+            self._note_results(p, [res], ms)
+            self.parked[wid] = ([res], candidates, ms)
+            return None
+
+        def dispatch_once():
+            for _ in range(4):
+                try:
+                    return self.ext.predicate_window_dispatch(args_list)
+                except PipelineDrainRequired:
+                    # The live loop drained and retried here too; its
+                    # drained results are already behind us in the stream
+                    # (journaled before this predicate event), so the
+                    # pending list SHOULD be empty — but mirror the
+                    # contract defensively.
+                    if not self.pending:
+                        raise
+                    self._force_complete(self.pending.pop(0))
+            raise AssertionError(
+                "dispatch kept raising PipelineDrainRequired"
+            )
+
+        ticket, secs = self._timed(dispatch_once)
+        return _Pending(wid, ticket, candidates, bind, secs)
+
+    def predicate_finish(self, p: Optional[_Pending]) -> None:
+        if p is None:
+            return
+        if p.bind and not self.has_result_events:
             # Input-only (generated) trace: no result event will arrive —
             # complete immediately so binds land before the next event.
-            results = ext.predicate_window_complete(p.ticket)
-            ms = (time.perf_counter() - t0) * 1e3
-            note_results(p, results, ms)
+            results, secs = self._timed(
+                lambda: self.ext.predicate_window_complete(p.ticket)
+            )
+            self._note_results(p, results, (p.t0 + secs) * 1e3)
         else:
-            pending.append(p)
+            self.pending.append(p)
 
-    # Input-only traces (generators) carry bind-predicates and no result
-    # events; captured traces carry result events (and re-captured "run"
-    # traces both). Sniff which shape this stream is once, up front.
-    bind_modes: set = set()
-    events = list(reader.events())
-    for ev in events:
-        if ev.get("k") == "result":
-            bind_modes.add("result")
-            break
+    def result(self, ev: dict) -> None:
+        wid = ev["w"]
+        if wid in self.parked:
+            results, candidates, ms = self.parked.pop(wid)
+        else:
+            # Completions are FIFO: anything older than this wid in the
+            # pipeline completes (parking its results) first.
+            while self.pending and self.pending[0].wid != wid:
+                self._force_complete(self.pending.pop(0))
+            if not self.pending:
+                return  # result for a window we never saw dispatch
+            p = self.pending.pop(0)
+            results, secs = self._timed(
+                lambda: self.ext.predicate_window_complete(p.ticket)
+            )
+            ms = (secs + p.t0) * 1e3
+            self._note_results(p, results, ms)
+            candidates = p.candidates
+        if self.compare:
+            report = self.report
+            for i, (res, rec) in enumerate(zip(results, ev["res"])):
+                got = encode_result(res, candidates[i])
+                if got != rec:
+                    report.mismatches.append(
+                        {
+                            "window": wid,
+                            "index": i,
+                            "recorded": rec,
+                            "replayed": got,
+                        }
+                    )
+            report.compared += len(ev["res"])
 
-    for ev in events:
-        report.events += 1
-        if progress is not None and report.events % 5000 == 0:
-            progress(report.events)
-        clock.set(ev.get("t"))
+    def apply(self, ev: dict) -> None:
+        """Every non-predicate, non-result event kind."""
+        from spark_scheduler_tpu.server.kube_io import node_from_k8s, pod_from_k8s
+
+        app, backend = self.app, self.backend
         k = ev.get("k")
         if k == "node":
             op = ev["op"]
             if op == "delete":
                 name = ev["name"]
-                if name in roster:
-                    roster.remove(name)
+                if name in self.roster:
+                    self.roster.remove(name)
                 if backend.get("nodes", "", name) is not None:
                     backend.delete("nodes", "", name)
             else:
                 node = node_from_k8s(ev["node"])
-                if op == "add" and node.name not in roster:
-                    roster.append(node.name)
+                if op == "add" and node.name not in self.roster:
+                    self.roster.append(node.name)
                 if backend.get("nodes", "", node.name) is None:
                     backend.add_node(node)
                 else:
@@ -288,7 +429,7 @@ def replay_trace(
                     # Re-point binds at THIS arm's placement so the world
                     # stays self-consistent under what-if configs (under
                     # the recorded config the two coincide bit-for-bit).
-                    own = placed.get((pod.namespace, pod.name))
+                    own = self.placed.get((pod.namespace, pod.name))
                     if own is not None and own != pod.node_name:
                         pod = dataclasses.replace(pod, node_name=own)
                 if backend.get("pods", pod.namespace, pod.name) is None:
@@ -316,84 +457,72 @@ def replay_trace(
                 app.trace_writer.emit_reconcile()
         elif k == "meta":
             if ev.get("resync_suppressed"):
-                ext._last_request = float("inf")
+                self.ext._last_request = float("inf")
             if app.trace_writer is not None:
                 app.trace_writer.emit_meta(
                     **{a: b for a, b in ev.items() if a not in ("k", "s", "t")}
                 )
-        elif k == "predicate":
-            wid = ev["w"]
-            candidates = [expand(r["nodes"]) for r in ev["reqs"]]
-
-            def resolve(r):
-                if "ref" in r:
-                    ns, name = r["ref"]
-                    pod = backend.get("pods", ns, name)
-                    if pod is None:
-                        raise AssertionError(
-                            f"trace ref to unknown pod {ns}/{name}"
-                        )
-                    return pod
-                return pod_from_k8s(r["pod"])
-
-            args_list = [
-                ExtenderArgs(pod=resolve(r), node_names=c)
-                for r, c in zip(ev["reqs"], candidates)
-            ]
-            bind = bool(ev.get("bind"))
-            if ev.get("mode") == "solo":
-                t0 = time.perf_counter()
-                res = ext.predicate(args_list[0])
-                ms = (time.perf_counter() - t0) * 1e3
-                p = _Pending(wid, None, candidates, bind, 0.0)
-                p.ticket = type("T", (), {"args_list": args_list})()
-                note_results(p, [res], ms)
-                parked[wid] = ([res], candidates, ms)
-            else:
-                dispatch(args_list, candidates, wid, bind)
-        elif k == "result":
-            wid = ev["w"]
-            if wid in parked:
-                results, candidates, ms = parked.pop(wid)
-            else:
-                # Completions are FIFO: anything older than this wid in
-                # the pipeline completes (parking its results) first.
-                while pending and pending[0].wid != wid:
-                    force_complete(pending.pop(0))
-                if not pending:
-                    continue  # result for a window we never saw dispatch
-                p = pending.pop(0)
-                t0 = time.perf_counter()
-                results = ext.predicate_window_complete(p.ticket)
-                ms = (time.perf_counter() - t0 + p.t0) * 1e3
-                note_results(p, results, ms)
-                candidates = p.candidates
-            if compare:
-                for i, (res, rec) in enumerate(zip(results, ev["res"])):
-                    got = encode_result(res, candidates[i])
-                    if got != rec:
-                        report.mismatches.append(
-                            {
-                                "window": wid,
-                                "index": i,
-                                "recorded": rec,
-                                "replayed": got,
-                            }
-                        )
-                report.compared += len(ev["res"])
         # decision events are informational (the recorder's own records
         # ride the replayed app's recorder) — skipped.
 
-    while pending:
-        report.uncompared_windows += 1
-        force_complete(pending.pop(0))
+    def drain(self) -> None:
+        while self.pending:
+            self.report.uncompared_windows += 1
+            self._force_complete(self.pending.pop(0))
 
-    report.torn_tail = reader.torn_tail
-    report.malformed = reader.malformed
-    _final_state_metrics(app, backend, report)
-    if record_path and app.trace_writer is not None:
-        app.trace_writer.close()
-    app.solver.close()
+    def finish(self, reader: TraceReader) -> ReplayReport:
+        self.report.torn_tail = reader.torn_tail
+        self.report.malformed = reader.malformed
+        _final_state_metrics(self.app, self.backend, self.report)
+        if self.record_path and self.app.trace_writer is not None:
+            self.app.trace_writer.close()
+        self.app.solver.close()
+        return self.report
+
+
+def replay_trace(
+    trace_path: str,
+    overrides: Optional[dict] = None,
+    strict: bool = False,
+    record_path: Optional[str] = None,
+    progress=None,
+) -> ReplayReport:
+    """Re-drive one trace. `overrides` switches the run into what-if
+    territory (an altered config — recorded results are then informational
+    and comparison is skipped); `record_path` re-captures the replay
+    through the normal TraceWriter wiring, which is how generated
+    input-only traces become full captured traces (`run` mode)."""
+    reader = TraceReader(trace_path)
+    config = config_from_fingerprint(
+        reader.header["config"],
+        overrides=overrides,
+        forced={**FORCED_FIELDS, "trace_path": record_path},
+    )
+    # Input-only traces (generators) carry bind-predicates and no result
+    # events; captured traces carry result events (and re-captured "run"
+    # traces both). Sniff which shape this stream is once, up front.
+    events = list(reader.events())
+    has_results = any(ev.get("k") == "result" for ev in events)
+    lane = ReplayLane(
+        reader.header,
+        config,
+        compare=not overrides,
+        has_result_events=has_results,
+        record_path=record_path,
+    )
+    for ev in events:
+        lane.begin_event(ev)
+        if progress is not None and lane.report.events % 5000 == 0:
+            progress(lane.report.events)
+        k = ev.get("k")
+        if k == "predicate":
+            lane.predicate_finish(lane.predicate_begin(ev))
+        elif k == "result":
+            lane.result(ev)
+        else:
+            lane.apply(ev)
+    lane.drain()
+    report = lane.finish(reader)
     if strict and report.mismatches:
         raise ReplayMismatchError(
             f"{len(report.mismatches)} replay mismatches "
@@ -445,13 +574,26 @@ def _final_state_metrics(app, backend, report: ReplayReport) -> None:
 
 def what_if(trace_path: str, overrides: dict) -> dict:
     """Replay under the recorded config AND under `overrides`; emit the
-    structured diff report (ISSUE 17 part c). The base arm's mismatch
+    structured diff report (ISSUE 17 part c). Since ISSUE 18 this is a
+    thin 2-arm wrapper over the sweep driver — the base arm replays once
+    and both arms share the decoded stream, roster build, and candidate
+    masks — with the output schema unchanged. The base arm's mismatch
     count doubles as the report's confidence check: a non-zero base
     mismatch means the trace itself doesn't replay cleanly and every
     delta should be read with suspicion."""
-    base = replay_trace(trace_path)
-    variant = replay_trace(trace_path, overrides=overrides)
+    from spark_scheduler_tpu.replay.sweep import run_sweep
 
+    sweep = run_sweep(trace_path, [{}, dict(overrides)])
+    base, variant = sweep.reports
+    return _whatif_diff(trace_path, overrides, base, variant)
+
+
+def _whatif_diff(
+    trace_path: str,
+    overrides: dict,
+    base: ReplayReport,
+    variant: ReplayReport,
+) -> dict:
     same = changed = 0
     moves = []
     for key, node in base.placements.items():

@@ -350,7 +350,17 @@ class SchedulerRoutes(SyncRoutes):
             tw = getattr(s.app, "trace_writer", None)
             if tw is None:
                 return json_response(404, {"error": "trace sink disabled"})
-            return json_response(200, tw.stats())
+            body = tw.stats()
+            # Last in-process multi-arm sweep (ISSUE 18), when one ran —
+            # the replay counters live next to the trace they replayed.
+            from spark_scheduler_tpu.replay.sweep import (
+                last_sweep_telemetry,
+            )
+
+            replay = last_sweep_telemetry()
+            if replay:
+                body = dict(body, replay=replay)
+            return json_response(200, body)
         if path == "/debug/state" and s.debug_routes:
             from spark_scheduler_tpu.observability import debug_state_snapshot
 

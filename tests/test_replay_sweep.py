@@ -1,0 +1,189 @@
+"""Batched multi-arm sweep equivalence (ISSUE 18).
+
+The correctness bar for replay/sweep.py: a stacked M-arm sweep is a pure
+reorganization of M sequential replays — every arm's verdicts, placements,
+denials, and mismatch census must be bit-identical to its own sequential
+`replay_trace()` under the same config, for strategy arms, prune arms, and
+their mixes, at M in {2, 4, 8}. On top of the equivalence pin:
+
+  * sweep determinism — same trace + same grid twice gives identical
+    `decision_summary()` documents (wall-clock fields excluded);
+  * shared-build accounting — dedup collapses identity-pinned-only
+    differences into one stream, stacked dispatches actually happen, and
+    every lane boots exactly one roster build / full snapshot (the
+    zero-per-arm-rebuild pin CI's sweep smoke leg re-asserts at 10k
+    nodes);
+  * `what_if()` is a thin 2-arm wrapper over the sweep (its base arm is
+    the sweep's stream 0), keeping the ISSUE 17 diff schema intact.
+"""
+
+import pytest
+
+from spark_scheduler_tpu.replay import generate, replay_trace, run_sweep
+from spark_scheduler_tpu.replay.sweep import grid_arms
+
+
+@pytest.fixture(scope="module")
+def bursty_trace(tmp_path_factory):
+    """One small generated bursty trace shared by every sweep test: big
+    enough for multi-window pipelining and strategy divergence, small
+    enough to replay in seconds per arm."""
+    path = str(tmp_path_factory.mktemp("sweep") / "bursty.jsonl")
+    generate("bursty", path, seed=11, n_nodes=24, bursts=6)
+    return path
+
+
+def _assert_arm_equiv(arm, rep, seq):
+    assert rep.placements == seq.placements, arm
+    assert rep.verdict_counts == seq.verdict_counts, arm
+    assert rep.denials == seq.denials, arm
+    assert rep.decisions == seq.decisions, arm
+    assert rep.utilization == seq.utilization, arm
+    assert rep.fragmentation == seq.fragmentation, arm
+    assert len(rep.mismatches) == len(seq.mismatches), arm
+
+
+ARM_SETS = {
+    2: [
+        {},
+        {"binpack_algo": "distribute-evenly"},
+    ],
+    4: [
+        {},
+        {"binpack_algo": "distribute-evenly"},
+        {"binpack_algo": "minimal-fragmentation"},
+        {"solver_prune_top_k": 4, "solver_prune_slack": 0.75},
+    ],
+    8: [
+        {},
+        {"binpack_algo": "distribute-evenly"},
+        {"binpack_algo": "minimal-fragmentation"},
+        {"binpack_algo": "single-az-tightly-pack"},
+        {"binpack_algo": "single-az-minimal-fragmentation"},
+        {"binpack_algo": "az-aware-tightly-pack"},
+        {"solver_prune_top_k": 4, "solver_prune_slack": 0.75},
+        {
+            "binpack_algo": "distribute-evenly",
+            "solver_prune_top_k": 4,
+            "solver_prune_slack": 0.75,
+        },
+    ],
+}
+
+
+@pytest.mark.parametrize("m", sorted(ARM_SETS))
+def test_sweep_bit_identical_to_sequential_per_arm(bursty_trace, m):
+    """The tentpole contract: every arm of an M-arm sweep equals its own
+    sequential replay — strategies, prune on/off, and dedup'd duplicates
+    alike."""
+    arms = ARM_SETS[m]
+    sweep = run_sweep(bursty_trace, arms)
+    assert len(sweep.reports) == m
+    for arm, rep in zip(arms, sweep.reports):
+        seq = replay_trace(bursty_trace, overrides=arm or None)
+        _assert_arm_equiv(arm, rep, seq)
+    # the sweep never had to bail out of lockstep
+    assert sweep.telemetry["forced_resolves"] == 0
+
+
+def test_sweep_accelerate_off_still_bit_identical(bursty_trace):
+    """`accelerate=False` opts out of injected certified pruning; decisions
+    are the same either way (that's what 'certified' means) but the opt-out
+    path must hold the same equivalence bar."""
+    arms = ARM_SETS[2]
+    sweep = run_sweep(bursty_trace, arms, accelerate=False)
+    for arm, rep in zip(arms, sweep.reports):
+        _assert_arm_equiv(arm, rep, replay_trace(bursty_trace, overrides=arm or None))
+    assert sweep.telemetry["lane_pruned_windows"] == [0, 0]
+
+
+def test_sweep_determinism(bursty_trace):
+    """Same trace + same grid -> identical decision documents (wall-clock
+    fields are excluded by decision_summary; everything else must match to
+    the byte)."""
+    arms = ARM_SETS[4]
+    a = run_sweep(bursty_trace, arms).decision_summary()
+    b = run_sweep(bursty_trace, arms).decision_summary()
+    assert a == b
+
+
+def test_stream_dedup_and_shared_build_accounting(bursty_trace):
+    """Arms differing only in identity-pinned knobs share one decision
+    stream; each stream boots exactly one roster build and one full
+    snapshot (everything arm-invariant is built once per stream, never per
+    arm); compatible windows actually stack."""
+    arms = [
+        {},
+        {"solver_prune_top_k": 4, "solver_prune_slack": 0.75},  # dedup -> 0
+        {"binpack_algo": "distribute-evenly"},
+        {"binpack_algo": "minimal-fragmentation"},
+    ]
+    sweep = run_sweep(bursty_trace, arms)
+    t = sweep.telemetry
+    assert t["arms"] == 4 and t["streams"] == 3 and t["dedup_arms"] == 1
+    assert sweep.arms[0]["stream"] == sweep.arms[1]["stream"]
+    # one roster build / full snapshot per LANE, zero per extra arm
+    assert t["lane_roster_rebuilds"] == [1] * t["streams"]
+    assert t["lane_full_snapshots"] == [1] * t["streams"]
+    assert t["stacked_dispatches"] > 0
+    assert t["stacked_arm_windows"] >= 2 * t["stacked_dispatches"]
+    assert t["windows"] == t["stacked_arm_windows"] + t["lane_fallbacks"]
+    # dedup'd arms still get independent (deep-copied) reports
+    assert sweep.reports[0] is not sweep.reports[1]
+    assert sweep.reports[0].placements == sweep.reports[1].placements
+
+
+def test_sweep_report_shapes(bursty_trace):
+    """summary() / markdown() are the CLI's output surface — keep them
+    well-formed (one row per arm, telemetry tail present)."""
+    sweep = run_sweep(bursty_trace, ARM_SETS[2])
+    s = sweep.summary()
+    assert [a["name"] for a in s["arms"]] == [
+        "base",
+        "binpack_algo=distribute-evenly",
+    ]
+    assert all("report" in a for a in s["arms"])
+    assert s["telemetry"]["arms"] == 2
+    md = sweep.markdown()
+    assert md.count("\n") >= 3 and "| arm |" in md
+    assert "stacked dispatches" in md
+
+
+def test_grid_arms_cartesian():
+    arms = grid_arms(
+        {"binpack-algo": ["a", "b"], "solver_prune_top_k": [0, 64]},
+        base={"fifo": True},
+    )
+    assert len(arms) == 4
+    assert all(a["fifo"] is True for a in arms)
+    assert {(a["binpack_algo"], a["solver_prune_top_k"]) for a in arms} == {
+        ("a", 0), ("a", 64), ("b", 0), ("b", 64)
+    }
+
+
+def test_what_if_is_a_two_arm_sweep(bursty_trace, monkeypatch):
+    """what_if() delegates to run_sweep with exactly [base, variant] and
+    keeps the ISSUE 17 schema."""
+    from spark_scheduler_tpu.replay import engine as engine_mod
+    from spark_scheduler_tpu.replay import sweep as sweep_mod
+
+    seen = {}
+    real = sweep_mod.run_sweep
+
+    def spy(trace, arms, **kw):
+        seen["arms"] = list(arms)
+        return real(trace, arms, **kw)
+
+    monkeypatch.setattr(sweep_mod, "run_sweep", spy)
+    diff = engine_mod.what_if(
+        bursty_trace, {"binpack-algo": "distribute-evenly"}
+    )
+    assert seen["arms"][0] == {}
+    assert seen["arms"][1] == {"binpack-algo": "distribute-evenly"}
+    for key in (
+        "trace", "overrides", "decisions", "verdicts", "denials",
+        "placements", "latency_ms", "utilization", "fragmentation",
+        "overcommit", "base_mismatches",
+    ):
+        assert key in diff, key
+    assert diff["decisions"]["base"] == diff["decisions"]["variant"]
