@@ -158,6 +158,13 @@ def _record(metric, value, unit, vs_baseline, detail=None):
         "unit": unit,
         "vs_baseline": vs_baseline,
     }
+    if metric.startswith(("serving", "fleet")):
+        # Fleet-era serving lines declare their topology: how many
+        # clusters served the load and how many gangs spilled to a
+        # sibling. Single-cluster sections are explicitly 1/0; the fleet
+        # sections override via their own entries.
+        entry["clusters"] = (detail or {}).get("clusters", 1)
+        entry["spillovers"] = (detail or {}).get("spillovers", 0)
     if detail is not None:
         # Per-metric detail rides into the FINAL all-metrics line so the
         # driver's truncated output tail still proves bench rigor
@@ -1085,6 +1092,8 @@ def _bench_serving_concurrent(
                     "vs_baseline": round(
                         inproc["decisions_per_s"] / 100.0, 2
                     ),
+                    "clusters": 1,
+                    "spillovers": 0,
                     "detail": inproc,
                 }
             ),
@@ -1106,6 +1115,8 @@ def _bench_serving_concurrent(
                 "value": round(dps, 1),
                 "unit": "decisions/s",
                 "vs_baseline": round(dps / 100.0, 2),
+                "clusters": 1,
+                "spillovers": 0,
                 "detail": detail,
             }
         ),
@@ -1503,6 +1514,8 @@ def bench_serving_http_executors(rng, transport="threaded"):
                 "value": inproc_bps,
                 "unit": "bindings/s",
                 "vs_baseline": round(inproc_bps / 500.0, 2),
+                "clusters": 1,
+                "spillovers": 0,
                 "detail": {"windows_of": window, "executors": len(rest)},
             }
         ),
@@ -1713,6 +1726,8 @@ def bench_serving_inprocess(rng):
                 "value": p50,
                 "unit": "ms",
                 "vs_baseline": round(TARGET_MS / p50, 2),
+                "clusters": 1,
+                "spillovers": 0,
                 "detail": data,
             }
         ),
@@ -1762,6 +1777,37 @@ def bench_multi_device_serving(rng):
             "vs_baseline": vs,
             "detail": arm,
         }
+        _RESULTS.append(entry)
+        print(json.dumps(entry), flush=True)
+
+
+def bench_fleet_scaling(rng):
+    """Fleet federation scaling (ISSUE 19): F=4 concurrent per-cluster
+    solver stacks behind one FleetFacade vs ONE cluster serving the same
+    total load behind one pipeline, under simulated device RTT. Runs as a
+    subprocess (hack/fleet_bench.py) because the >=4-slot pool rig is
+    forced before jax initializes. The fleet arm asserts IN-ARM that
+    aggregate decisions/s >= 3x the single-cluster control AND that every
+    cluster's decisions are byte-identical to a standalone replay of its
+    op stream (vs_baseline = speedup/3; >= 1 clears the bar). Lines carry
+    the serving `clusters`/`spillovers` fields."""
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "hack", "fleet_bench.py"
+    )
+    out = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=1200,
+    )
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    if out.returncode != 0 or not lines:
+        raise RuntimeError(
+            f"fleet bench failed rc={out.returncode}: {out.stderr[-800:]}"
+        )
+    for line in lines:
+        entry = json.loads(line)
         _RESULTS.append(entry)
         print(json.dumps(entry), flush=True)
 
@@ -2626,6 +2672,10 @@ def main() -> None:
     # mesh): decisions/s at pool sizes 1/2/4/8 on the 10k-node x 8-group
     # topology; the pooled arms' bar is 1.5x the single-device path.
     guarded("multi_device_serving", bench_multi_device_serving, rng)
+    # Fleet federation scaling (subprocess, 4 forced host devices): F=4
+    # concurrent per-cluster stacks vs one consolidated cluster; >= 3x
+    # aggregate decisions/s + per-cluster byte-identity asserted in-arm.
+    guarded("fleet_scaling", bench_fleet_scaling, rng)
     # Fused multi-window dispatch A/B under simulated device RTT
     # (subprocess): the fused arms at RTT >= 50 ms carry the 3x bar.
     guarded("fused_dispatch", bench_fused_dispatch, rng)

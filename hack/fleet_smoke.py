@@ -1,0 +1,180 @@
+"""Fleet federation smoke (ISSUE 19, the CI `fleet` job leg): boot a
+3-cluster FleetFacade behind one HTTP serving endpoint, drive real
+gang traffic over POST /predicates with cluster-tagged calls (including
+deliberately WRONG tags — the forwarding path), then run the seeded
+kill/rejoin chaos soak and hold the fleet invariants:
+
+  * zero double placements — an app's reservation lives in at most one
+    cluster at every checkpoint, through a cluster kill and rejoin;
+  * zero over-commits on any node of any cluster;
+  * every orphaned PENDING gang (routed to the dead cluster, never
+    placed) is re-routed off it;
+  * resident per-cluster aggregates equal a from-scratch walk;
+  * every cluster's decision stream replays byte-identical on a
+    standalone stack.
+
+Env knobs: FLEET_SMOKE_STEPS (default 60), FLEET_SMOKE_SEED (default 1).
+Exits non-zero (assert) on any violation; prints one JSON summary line.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+STEPS = int(os.environ.get("FLEET_SMOKE_STEPS", "60"))
+SEED = int(os.environ.get("FLEET_SMOKE_SEED", "1"))
+
+
+def _req(port, method, path, payload=None):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode() if payload is not None else None,
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _k8s_spark_pod(app_id, role, name, group, executors=1):
+    return {
+        "metadata": {
+            "name": name,
+            "namespace": "ns",
+            "uid": f"uid-{name}",
+            "labels": {"spark-role": role, "spark-app-id": app_id},
+            "annotations": {
+                "spark-driver-cpu": "1",
+                "spark-driver-mem": "1Gi",
+                "spark-executor-cpu": "1",
+                "spark-executor-mem": "1Gi",
+                "spark-executor-count": str(executors),
+            },
+            "creationTimestamp": "2026-08-07T12:00:00Z",
+        },
+        "spec": {
+            "schedulerName": "spark-scheduler",
+            "nodeSelector": {"resource_channel": group},
+            "containers": [
+                {
+                    "name": "main",
+                    "resources": {"requests": {"cpu": "1", "memory": "1Gi"}},
+                }
+            ],
+        },
+        "status": {"phase": "Pending"},
+    }
+
+
+def serve_over_http():
+    """Boot 3 clusters behind one endpoint; schedule gangs with right AND
+    wrong ?cluster= tags; verify forwarding + /debug/fleet."""
+    from spark_scheduler_tpu.fleet import FleetFacade, verify_cluster_equivalence
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+    from spark_scheduler_tpu.testing.harness import (
+        INSTANCE_GROUP_LABEL,
+        new_node,
+    )
+
+    cfg = InstallConfig(
+        fifo=True, sync_writes=True, instance_group_label=INSTANCE_GROUP_LABEL
+    )
+    facade = FleetFacade(3, cfg, record_ops=True)
+    for c in range(3):
+        for i in range(2):
+            facade.add_node(
+                c, new_node(f"c{c}-n{i}", instance_group=f"ig-{c}")
+            )
+    server = SchedulerHTTPServer(
+        facade.stacks[0].app, host="127.0.0.1", port=0, fleet=facade
+    )
+    server.start()
+    try:
+        placed = 0
+        for k in range(6):
+            group = f"ig-{k % 3}"
+            app = f"smoke-http-{k}"
+            # Tag half the calls with the WRONG cluster endpoint: they
+            # must forward to the owner with identical decision bytes.
+            via = (k % 3) if k < 3 else ((k + 1) % 3)
+            for role, name in (
+                ("driver", f"{app}-driver"),
+                ("executor", f"{app}-exec-0"),
+            ):
+                status, result = _req(
+                    server.port,
+                    "POST",
+                    f"/predicates?cluster={via}",
+                    {
+                        "Pod": _k8s_spark_pod(app, role, name, group),
+                        "NodeNames": [],
+                    },
+                )
+                assert status == 200 and result["NodeNames"], (
+                    f"{name} via c{via}: {result}"
+                )
+                assert result["NodeNames"][0].startswith(f"c{k % 3}-"), (
+                    f"{name} placed off-home: {result}"
+                )
+                placed += 1
+        status, dbg = _req(server.port, "GET", "/debug/fleet")
+        assert status == 200
+        assert dbg["forwarded"] == 6, dbg  # 3 wrong-tagged apps x 2 pods
+        assert all(c["live"] for c in dbg["clusters"])
+        verify_cluster_equivalence(facade)
+        return {"http_decisions": placed, "forwarded": dbg["forwarded"]}
+    finally:
+        server.stop()
+        facade.stop()
+
+
+def chaos_soak():
+    from spark_scheduler_tpu.testing.soak import FleetSoak
+
+    soak = FleetSoak(n_clusters=3, nodes_per_cluster=2, seed=SEED)
+    try:
+        soak.run(
+            steps=STEPS,
+            kill_at=max(2, STEPS * 5 // 8),
+            rejoin_at=max(3, STEPS * 4 // 5),
+        )
+        v = soak.verdict()
+    finally:
+        soak.stop()
+    assert v["double_placements"] == [], v["double_placements"]
+    assert v["overcommit"] == [], v["overcommit"]
+    assert v["oracle_mismatches"] == [], v["oracle_mismatches"]
+    assert v["orphans_unrouted"] == [], v["orphans_unrouted"]
+    assert v["placed"] > 0 and v["spillovers"] > 0, v
+    assert all(r["identical"] for r in v["equivalence"].values())
+    return {
+        "steps": v["steps"],
+        "placed": v["placed"],
+        "pending": v["pending"],
+        "spillovers": v["spillovers"],
+        "orphans_at_kill": v["orphans_at_kill"],
+        "double_placements": 0,
+        "overcommit": 0,
+        "byte_identical_clusters": len(v["equivalence"]),
+    }
+
+
+def main():
+    from spark_scheduler_tpu.tracing import Svc1Logger, set_svc1log
+
+    set_svc1log(Svc1Logger(stream=open(os.devnull, "w")))
+    summary = {"smoke": "fleet", "seed": SEED}
+    summary.update(serve_over_http())
+    summary.update(chaos_soak())
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

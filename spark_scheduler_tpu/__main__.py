@@ -331,6 +331,19 @@ def main(argv=None) -> int:
         # features are exercisable.
         backend.register_crd(DEMAND_CRD)
     ha_runtime = None
+    fleet_facade = None
+    if config.fleet_enabled and (
+        config.ha_enabled or config.durable_store_path or kube_backend
+    ):
+        # Fleet mode boots F private in-memory cluster stacks; composing
+        # it with HA roles or a shared durable/apiserver backend (whose
+        # state would reach only cluster 0) needs per-cluster state
+        # ingestion — refusing beats serving a silently half-wired fleet.
+        raise SystemExit(
+            "fleet.enabled composes with the in-memory backend only for "
+            "now (not ha.enabled / --durable-store / --kube-api-url): "
+            "each cluster stack owns a private backend."
+        )
     if config.ha_enabled:
         from spark_scheduler_tpu.ha import (
             BackendLeaseStore,
@@ -373,6 +386,21 @@ def main(argv=None) -> int:
             registry=registry,
         )
         app = ha_runtime.app
+    elif config.fleet_enabled:
+        from spark_scheduler_tpu.fleet import FleetFacade
+
+        # F independent per-cluster stacks behind this one endpoint
+        # (fleet/facade.py). Cluster 0 doubles as the server's local app
+        # (readiness, debug state, PUT /state ingestion); /predicates is
+        # fleet-routed by the routing layer the moment `fleet` is wired.
+        fleet_facade = FleetFacade(
+            config.fleet_clusters,
+            config,
+            registry=registry,
+            max_spillover_hops=config.fleet_max_spillover_hops,
+            suppress_resync=False,
+        )
+        app = fleet_facade.stacks[0].app
     else:
         app = build_scheduler_app(
             backend, config, metrics=metrics, events=events, waste=waste
@@ -411,6 +439,7 @@ def main(argv=None) -> int:
         debug_routes=config.debug_routes,
         request_log=config.request_log,
         ha=ha_runtime,
+        fleet=fleet_facade,
     )
     reporters.start()
     print(f"spark-scheduler-tpu serving on {args.host}:{server.port}", file=sys.stderr)
@@ -454,6 +483,8 @@ def main(argv=None) -> int:
         server.stop()
     finally:
         reporters.stop()
+        if fleet_facade is not None:
+            fleet_facade.stop()
     return 0
 
 

@@ -1,0 +1,33 @@
+"""Fleet federation: many clusters behind one scheduler facade.
+
+Two-level placement (ROADMAP item 4): a FleetFacade owns F fully
+independent per-cluster solver stacks running concurrently, a FleetRouter
+picks the home cluster in O(F) from resident ClusterAggregates, and a
+SpilloverCoordinator retries capacity-denied drivers on the best sibling.
+Per-cluster decisions stay byte-identical to a standalone cluster —
+`verify_cluster_equivalence` is the mechanical oracle.
+"""
+
+from spark_scheduler_tpu.fleet.aggregates import ClusterAggregates  # noqa: F401
+from spark_scheduler_tpu.fleet.facade import (  # noqa: F401
+    ClusterStack,
+    FleetFacade,
+    replay_standalone,
+    verify_cluster_equivalence,
+)
+from spark_scheduler_tpu.fleet.router import FleetRouter  # noqa: F401
+from spark_scheduler_tpu.fleet.spillover import (  # noqa: F401
+    FleetDecision,
+    SpilloverCoordinator,
+)
+
+__all__ = [
+    "ClusterAggregates",
+    "ClusterStack",
+    "FleetDecision",
+    "FleetFacade",
+    "FleetRouter",
+    "SpilloverCoordinator",
+    "replay_standalone",
+    "verify_cluster_equivalence",
+]

@@ -8,29 +8,39 @@ map is a pure function of (group, replica count) — stable CRC32 — so
 every replica computes the same ownership with no coordination, and
 kube-scheduler can hit any replica: non-owners forward to the owner
 (in-process delegation or an HTTP redirect) instead of failing.
+
+The membership/remap mechanics live in core/membership.py
+(StableMembership), shared with the fleet-level ClusterMap so the two
+layers cannot fork the remap logic.
 """
 
 from __future__ import annotations
 
-import zlib
+from spark_scheduler_tpu.core.membership import StableMembership
 
 
 class ShardMap:
     def __init__(self, n_replicas: int):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
-        self.n_replicas = n_replicas
         # Live membership: removing a member remaps its groups onto the
         # survivors (modulo over the live list — every replica computes
         # the same map from the same membership, no coordination beyond
         # agreeing on who is live).
-        self._live = list(range(n_replicas))
+        self._members = StableMembership(n_replicas)
+
+    @property
+    def n_replicas(self) -> int:
+        return self._members.n_slots
+
+    @property
+    def _live(self) -> list[int]:
+        return self._members._live
 
     def remove(self, index: int) -> None:
-        if len(self._live) <= 1:
+        if len(self._members._live) <= 1:
             raise ValueError("cannot remove the last live replica")
-        if index in self._live:
-            self._live.remove(index)
+        self._members.remove(index)
 
     def owner(self, instance_group: str) -> int:
         """Owning replica index for a group — stable across processes and
@@ -39,19 +49,14 @@ class ShardMap:
         survivors — a surviving member's groups never change owner, so an
         in-flight window on a survivor cannot silently lose ownership
         mid-commit (only the removed member moves, and it is fenced)."""
-        h = zlib.crc32(instance_group.encode("utf-8"))
-        idx = h % self.n_replicas
-        live = self._live  # never empty: remove() refuses the last member
-        if idx in live:
-            return idx
-        return live[h % len(live)]
+        return self._members.owner(instance_group)
 
     def owned_by(self, index: int, groups) -> list[str]:
-        return [g for g in groups if self.owner(g) == index]
+        return self._members.owned_by(index, groups)
 
     def describe(self, groups=()) -> dict:
         return {
             "replicas": self.n_replicas,
-            "live": list(self._live),
+            "live": self._members.live(),
             "assignments": {g: self.owner(g) for g in groups},
         }

@@ -23,6 +23,7 @@ from spark_scheduler_tpu.observability.recorder import (  # noqa: F401
     FlightRecorder,
 )
 from spark_scheduler_tpu.observability.telemetry import (  # noqa: F401
+    FleetTelemetry,
     HATelemetry,
     RetryTelemetry,
     SolverTelemetry,
@@ -39,6 +40,7 @@ from spark_scheduler_tpu.observability.state import (  # noqa: F401
 
 __all__ = [
     "DecisionRecord",
+    "FleetTelemetry",
     "FlightRecorder",
     "HATelemetry",
     "RetryTelemetry",

@@ -609,3 +609,53 @@ class HATelemetry:
 
     def on_tailed(self, applied: int) -> None:
         self.registry.gauge(HA_TAILED_EVENTS, **self._tags()).set(applied)
+
+
+FLEET_CLUSTERS_LIVE = "foundry.spark.scheduler.fleet.clusters.live"
+FLEET_DECISIONS = "foundry.spark.scheduler.fleet.decisions"
+FLEET_ROUTER_PICKS = "foundry.spark.scheduler.fleet.router.picks"
+FLEET_FORWARDED = "foundry.spark.scheduler.fleet.forwarded"
+FLEET_SPILLOVERS = "foundry.spark.scheduler.fleet.spillovers"
+FLEET_SPILLOVER_DENIED = "foundry.spark.scheduler.fleet.spillover.denied"
+FLEET_ORPHANS_REROUTED = "foundry.spark.scheduler.fleet.orphans.rerouted"
+FLEET_AGG_EVENTS = "foundry.spark.scheduler.fleet.aggregate.events.applied"
+
+
+class FleetTelemetry:
+    """`foundry.spark.scheduler.fleet.*` — the facade's two-level serving
+    surface: live cluster count, per-cluster decision counters, router
+    pick reasons, spillovers by (from, to), and aggregate freshness."""
+
+    def __init__(self, registry: MetricRegistry | None = None):
+        self.registry = registry or MetricRegistry()
+
+    def on_live(self, live: int) -> None:
+        self.registry.gauge(FLEET_CLUSTERS_LIVE).set(int(live))
+
+    def on_decision(self, cluster: int) -> None:
+        self.registry.counter(FLEET_DECISIONS, cluster=str(cluster)).inc()
+
+    def on_pick(self, reason: str) -> None:
+        self.registry.counter(FLEET_ROUTER_PICKS, reason=reason).inc()
+
+    def on_forwarded(self) -> None:
+        self.registry.counter(FLEET_FORWARDED).inc()
+
+    def on_spillover(self, home: int, sibling: int) -> None:
+        self.registry.counter(
+            FLEET_SPILLOVERS, from_cluster=str(home), to_cluster=str(sibling)
+        ).inc()
+
+    def on_spillover_denied(self, home: int) -> None:
+        self.registry.counter(
+            FLEET_SPILLOVER_DENIED, from_cluster=str(home)
+        ).inc()
+
+    def on_orphans_rerouted(self, n: int) -> None:
+        if n:
+            self.registry.counter(FLEET_ORPHANS_REROUTED).inc(n)
+
+    def on_aggregate_events(self, cluster: int, applied: int) -> None:
+        self.registry.gauge(FLEET_AGG_EVENTS, cluster=str(cluster)).set(
+            int(applied)
+        )

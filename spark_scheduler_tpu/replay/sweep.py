@@ -83,8 +83,13 @@ from spark_scheduler_tpu.replay.trace import (
 # Config fields that cannot move decisions: the repo's equivalence suites
 # pin each of them byte-identical (prune: certificate-verified with exact
 # escalation; delta statics / scale tier / lazy warm start: delta-vs-full
-# and parity suites; the flight recorder only observes). Arms that differ
-# ONLY in these share one decision stream.
+# and parity suites; the flight recorder only observes; device pool /
+# mesh / fused dispatch: the multi-device parity suites — pooling moves
+# WALL time, never bytes; autoscaler policy knobs: replay forces
+# autoscaler_enabled=False (FORCED_FIELDS), so its tuning cannot reach a
+# decision). Arms that differ ONLY in these share one decision stream —
+# which is exactly what makes `grid_arms` sweeps over device-pool and
+# autoscaler policy grids cheap: F x A arms, one decision stream.
 IDENTITY_PINNED_FIELDS = frozenset(
     {
         "solver_prune_top_k",
@@ -95,7 +100,30 @@ IDENTITY_PINNED_FIELDS = frozenset(
         "solver_lazy_warm_start",
         "flight_recorder",
         "flight_recorder_capacity",
+        "solver_device_pool",
+        "solver_mesh_groups",
+        "solver_mesh_node_shards",
+        "solver_fuse_windows",
+        "autoscaler_max_cluster_size",
+        "autoscaler_idle_ttl_s",
+        "autoscaler_poll_interval_s",
+        "autoscaler_node_cpu",
+        "autoscaler_node_memory",
+        "autoscaler_node_gpu",
+        "autoscaler_zones",
     }
+)
+
+# Identity-pinned TOPOLOGY knobs a sweep lane must not actually build:
+# the stacked sweep overlaps arms its own way (one shared roster, vmapped
+# lanes), so a pooled/meshed/fused solver inside one lane would burn
+# compiles for zero decision delta. Stripped from every stream's
+# effective overrides (decisions pinned identical by the parity suites).
+_NEUTRALIZED_TOPOLOGY_FIELDS = (
+    "solver_device_pool",
+    "solver_mesh_groups",
+    "solver_mesh_node_shards",
+    "solver_fuse_windows",
 )
 
 # Top-K injected into prune-eligible streams under accelerate=True. The
@@ -453,6 +481,8 @@ def _stream_plan(norm_arms: list[dict], accelerate: bool):
             # exact escalation) — free speed for eligible plain-fill
             # streams, a no-op for the rest.
             eff["solver_prune_top_k"] = ACCEL_PRUNE_TOP_K
+        for k in _NEUTRALIZED_TOPOLOGY_FIELDS:
+            eff.pop(k, None)
         # Comparison against recorded results is only meaningful when the
         # stream's DECISION config is the recorded one (identity-pinned
         # overrides don't move decisions, so they don't disqualify it).

@@ -246,6 +246,13 @@ class InstallConfig:
     ha_lease_ttl_s: float = 3.0
     # None = lease-ttl / 3 (three renew chances before takeover).
     ha_heartbeat_s: Optional[float] = None
+    # Fleet federation (fleet/): the server boots F independent
+    # per-cluster solver stacks behind one FleetFacade instead of a
+    # single-cluster app. YAML block:
+    #   fleet: {enabled, clusters, max-spillover-hops}
+    fleet_enabled: bool = False
+    fleet_clusters: int = 2
+    fleet_max_spillover_hops: int = 1
     # Request-gap resync threshold (`extender.resync-gap-seconds`,
     # resource.go:191-202): a gap longer than this resyncs durable state
     # from observed pods. Skipped entirely while the HA lease is held.
@@ -432,6 +439,7 @@ class InstallConfig:
         solver_block = raw.get("solver") or {}
         mesh_block = solver_block.get("mesh") or {}
         ha_block = raw.get("ha") or {}
+        fleet_block = raw.get("fleet") or {}
         trace_block = raw.get("trace") or {}
         extender_block = raw.get("extender") or {}
         retry_block = raw.get("retry") or {}
@@ -572,6 +580,11 @@ class InstallConfig:
                 if (v := block_key(ha_block, "heartbeat-interval", None))
                 is not None
                 else None
+            ),
+            fleet_enabled=bool(block_key(fleet_block, "enabled", False)),
+            fleet_clusters=int(block_key(fleet_block, "clusters", 2)),
+            fleet_max_spillover_hops=int(
+                block_key(fleet_block, "max-spillover-hops", 1)
             ),
             resync_gap_seconds=_parse_duration(
                 block_key(

@@ -690,6 +690,7 @@ class SchedulerHTTPServer:
         max_connections: int | None = None,
         shed_queue_depth: int | None = None,
         ha=None,
+        fleet=None,
     ):
         from spark_scheduler_tpu.observability import TransportTelemetry
 
@@ -707,6 +708,12 @@ class SchedulerHTTPServer:
         # a serving role (leader/active), GET /debug/ha exposes the role /
         # lease / tailer state, and start()/stop() run the heartbeat.
         self.ha = ha
+        # FleetFacade (fleet/facade.py) when this endpoint fronts F
+        # per-cluster stacks: GET /debug/fleet exposes router/spillover/
+        # aggregate state and predicates accept a ?cluster=N tag (which
+        # cluster endpoint kube-scheduler thinks it hit — wrong-cluster
+        # calls are forwarded, counted, and byte-identical either way).
+        self.fleet = fleet
         self.ready = threading.Event()
         self._shutdown = threading.Event()
         cfg = getattr(app, "config", None)

@@ -338,6 +338,11 @@ class SchedulerRoutes(SyncRoutes):
             # served whenever HA is wired — failover forensics must not
             # depend on the debug-routes opt-in.
             return json_response(200, s.ha.state())
+        if path == "/debug/fleet" and getattr(s, "fleet", None) is not None:
+            # Fleet surface (router picks, spillovers, per-cluster
+            # aggregates): served whenever the facade is wired — same
+            # always-on rule as /debug/ha.
+            return json_response(200, s.fleet.state())
         if path == "/metrics":
             return self._metrics(req)
         if path == "/debug/traces" and s.debug_routes:
@@ -664,6 +669,29 @@ class SchedulerRoutes(SyncRoutes):
         shed = self._shed_response()
         if shed is not None:
             return shed
+        # Fleet mode: the facade routes to the home cluster's own stack
+        # (bypassing this endpoint's batcher — each cluster serializes on
+        # its own worker). `?cluster=N` tags which cluster endpoint the
+        # caller believed it hit; wrong-cluster calls are forwarded and
+        # counted, decisions byte-identical either way.
+        fleet = getattr(s, "fleet", None)
+        if fleet is not None:
+            via = req.q("cluster")
+            with tracer().root_from_headers(
+                req.headers, "predicate", pod=f"{pod.namespace}/{pod.name}"
+            ) as root:
+                try:
+                    decision = fleet.schedule(
+                        pod,
+                        node_names or None,
+                        via=int(via) if via is not None else None,
+                    )
+                except Exception as exc:
+                    root.tag("outcome", "failure-internal")
+                    return self._predicate_err(pod, exc)
+                root.tag("outcome", decision.result.outcome)
+                root.tag("cluster", str(decision.cluster))
+                return self._predicate_ok(pod, decision.result, node_names)
         # Root span continues the caller's b3 trace context (the
         # witchcraft tracing middleware slot).
         with tracer().root_from_headers(

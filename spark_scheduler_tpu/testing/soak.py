@@ -1288,3 +1288,206 @@ class PolicySoak:
                 if rec.get("preemption")
             ],
         }
+
+
+class FleetSoak:
+    """Fleet chaos soak (ISSUE 19): randomized gang traffic across F
+    per-cluster stacks behind one FleetFacade, with cluster kill/rejoin
+    chaos riding StableMembership. Groups are multi-homed (each instance
+    group hosted by two clusters) so routing has real choices and denied
+    drivers have a live spillover sibling.
+
+    Each step: submit a fresh gang on a random group, retry a few pending
+    (denied) gangs, occasionally tear one placed app down. At `kill_at`
+    one cluster is removed from serving (its pending gangs become orphans
+    and MUST re-route to survivors); at `rejoin_at` it returns.
+
+    Invariants (verdict()):
+      * zero double placements — every app's reservation exists in at
+        most ONE cluster's backend at every checkpoint;
+      * zero over-commits — per-cluster overcommit_violations() empty at
+        every checkpoint;
+      * orphaned gangs re-routed — every pre-kill PENDING gang bound to
+        the dead cluster ends up placed on (or routed to) a survivor;
+      * aggregates == walk-oracle per cluster at every checkpoint;
+      * per-cluster decisions byte-identical to a standalone replay of
+        the cluster's op stream (checked once at the end — the oplog
+        covers the entire soak).
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 3,
+        nodes_per_cluster: int = 2,
+        seed: int = 0,
+        max_spillover_hops: int = 1,
+    ):
+        from spark_scheduler_tpu.fleet import FleetFacade
+        from spark_scheduler_tpu.server.config import InstallConfig
+        from spark_scheduler_tpu.testing.harness import (
+            INSTANCE_GROUP_LABEL,
+        )
+
+        self.rng = np.random.default_rng(seed)
+        self.F = n_clusters
+        cfg = InstallConfig(
+            fifo=True,
+            sync_writes=True,
+            instance_group_label=INSTANCE_GROUP_LABEL,
+        )
+        self.facade = FleetFacade(
+            n_clusters,
+            cfg,
+            record_ops=True,
+            max_spillover_hops=max_spillover_hops,
+        )
+        # Group g is hosted by clusters g and (g+1) % F — multi-homed.
+        self.groups = [f"ig-{g}" for g in range(n_clusters)]
+        for g in range(n_clusters):
+            for c in (g, (g + 1) % n_clusters):
+                for i in range(nodes_per_cluster):
+                    self.facade.add_node(
+                        c, new_node(f"c{c}-g{g}-n{i}", instance_group=f"ig-{g}")
+                    )
+        self.seq = 0
+        self.placed: dict[str, dict] = {}   # app_id -> {pods, cluster}
+        self.pending: dict[str, dict] = {}  # app_id -> {pods, group}
+        self.dead: int | None = None
+        self.double_placements: list = []
+        self.overcommit: list = []
+        self.oracle_mismatches: list = []
+        self.orphans_at_kill: set[str] = set()
+        self.orphans_rerouted = 0
+        self.unavailable_denials = 0
+        self.steps_run = 0
+
+    # -- traffic -------------------------------------------------------------
+
+    def _submit(self, app_id: str, group: str) -> None:
+        pods = static_allocation_spark_pods(
+            app_id, int(self.rng.integers(1, 4)), instance_group=group
+        )
+        self._try_place(app_id, group, pods)
+
+    def _try_place(self, app_id: str, group: str, pods) -> None:
+        d = self.facade.schedule(pods[0])
+        if d.unavailable:
+            self.unavailable_denials += 1
+            self.pending[app_id] = {"pods": pods, "group": group}
+            return
+        if not d.ok:
+            self.pending[app_id] = {"pods": pods, "group": group}
+            return
+        for p in pods[1:]:
+            self.facade.schedule(p)
+        self.pending.pop(app_id, None)
+        self.placed[app_id] = {"pods": pods, "cluster": d.cluster}
+        if app_id in self.orphans_at_kill:
+            self.orphans_rerouted += 1
+
+    def _teardown(self, app_id: str) -> None:
+        info = self.placed.pop(app_id)
+        stack = self.facade.stacks[info["cluster"]]
+        if not self.facade.router.members.is_live(info["cluster"]):
+            self.placed[app_id] = info  # cluster down: cannot tear down
+            return
+        for p in info["pods"]:
+            stack.delete_pod(p)
+        self.facade.router.unbind(app_id)
+
+    # -- invariants ----------------------------------------------------------
+
+    def _reservation_holders(self, app_id: str) -> list[int]:
+        out = []
+        for s in self.facade.stacks:
+            if any(
+                rr.name == app_id
+                for rr in s.backend.list("resourcereservations")
+            ):
+                out.append(s.index)
+        return out
+
+    def _check(self) -> None:
+        for app_id in list(self.placed) + list(self.pending):
+            holders = self._reservation_holders(app_id)
+            if len(holders) > 1:
+                self.double_placements.append((self.steps_run, app_id, holders))
+        for s in self.facade.stacks:
+            v = overcommit_violations(s.app, s.backend)
+            if v:
+                self.overcommit.append((self.steps_run, s.index, v))
+            if not s.aggregates.oracle_equals():
+                self.oracle_mismatches.append((self.steps_run, s.index))
+
+    # -- the soak loop -------------------------------------------------------
+
+    def run(
+        self,
+        steps: int = 45,
+        kill_at: int = 15,
+        rejoin_at: int = 30,
+        check_every: int = 5,
+    ) -> "FleetSoak":
+        for step in range(steps):
+            self.steps_run = step
+            if step == kill_at and self.dead is None:
+                victim = int(self.rng.integers(0, self.F))
+                # Pending gangs routed to the victim are the orphans the
+                # re-route invariant tracks.
+                self.orphans_at_kill = {
+                    a
+                    for a in self.pending
+                    if self.facade.router.affinity_of(a) == victim
+                }
+                self.facade.kill_cluster(victim)
+                self.dead = victim
+            if step == rejoin_at and self.dead is not None:
+                self.facade.rejoin_cluster(self.dead)
+                self.dead = None
+            # Fresh gang.
+            self.seq += 1
+            group = self.groups[int(self.rng.integers(0, len(self.groups)))]
+            self._submit(f"fleet-soak-{self.seq}", group)
+            # Retry up to two pending gangs (oldest first).
+            for app_id in list(self.pending)[:2]:
+                info = self.pending.pop(app_id)
+                self._try_place(app_id, info["group"], info["pods"])
+            # Occasionally retire a placed app.
+            if self.placed and self.rng.random() < 0.25:
+                ids = sorted(self.placed)
+                self._teardown(ids[int(self.rng.integers(0, len(ids)))])
+            if step % check_every == 0:
+                self._check()
+        self._check()
+        return self
+
+    def verdict(self) -> dict:
+        from spark_scheduler_tpu.fleet import verify_cluster_equivalence
+
+        equivalence = verify_cluster_equivalence(self.facade)
+        st = self.facade.state()
+        # Every orphan must have left the dead cluster: either re-placed
+        # on a survivor (orphans_rerouted) or re-routed and still pending
+        # with a LIVE affinity (or none yet).
+        unrouted = []
+        for a in self.orphans_at_kill:
+            aff = self.facade.router.affinity_of(a)
+            if aff is not None and not self.facade.router.members.is_live(aff):
+                unrouted.append(a)
+        return {
+            "steps": self.steps_run + 1,
+            "double_placements": self.double_placements,
+            "overcommit": self.overcommit,
+            "oracle_mismatches": self.oracle_mismatches,
+            "orphans_at_kill": len(self.orphans_at_kill),
+            "orphans_rerouted": self.orphans_rerouted,
+            "orphans_unrouted": unrouted,
+            "unavailable_denials": self.unavailable_denials,
+            "placed": len(self.placed),
+            "pending": len(self.pending),
+            "spillovers": st["spillover"]["spilled"],
+            "equivalence": equivalence,
+        }
+
+    def stop(self) -> None:
+        self.facade.stop()

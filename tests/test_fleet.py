@@ -1,0 +1,491 @@
+"""Fleet federation (ISSUE 19): FleetFacade two-level placement over F
+independent per-cluster stacks, demand spillover, kill/rejoin chaos, and
+the per-cluster byte-identity contract — every cluster's decisions and
+durable reservation state must match a standalone cluster replaying the
+same op stream, under randomized churn, across solver configurations.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.core.membership import StableMembership
+from spark_scheduler_tpu.fleet import (
+    ClusterStack,
+    FleetFacade,
+    verify_cluster_equivalence,
+)
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.testing.harness import (
+    INSTANCE_GROUP_LABEL,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+def _config(**kw):
+    return InstallConfig(
+        fifo=True,
+        sync_writes=True,
+        instance_group_label=INSTANCE_GROUP_LABEL,
+        **kw,
+    )
+
+
+# ------------------------------------------------------ membership core
+
+
+class TestStableMembership:
+    def test_owner_is_stable_for_survivors_across_removal(self):
+        m = StableMembership(4)
+        keys = [f"app-{i}" for i in range(64)]
+        before = {k: m.owner(k) for k in keys}
+        m.remove(2)
+        after = {k: m.owner(k) for k in keys}
+        # Only keys the victim owned move; every survivor's keys stay put.
+        for k in keys:
+            if before[k] != 2:
+                assert after[k] == before[k], k
+            else:
+                assert after[k] != 2
+                assert m.is_live(after[k])
+
+    def test_rejoin_restores_original_assignment(self):
+        m = StableMembership(4)
+        keys = [f"app-{i}" for i in range(64)]
+        before = {k: m.owner(k) for k in keys}
+        m.remove(1)
+        m.rejoin(1)
+        assert {k: m.owner(k) for k in keys} == before
+        assert m.live() == [0, 1, 2, 3]
+
+    def test_cannot_remove_last_member(self):
+        m = StableMembership(2)
+        m.remove(0)
+        with pytest.raises(ValueError):
+            m.remove(1)
+
+    def test_owned_by_partitions_keys(self):
+        m = StableMembership(3)
+        keys = [f"k{i}" for i in range(30)]
+        shards = [m.owned_by(i, keys) for i in range(3)]
+        assert sorted(k for s in shards for k in s) == sorted(keys)
+        d = m.describe(keys)
+        assert d["slots"] == 3 and d["live"] == [0, 1, 2]
+
+
+# ------------------------------------------- two-level routing + facade
+
+
+class TestFleetRouting:
+    def _fleet(self, n=3, record_ops=True, **cfg_kw):
+        f = FleetFacade(n, _config(**cfg_kw), record_ops=record_ops)
+        for c in range(n):
+            for i in range(2):
+                f.add_node(c, new_node(f"c{c}-n{i}", instance_group=f"ig-{c}"))
+        return f
+
+    def test_hosting_pick_routes_to_group_host(self):
+        f = self._fleet()
+        try:
+            pods = static_allocation_spark_pods(
+                "app-host", 2, instance_group="ig-1"
+            )
+            d = f.schedule(pods[0])
+            assert d.ok and d.cluster == 1
+            assert f.router.picks["hosting"] == 1
+            # Executors ride the driver's affinity — same cluster.
+            for p in pods[1:]:
+                dd = f.schedule(p)
+                assert dd.ok and dd.cluster == 1
+            assert f.router.picks["affinity"] == 2
+        finally:
+            f.stop()
+
+    def test_headroom_pick_prefers_emptier_host(self):
+        f = FleetFacade(2, _config(), record_ops=True)
+        try:
+            # Both clusters host the group; cluster 1 has more headroom.
+            f.add_node(0, new_node("c0-n0", instance_group="ig-s"))
+            for i in range(2):
+                f.add_node(1, new_node(f"c1-n{i}", instance_group="ig-s"))
+            d = f.schedule(
+                static_allocation_spark_pods("app-hr", 1, instance_group="ig-s")[0]
+            )
+            assert d.ok and d.cluster == 1
+            assert f.router.picks["headroom"] == 1
+        finally:
+            f.stop()
+
+    def test_hash_pick_for_unhosted_group_is_stable(self):
+        f = self._fleet()
+        try:
+            home, reason = f.router.route("ghost-app", "ig-nowhere")
+            assert reason == "hash"
+            f.router.unbind("ghost-app")
+            again, _ = f.router.route("ghost-app", "ig-nowhere")
+            assert again == home
+        finally:
+            f.stop()
+
+    def test_wrong_cluster_call_is_forwarded_and_identical(self):
+        f = self._fleet()
+        try:
+            pod = static_allocation_spark_pods(
+                "app-fwd", 1, instance_group="ig-0"
+            )[0]
+            wrong = 2  # ig-0 is hosted by cluster 0
+            d = f.schedule(pod, via=wrong)
+            assert d.ok and d.cluster == 0
+            assert f.forwarded == 1
+            # The decision is the owner's: the node lives in cluster 0.
+            assert d.result.node_names[0].startswith("c0-")
+            verify_cluster_equivalence(f)
+        finally:
+            f.stop()
+
+
+# ------------------------------------------------------------ spillover
+
+
+class TestSpillover:
+    def _two_homes(self, max_hops=1):
+        """Two clusters both hosting ig-s: one node each."""
+        f = FleetFacade(2, _config(), record_ops=True, max_spillover_hops=max_hops)
+        f.add_node(0, new_node("c0-n0", instance_group="ig-s"))
+        f.add_node(1, new_node("c1-n0", instance_group="ig-s"))
+        return f
+
+    def _fill(self, f, cluster, app_id, executors=6):
+        """Occupy 7 of the node's 8 CPUs so a 3-pod gang cannot fit."""
+        pods = static_allocation_spark_pods(
+            app_id, executors, instance_group="ig-s"
+        )
+        f.router.bind(app_id, cluster)
+        for p in pods:
+            assert f.schedule(p).ok
+
+    def test_denied_driver_spills_to_sibling_and_executors_follow(self):
+        f = self._two_homes()
+        try:
+            self._fill(f, 0, "filler")
+            pods = static_allocation_spark_pods(
+                "spill-app", 2, instance_group="ig-s"
+            )
+            f.router.bind("spill-app", 0)  # force home = the full cluster
+            d = f.schedule(pods[0])
+            assert d.ok and d.cluster == 1 and d.spilled_from == 0
+            assert f.spillover.spilled == 1
+            # Affinity re-bound: the gang's executors land beside the
+            # driver on the sibling.
+            for p in pods[1:]:
+                dd = f.schedule(p)
+                assert dd.ok and dd.cluster == 1 and dd.spilled_from is None
+            # Home cleanup: neither the pod nor its demand remain in
+            # cluster 0 — the demand was fulfilled by a sibling, not an
+            # autoscaler.
+            home = f.stacks[0]
+            assert home.backend.get("pods", "ns", pods[0].name) is None
+            assert not [
+                dm for dm in home.backend.list("demands")
+                if "spill-app" in dm.name
+            ]
+            # The hand-off is journaled in the home cluster's recorder.
+            recs = home.app.recorder.query(app="spill-app")
+            assert recs and recs[0]["verdict"] == "spillover"
+            assert "sibling cluster 1" in recs[0]["message"]
+            # Both clusters stay byte-identical to standalone replays —
+            # the sibling saw ordinary schedule ops, the home saw its
+            # denial + release.
+            verify_cluster_equivalence(f)
+        finally:
+            f.stop()
+
+    def test_spillover_denied_everywhere_leaves_home_demand(self):
+        f = self._two_homes()
+        try:
+            self._fill(f, 0, "filler-a")
+            self._fill(f, 1, "filler-b")
+            pods = static_allocation_spark_pods(
+                "doomed-app", 2, instance_group="ig-s"
+            )
+            f.router.bind("doomed-app", 0)
+            d = f.schedule(pods[0])
+            assert not d.ok and d.cluster == 0
+            assert d.spillover_attempts == 1 and f.spillover.denied == 1
+            # The home demand STANDS — the autoscaler path takes over.
+            assert [
+                dm for dm in f.stacks[0].backend.list("demands")
+                if "doomed-app" in dm.name
+            ]
+            # The sibling's failed copy left through release: no pod, no
+            # demand, and its op stream still replays byte-identically.
+            assert f.stacks[1].backend.get("pods", "ns", pods[0].name) is None
+            verify_cluster_equivalence(f)
+        finally:
+            f.stop()
+
+    def test_zero_hops_disables_spillover(self):
+        f = self._two_homes(max_hops=0)
+        try:
+            self._fill(f, 0, "filler")
+            pod = static_allocation_spark_pods(
+                "capped-app", 2, instance_group="ig-s"
+            )[0]
+            f.router.bind("capped-app", 0)
+            d = f.schedule(pod)
+            assert not d.ok and d.spillover_attempts == 0
+            assert f.spillover.spilled == 0
+        finally:
+            f.stop()
+
+
+# ------------------------------------------------------- kill / rejoin
+
+
+class TestKillRejoin:
+    def test_placed_app_denies_while_home_down_and_never_double_places(self):
+        f = FleetFacade(2, _config(), record_ops=True)
+        try:
+            for c in range(2):
+                f.add_node(c, new_node(f"c{c}-n0", instance_group="ig-kr"))
+            pods = static_allocation_spark_pods(
+                "placed-app", 2, instance_group="ig-kr"
+            )
+            for p in pods[:2]:
+                assert f.schedule(p).ok
+            home = f.router.affinity_of("placed-app")
+            f.kill_cluster(home)
+            # The remaining executor targets a placed app on a dead
+            # cluster: synthesized denial, NOT an op in any oplog.
+            d = f.schedule(pods[2])
+            assert not d.ok and d.unavailable
+            assert f.unavailable_denials == 1
+            holders = [
+                s.index
+                for s in f.stacks
+                if any(
+                    rr.name == "placed-app"
+                    for rr in s.backend.list("resourcereservations")
+                )
+            ]
+            assert holders == [home]  # exactly one cluster holds the gang
+            # Rejoin: the same executor now serves at home, and the oplog
+            # (which never saw the synthesized denial) replays clean.
+            f.rejoin_cluster(home)
+            d = f.schedule(pods[2])
+            assert d.ok and d.cluster == home
+            verify_cluster_equivalence(f)
+        finally:
+            f.stop()
+
+    def test_pending_orphan_reroutes_to_survivor(self):
+        f = FleetFacade(2, _config(), record_ops=True)
+        try:
+            # Both clusters host the group and BOTH are full: the gang is
+            # denied at home and by spillover — a pending app.
+            f.add_node(0, new_node("c0-n0", instance_group="ig-or"))
+            f.add_node(1, new_node("c1-n0", instance_group="ig-or"))
+            for fid, cluster in (("filler-a", 0), ("filler-b", 1)):
+                f.router.bind(fid, cluster)
+                for p in static_allocation_spark_pods(
+                    fid, 6, instance_group="ig-or"
+                ):
+                    assert f.schedule(p).ok
+            gang = static_allocation_spark_pods(
+                "orphan-app", 2, instance_group="ig-or"
+            )
+            f.router.bind("orphan-app", 0)
+            d = f.schedule(gang[0])
+            assert not d.ok and d.cluster == 0
+            # Home dies: the PENDING gang is an orphan — its affinity
+            # drops so the next retry re-routes.
+            assert f.kill_cluster(0) == 1
+            assert f.router.affinity_of("orphan-app") is None
+            # Capacity appears on the survivor; the retry routes there
+            # (hosting pick among LIVE clusters) and the whole gang lands.
+            f.add_node(1, new_node("c1-n1", instance_group="ig-or"))
+            for p in gang:
+                d = f.schedule(p)
+                assert d.ok and d.cluster == 1
+            # Exactly one cluster ever held the gang, and the survivor's
+            # op stream still replays byte-identically. (The dead home's
+            # replay is checked after rejoin-free shutdown too.)
+            assert f.router.rerouted_orphans == 1
+            verify_cluster_equivalence(f)
+        finally:
+            f.stop()
+
+
+# ---------------------------- byte-identity under churn x solver configs
+
+
+CHURN_CONFIGS = [
+    pytest.param({}, id="default"),
+    pytest.param({"solver_prune_top_k": 4}, id="pruned"),
+    pytest.param({"solver_device_pool": 2}, id="pooled"),
+]
+
+
+class TestEquivalenceUnderChurn:
+    @pytest.mark.parametrize("cfg_kw", CHURN_CONFIGS)
+    def test_randomized_churn_replays_byte_identical(self, cfg_kw):
+        rng = np.random.default_rng(11)
+        f = FleetFacade(3, _config(**cfg_kw), record_ops=True)
+        try:
+            for c in range(3):
+                for g in (c, (c + 1) % 3):
+                    f.add_node(
+                        c, new_node(f"c{c}-g{g}-n0", instance_group=f"ig-{g}")
+                    )
+            live = {}
+            for step in range(25):
+                roll = rng.random()
+                if roll < 0.6 or not live:
+                    app = f"churn-{step}"
+                    group = f"ig-{int(rng.integers(0, 3))}"
+                    pods = static_allocation_spark_pods(
+                        app, int(rng.integers(1, 3)), instance_group=group
+                    )
+                    decisions = [f.schedule(p) for p in pods]
+                    if decisions[0].ok:
+                        live[app] = (decisions[0].cluster, pods)
+                elif roll < 0.8 and live:
+                    app = sorted(live)[int(rng.integers(0, len(live)))]
+                    cluster, pods = live.pop(app)
+                    for p in pods:
+                        f.stacks[cluster].terminate_pod(p)
+                else:
+                    app = sorted(live)[int(rng.integers(0, len(live)))]
+                    cluster, pods = live.pop(app)
+                    for p in pods:
+                        f.stacks[cluster].delete_pod(p)
+                    f.router.unbind(app)
+            report = verify_cluster_equivalence(f)
+            assert set(report) == {0, 1, 2}
+            assert all(r["identical"] for r in report.values())
+            # Resident aggregates still equal a from-scratch walk.
+            for s in f.stacks:
+                assert s.aggregates.oracle_equals(), f"cluster {s.index}"
+        finally:
+            f.stop()
+
+
+# -------------------------------------------------- aggregates oracle
+
+
+class TestAggregatesOracle:
+    def test_event_maintained_equals_walk_oracle(self):
+        stack = ClusterStack(0, _config(), threaded=False)
+        try:
+            for i in range(4):
+                stack.add_node(new_node(f"n{i}", instance_group="ig-a"))
+            for k in range(3):
+                for p in static_allocation_spark_pods(
+                    f"agg-{k}", 2, instance_group="ig-a"
+                ):
+                    stack.schedule(p)
+            agg = stack.aggregates
+            assert agg.hosts_group("ig-a") and not agg.hosts_group("ig-x")
+            assert agg.oracle_equals()
+            # Churn: drop an app's pods, then a node.
+            for p in static_allocation_spark_pods(
+                "agg-0", 2, instance_group="ig-a"
+            ):
+                stack.delete_pod(p)
+            stack.backend.delete("nodes", "", "n3")
+            assert agg.oracle_equals()
+            free = agg.free_total()
+            assert free[0] > 0 and agg.top_node_free()[0] > 0
+        finally:
+            stack.stop()
+
+
+# -------------------------------------------------- config + HTTP surface
+
+
+class TestFleetConfigAndHTTP:
+    def test_fleet_block_parses_with_defaults(self):
+        cfg = InstallConfig.from_dict({})
+        assert not cfg.fleet_enabled
+        assert cfg.fleet_clusters == 2 and cfg.fleet_max_spillover_hops == 1
+        cfg = InstallConfig.from_dict(
+            {"fleet": {"enabled": True, "clusters": 4, "max-spillover-hops": 2}}
+        )
+        assert cfg.fleet_enabled and cfg.fleet_clusters == 4
+        assert cfg.fleet_max_spillover_hops == 2
+
+    def test_debug_fleet_and_cluster_tagged_predicate(self):
+        from spark_scheduler_tpu.server.http import SchedulerHTTPServer
+
+        f = FleetFacade(2, _config(), record_ops=True)
+        for c in range(2):
+            f.add_node(c, new_node(f"c{c}-n0", instance_group=f"ig-{c}"))
+        server = SchedulerHTTPServer(
+            f.stacks[0].app, host="127.0.0.1", port=0, fleet=f
+        )
+        server.start()
+        try:
+            def req(method, path, payload=None):
+                r = urllib.request.Request(
+                    f"http://127.0.0.1:{server.port}{path}",
+                    data=(
+                        json.dumps(payload).encode()
+                        if payload is not None
+                        else None
+                    ),
+                    method=method,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(r) as resp:
+                    return resp.status, json.loads(resp.read())
+
+            status, body = req("GET", "/debug/fleet")
+            assert status == 200
+            assert [c["live"] for c in body["clusters"]] == [True, True]
+            # A predicate tagged with the WRONG cluster endpoint forwards
+            # to the owner and returns the owner's decision bytes.
+            pod = {
+                "metadata": {
+                    "name": "fleet-http-driver",
+                    "namespace": "ns",
+                    "uid": "uid-fh",
+                    "labels": {
+                        "spark-role": "driver",
+                        "spark-app-id": "fleet-http",
+                    },
+                    "annotations": {
+                        "spark-driver-cpu": "1",
+                        "spark-driver-mem": "1Gi",
+                        "spark-executor-cpu": "1",
+                        "spark-executor-mem": "1Gi",
+                        "spark-executor-count": "1",
+                    },
+                    "creationTimestamp": "2026-08-07T12:00:00Z",
+                },
+                "spec": {
+                    "schedulerName": "spark-scheduler",
+                    "nodeSelector": {INSTANCE_GROUP_LABEL: "ig-1"},
+                    "containers": [
+                        {
+                            "name": "main",
+                            "resources": {
+                                "requests": {"cpu": "1", "memory": "1Gi"}
+                            },
+                        }
+                    ],
+                },
+                "status": {"phase": "Pending"},
+            }
+            status, result = req(
+                "POST", "/predicates?cluster=0", {"Pod": pod, "NodeNames": []}
+            )
+            assert status == 200 and result["NodeNames"] == ["c1-n0"]
+            status, body = req("GET", "/debug/fleet")
+            assert body["forwarded"] == 1
+            assert body["router"]["picks"]["hosting"] == 1
+        finally:
+            server.stop()
+            f.stop()

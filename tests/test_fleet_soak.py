@@ -1,0 +1,55 @@
+"""Fleet chaos soak (ISSUE 19) — the engine lives in
+spark_scheduler_tpu/testing/soak.py (FleetSoak, shared with
+hack/fleet_smoke.py's CI leg). A seeded random gang mix across 3
+clusters with multi-homed instance groups, one cluster killed mid-run
+and rejoined later. Invariants: zero double placements, zero
+over-commits, aggregates == walk-oracle, every orphaned pending gang
+re-routed off the dead cluster, and per-cluster byte-identity to a
+standalone replay of the full soak's op stream.
+
+Step count: FLEET_SOAK_STEPS env (default 40 keeps tier-1 fast; the CI
+fleet job runs longer).
+"""
+
+from __future__ import annotations
+
+import os
+
+from spark_scheduler_tpu.testing.soak import FleetSoak
+
+STEPS = int(os.environ.get("FLEET_SOAK_STEPS", "40"))
+
+
+def test_fleet_chaos_soak():
+    soak = FleetSoak(n_clusters=3, nodes_per_cluster=2, seed=1)
+    try:
+        soak.run(
+            steps=STEPS,
+            kill_at=max(2, STEPS * 5 // 8),
+            rejoin_at=max(3, STEPS * 4 // 5),
+        )
+        v = soak.verdict()
+    finally:
+        soak.stop()
+    assert v["double_placements"] == [], v["double_placements"]
+    assert v["overcommit"] == [], v["overcommit"]
+    assert v["oracle_mismatches"] == [], v["oracle_mismatches"]
+    assert v["orphans_unrouted"] == [], v["orphans_unrouted"]
+    # The chaos actually bit: traffic placed, capacity pressure spilled
+    # gangs across clusters, and every cluster replayed byte-identical.
+    assert v["placed"] > 0
+    assert v["spillovers"] > 0, v
+    assert all(r["identical"] for r in v["equivalence"].values())
+
+
+def test_fleet_soak_orphans_leave_dead_cluster():
+    """A seed whose kill point catches a pending backlog: the orphan
+    re-route invariant is exercised, not vacuous."""
+    soak = FleetSoak(n_clusters=3, nodes_per_cluster=2, seed=1)
+    try:
+        v = soak.run(steps=45, kill_at=25, rejoin_at=36).verdict()
+    finally:
+        soak.stop()
+    assert v["orphans_at_kill"] > 0
+    assert v["orphans_unrouted"] == [], v["orphans_unrouted"]
+    assert v["double_placements"] == [] and v["overcommit"] == []

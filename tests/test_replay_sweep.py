@@ -187,3 +187,30 @@ def test_what_if_is_a_two_arm_sweep(bursty_trace, monkeypatch):
     ):
         assert key in diff, key
     assert diff["decisions"]["base"] == diff["decisions"]["variant"]
+
+
+def test_device_pool_and_autoscaler_grid_shares_one_stream(bursty_trace):
+    """ISSUE 19 satellite: `solver.device-pool` and the autoscaler policy
+    knobs are sweepable `grid_arms` fields — identity-pinned (pooling
+    moves wall time, never decision bytes, per the multi-device parity
+    suites; replay forces the autoscaler off), so a pool x idle-ttl grid
+    collapses to ONE decision stream, the topology knobs are neutralized
+    inside the lane (no pooled solver is built for a sweep), and every
+    arm's report is bit-identical to a sequential replay."""
+    arms = grid_arms(
+        {
+            "solver-device-pool": [1, 2],
+            "autoscaler-idle-ttl-s": [60.0, 300.0],
+        }
+    )
+    assert len(arms) == 4
+    sweep = run_sweep(bursty_trace, arms)
+    t = sweep.telemetry
+    assert t["arms"] == 4 and t["streams"] == 1 and t["dedup_arms"] == 3
+    assert len({a["stream"] for a in sweep.arms}) == 1
+    # one roster build / one full snapshot TOTAL: the whole grid rode one
+    # lane
+    assert t["lane_roster_rebuilds"] == [1] and t["lane_full_snapshots"] == [1]
+    seq = replay_trace(bursty_trace)
+    for arm, rep in zip(sweep.arms, sweep.reports):
+        _assert_arm_equiv(arm, rep, seq)
