@@ -1790,7 +1790,11 @@ def bench_fleet_scaling(rng):
     aggregate decisions/s >= 3x the single-cluster control AND that every
     cluster's decisions are byte-identical to a standalone replay of its
     op stream (vs_baseline = speedup/3; >= 1 clears the bar). Lines carry
-    the serving `clusters`/`spillovers` fields."""
+    the serving `clusters`/`spillovers` fields. The bench's stacked
+    section (ISSUE 20) then A/Bs the fleet-fused dispatch over a
+    SERIALIZED 40 ms tunnel — stacked vs unstacked interleaved reps,
+    >=1.5x + stacked_dispatches>0 + forced_resolves==0 + byte-identity
+    asserted in-arm; its lines carry `stacked_dispatches`/`stack_arms`."""
     import subprocess
     import sys
 

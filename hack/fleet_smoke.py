@@ -13,13 +13,23 @@ kill/rejoin chaos soak and hold the fleet invariants:
   * every cluster's decision stream replays byte-identical on a
     standalone stack.
 
-Env knobs: FLEET_SMOKE_STEPS (default 60), FLEET_SMOKE_SEED (default 1).
-Exits non-zero (assert) on any violation; prints one JSON summary line.
+STACKED MODE (ISSUE 20, the CI `fleet-stacked` job leg): with
+FLEET_SMOKE_STACK=1 the smoke additionally (a) drives concurrent
+per-cluster gang traffic against a facade running the
+FleetDispatchCoordinator and asserts stacked_dispatches > 0 with
+forced_resolves == 0 and byte-identical oplog equivalence, and (b)
+re-runs the chaos soak in stacking mode (concurrent bursts, kill lands
+mid-gather) holding every invariant above unchanged.
+
+Env knobs: FLEET_SMOKE_STEPS (default 60), FLEET_SMOKE_SEED (default 1),
+FLEET_SMOKE_STACK (default 0). Exits non-zero (assert) on any violation;
+prints one JSON summary line.
 """
 
 import json
 import os
 import sys
+import threading
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -29,6 +39,7 @@ sys.path.insert(
 
 STEPS = int(os.environ.get("FLEET_SMOKE_STEPS", "60"))
 SEED = int(os.environ.get("FLEET_SMOKE_SEED", "1"))
+STACK = os.environ.get("FLEET_SMOKE_STACK", "0") == "1"
 
 
 def _req(port, method, path, payload=None):
@@ -135,15 +146,79 @@ def serve_over_http():
         facade.stop()
 
 
-def chaos_soak():
+def stacked_serving():
+    """ISSUE 20: concurrent per-cluster gangs against the dispatch
+    coordinator — windows must stack (stacked_dispatches > 0) with no
+    forced resolves, and every cluster's oplog must replay
+    byte-identical on a standalone (unstacked) stack."""
+    from spark_scheduler_tpu.fleet import (
+        FleetFacade,
+        verify_cluster_equivalence,
+    )
+    from spark_scheduler_tpu.server.config import InstallConfig
+    from spark_scheduler_tpu.testing.harness import (
+        INSTANCE_GROUP_LABEL,
+        new_node,
+        static_allocation_spark_pods,
+    )
+
+    cfg = InstallConfig(
+        fifo=True, sync_writes=True, instance_group_label=INSTANCE_GROUP_LABEL
+    )
+    facade = FleetFacade(
+        3, cfg, record_ops=True, stack_window_ms=150.0
+    )
+    for c in range(3):
+        for i in range(2):
+            facade.add_node(
+                c, new_node(f"c{c}-n{i}", instance_group=f"ig-{c}")
+            )
+
+    def pump(c, k):
+        pods = static_allocation_spark_pods(
+            f"smoke-stack-c{c}-{k}", 1, instance_group=f"ig-{c}"
+        )
+        for p in pods:
+            facade.schedule(p)
+
+    try:
+        for k in range(4):
+            ts = [
+                threading.Thread(target=pump, args=(c, k)) for c in range(3)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        st = facade.state()["stacking"]
+        assert st["stacked_dispatches"] > 0, st
+        assert st["forced_resolves"] == 0, st
+        eq = verify_cluster_equivalence(facade)
+        assert all(r["identical"] for r in eq.values()), eq
+        return {
+            "stacked_dispatches": st["stacked_dispatches"],
+            "stack_arms": st["stack_arms"],
+            "stack_fallbacks": st["fallbacks"],
+        }
+    finally:
+        facade.stop()
+
+
+def chaos_soak(stack_window_ms: float = 0.0):
     from spark_scheduler_tpu.testing.soak import FleetSoak
 
-    soak = FleetSoak(n_clusters=3, nodes_per_cluster=2, seed=SEED)
+    steps = max(12, STEPS // 3) if stack_window_ms > 0 else STEPS
+    soak = FleetSoak(
+        n_clusters=3,
+        nodes_per_cluster=2,
+        seed=SEED,
+        stack_window_ms=stack_window_ms,
+    )
     try:
         soak.run(
-            steps=STEPS,
-            kill_at=max(2, STEPS * 5 // 8),
-            rejoin_at=max(3, STEPS * 4 // 5),
+            steps=steps,
+            kill_at=max(2, steps * 5 // 8),
+            rejoin_at=max(3, steps * 4 // 5),
         )
         v = soak.verdict()
     finally:
@@ -152,9 +227,9 @@ def chaos_soak():
     assert v["overcommit"] == [], v["overcommit"]
     assert v["oracle_mismatches"] == [], v["oracle_mismatches"]
     assert v["orphans_unrouted"] == [], v["orphans_unrouted"]
-    assert v["placed"] > 0 and v["spillovers"] > 0, v
+    assert v["placed"] > 0, v
     assert all(r["identical"] for r in v["equivalence"].values())
-    return {
+    out = {
         "steps": v["steps"],
         "placed": v["placed"],
         "pending": v["pending"],
@@ -164,15 +239,28 @@ def chaos_soak():
         "overcommit": 0,
         "byte_identical_clusters": len(v["equivalence"]),
     }
+    if stack_window_ms > 0:
+        st = v["stacking"]
+        assert st["stacked_dispatches"] > 0, st
+        out = {f"chaos_{k}": x for k, x in out.items()}
+        out["chaos_stacked_dispatches"] = st["stacked_dispatches"]
+        out["chaos_forced_resolves"] = st["forced_resolves"]
+    else:
+        assert v["spillovers"] > 0, v
+    return out
 
 
 def main():
     from spark_scheduler_tpu.tracing import Svc1Logger, set_svc1log
 
     set_svc1log(Svc1Logger(stream=open(os.devnull, "w")))
-    summary = {"smoke": "fleet", "seed": SEED}
+    summary = {"smoke": "fleet-stacked" if STACK else "fleet", "seed": SEED}
     summary.update(serve_over_http())
-    summary.update(chaos_soak())
+    if STACK:
+        summary.update(stacked_serving())
+        summary.update(chaos_soak(stack_window_ms=75.0))
+    else:
+        summary.update(chaos_soak())
     print(json.dumps(summary), flush=True)
 
 

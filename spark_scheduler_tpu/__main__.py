@@ -151,6 +151,16 @@ def main(argv=None) -> int:
         "Demand CRDs, provision simulated nodes, drain idle ones "
         "(see the install config's `autoscaler:` block for knobs)",
     )
+    srv.add_argument(
+        "--fleet-stack",
+        type=float,
+        default=None,
+        metavar="MS",
+        help="fused fleet dispatch gather window in milliseconds "
+        "(fleet.stack-window-ms): concurrent per-cluster windows stack "
+        "into one device launch (fleet/dispatch.py); 0 disables; "
+        "requires fleet.enabled with >= 2 clusters to have any effect",
+    )
     pc = sub.add_parser(
         "print-crds",
         help="emit the CustomResourceDefinition manifests as YAML "
@@ -250,6 +260,8 @@ def main(argv=None) -> int:
         config.ha_replica_id = args.ha_replica
     if args.ha_lease_ttl is not None:
         config.ha_lease_ttl_s = args.ha_lease_ttl
+    if args.fleet_stack is not None:
+        config.fleet_stack_window_ms = args.fleet_stack
     if args.transport is not None:
         config.server_transport = args.transport
     if args.ingest is not None:

@@ -1302,18 +1302,27 @@ class PlacementSolver:
         # How the LAST pipelined/cached build reached the device
         # ("full" | "delta" | "reuse") — flight-recorder state_upload.
         self.last_state_upload: str | None = None
-        # Replay-sweep coordination (ISSUE 18, replay/sweep.py) — BOTH are
-        # None on every serving path. `_sweep_lane` lets the sweep driver
-        # intercept the pipelined XLA window solve and defer it into a
-        # cross-arm stacked dispatch (arm_stacked_fifo_pack); `_sweep_shared`
-        # is a cross-lane candidate-mask memo (the roster/registry state is
-        # arm-invariant, so lane 2..M reuse lane 1's mask build instead of
-        # re-walking the name->row map). `_row_bucket_quantum` stays 32 for
-        # serving (compile-cache coarseness on live traffic); sweep lanes
-        # drop it to 8 — under vmap padding rows EXECUTE (lax.cond lowers to
-        # select), so tight buckets are pure win there and the sweep
-        # pre-compiles its buckets up front anyway.
-        self._sweep_lane = None
+        # Deferred-dispatch lane (ISSUE 18 replay/sweep.py, ISSUE 20
+        # fleet/dispatch.py) — None on the plain serving path. A lane is a
+        # coordinator that intercepts the pipelined XLA window solve and
+        # defers it into a stacked multi-window dispatch: the sweep stacks
+        # the SAME window across config arms at its lockstep barrier
+        # (arm_stacked_fifo_pack); the fleet coordinator stacks CONCURRENT
+        # windows from different clusters inside a short gather window
+        # (bucket_stacked_fifo_pack). Lane protocol: `accepts(solver)`
+        # gates per-dispatch deferral (the fleet lane declines when fewer
+        # than two clusters are live, so those windows take the normal
+        # path untouched), `row_bucket_quantum` (None = use the solver's)
+        # sets the app-row bucket for DEFERRED windows only, and
+        # `defer_window(...)` parks the window, returning lazy blob/avail
+        # stand-ins resolved at flush. `_sweep_shared` is the sweep's
+        # cross-lane candidate-mask memo (roster state is arm-invariant,
+        # so lane 2..M reuse lane 1's mask build). `_row_bucket_quantum`
+        # stays 32 for serving (compile-cache coarseness on live
+        # traffic); sweep lanes drop it to 8 — under vmap padding rows
+        # EXECUTE (lax.cond lowers to select), so tight buckets are pure
+        # win there and the sweep pre-compiles its buckets up front.
+        self._dispatch_lane = None
         self._sweep_shared: dict | None = None
         self._row_bucket_quantum = 32
         # In-flight worker/fetch futures, cancelled (if unstarted) on
@@ -3793,6 +3802,17 @@ class PlacementSolver:
         tel = self.telemetry
         compiles_before = tel.compile_count() if tel is not None else None
         seg_bucket = 1
+        # Deferred-dispatch lane (sweep arms / fleet stacking): decided up
+        # front because a deferring dispatch must NOT pay the h2d shim —
+        # the coordinator pays ONE h2d per stacked flush, which is the
+        # whole point of fusing the launches.
+        lane = self._dispatch_lane
+        defer = (
+            pipelined
+            and not use_pallas
+            and lane is not None
+            and lane.accepts(self)
+        )
         try:
             with tracer().span(
                 "solve-dispatch", strategy=strategy, nodes=n,
@@ -3802,7 +3822,8 @@ class PlacementSolver:
                 # One simulated h2d/dispatch boundary per DISPATCH, on the
                 # dispatcher thread — a fused K-window batch pays this once
                 # where K sequential dispatches pay it K times.
-                _shim("h2d")
+                if not defer:
+                    _shim("h2d")
                 if use_pallas:
                     win, seg_idx, row_idx, s_pad, r_pad = (
                         _build_segmented_window(
@@ -3817,7 +3838,18 @@ class PlacementSolver:
                         emax=emax, num_zones=self._num_zones_bucket(),
                     )
                 else:
-                    row_bucket = _bucket(b, self._row_bucket_quantum)
+                    quantum = self._row_bucket_quantum
+                    if defer:
+                        # The lane may carry its own row-bucket policy
+                        # (fleet lane: 8, like the sweep) — it applies ONLY
+                        # to deferred windows, so the serving hot path's
+                        # compile-cache coarseness (32) is untouched when
+                        # stacking cannot trigger.
+                        quantum = (
+                            getattr(lane, "row_bucket_quantum", None)
+                            or quantum
+                        )
+                    row_bucket = _bucket(b, quantum)
                     apps = make_app_batch(
                         drv_arr,
                         exc_arr,
@@ -3833,14 +3865,16 @@ class PlacementSolver:
                         commit=commit,
                         reset=reset,
                     )
-                    if pipelined and self._sweep_lane is not None:
-                        # Replay sweep (ISSUE 18): don't solve yet — park the
-                        # window with the sweep coordinator, which stacks it
-                        # with the other arms' same-window payloads into ONE
-                        # arm-vmapped dispatch at the lockstep barrier. The
-                        # returned blob/avail are lazy stand-ins resolved at
-                        # flush (or singly, on a forced early fetch).
-                        blob, avail_after = self._sweep_lane.defer_window(
+                    if defer:
+                        # Deferred lane (ISSUE 18 sweep / ISSUE 20 fleet):
+                        # don't solve yet — park the window with the
+                        # coordinator, which stacks it with its peers'
+                        # payloads into ONE vmapped dispatch (at the sweep's
+                        # lockstep barrier, or the fleet's gather-window
+                        # flush). The returned blob/avail are lazy stand-ins
+                        # resolved at flush (or singly, on a forced early
+                        # fetch / straggler timeout).
+                        blob, avail_after = lane.defer_window(
                             self, apps,
                             avail=tensors.available,
                             statics=cluster_statics(tensors),

@@ -9,6 +9,9 @@ Per-cluster decisions stay byte-identical to a standalone cluster —
 """
 
 from spark_scheduler_tpu.fleet.aggregates import ClusterAggregates  # noqa: F401
+from spark_scheduler_tpu.fleet.dispatch import (  # noqa: F401
+    FleetDispatchCoordinator,
+)
 from spark_scheduler_tpu.fleet.facade import (  # noqa: F401
     ClusterStack,
     FleetFacade,
@@ -25,6 +28,7 @@ __all__ = [
     "ClusterAggregates",
     "ClusterStack",
     "FleetDecision",
+    "FleetDispatchCoordinator",
     "FleetFacade",
     "FleetRouter",
     "SpilloverCoordinator",

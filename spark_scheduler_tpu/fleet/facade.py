@@ -115,11 +115,31 @@ class ClusterStack:
         if self.oplog is not None:
             self.oplog.append(entry)
 
+    def _run_logged(self, entry_of, fn, *args):
+        """Execute an op on the worker AND journal it there, so oplog
+        order IS execution order even when multiple client threads hit
+        the same cluster concurrently (spillover from a sibling's pump
+        thread, the stacked-dispatch soak's concurrent offered load). A
+        client-side `_log` around `_run` could journal two racing ops in
+        the opposite order the worker served them, and the standalone
+        replay would then diverge for reasons that are artifacts of the
+        journal, not the decisions. `entry_of(result)` builds the entry
+        from already-deepcopied inputs."""
+
+        def body():
+            result = fn(*args)
+            self._log(entry_of(result))
+            return result
+
+        return self._run(body)
+
     # -- ops (public: thread-dispatched + oplogged) --------------------------
 
     def add_node(self, node) -> None:
-        self._log(("add_node", copy.deepcopy(node)))
-        self._run(self._do_add_node, node)
+        pristine = copy.deepcopy(node)
+        self._run_logged(
+            lambda _: ("add_node", pristine), self._do_add_node, node
+        )
 
     def schedule(self, pod, node_names=None) -> ExtenderFilterResult:
         pristine = copy.deepcopy(pod)
@@ -127,24 +147,33 @@ class ClusterStack:
             node_names = self.group_node_names(
                 find_instance_group(pod, self._label) or ""
             )
-        result = self._run(self._do_schedule, pod, list(node_names))
-        self._log(("schedule", pristine, tuple(node_names), result))
-        self.decisions += 1
-        return result
+        names = list(node_names)
+        return self._run_logged(
+            lambda r: ("schedule", pristine, tuple(names), r),
+            self._do_schedule,
+            pod,
+            names,
+        )
 
     def release(self, pod) -> None:
         """Delete the pod AND its demand — the spillover hand-off's home
         cleanup (and the sibling cleanup after a failed attempt)."""
-        self._log(("release", copy.deepcopy(pod)))
-        self._run(self._do_release, pod)
+        pristine = copy.deepcopy(pod)
+        self._run_logged(
+            lambda _: ("release", pristine), self._do_release, pod
+        )
 
     def terminate_pod(self, pod) -> None:
-        self._log(("terminate", copy.deepcopy(pod)))
-        self._run(self._do_terminate, pod)
+        pristine = copy.deepcopy(pod)
+        self._run_logged(
+            lambda _: ("terminate", pristine), self._do_terminate, pod
+        )
 
     def delete_pod(self, pod) -> None:
-        self._log(("delete_pod", copy.deepcopy(pod)))
-        self._run(self._do_delete_pod, pod)
+        pristine = copy.deepcopy(pod)
+        self._run_logged(
+            lambda _: ("delete_pod", pristine), self._do_delete_pod, pod
+        )
 
     # -- op bodies (single-cluster semantics, worker-thread only) ------------
 
@@ -159,6 +188,7 @@ class ClusterStack:
         )
         if result.ok:
             self.backend.bind_pod(pod, result.node_names[0])
+        self.decisions += 1
         return result
 
     def _do_release(self, pod) -> None:
@@ -227,6 +257,7 @@ class FleetFacade:
         record_ops: bool = False,
         max_spillover_hops: int = 1,
         suppress_resync: bool = True,
+        stack_window_ms: float | None = None,
     ):
         if n_clusters < 1:
             raise ValueError("n_clusters must be >= 1")
@@ -256,6 +287,28 @@ class FleetFacade:
         self.forwarded = 0
         self.unavailable_denials = 0
         self._lock = threading.RLock()
+        # Fused fleet dispatch (ISSUE 20): when `fleet.stack-window-ms`
+        # is > 0 and at least two clusters exist, every stack's solver
+        # gets the shared FleetDispatchCoordinator as its deferred-
+        # dispatch lane — concurrent per-cluster windows gather and
+        # launch as ONE stacked device dispatch. None/0 = off: the lane
+        # stays None and every serving path is byte-identical to the
+        # unstacked fleet.
+        if stack_window_ms is None:
+            stack_window_ms = base.fleet_stack_window_ms
+        self.dispatch = None
+        if stack_window_ms and stack_window_ms > 0 and n_clusters >= 2:
+            from spark_scheduler_tpu.fleet.dispatch import (
+                FleetDispatchCoordinator,
+            )
+
+            self.dispatch = FleetDispatchCoordinator(
+                stack_window_ms,
+                expected=n_clusters,
+                telemetry=self.telemetry,
+            )
+            for s in self.stacks:
+                s.app.solver._dispatch_lane = self.dispatch
 
     # -- topology ------------------------------------------------------------
 
@@ -275,6 +328,12 @@ class FleetFacade:
             }
             self.router.members.remove(cluster)
             orphans = self.router.drop_pending_affinity(cluster, placed)
+        if self.dispatch is not None:
+            # Survivors' gathers must stop waiting on the dead peer, and
+            # its own parked window (if any — kill can land mid-gather)
+            # resolves via the forced single-window fallback.
+            self.dispatch.set_expected(len(self.router.members.live()))
+            self.dispatch.expel(self.stacks[cluster].app.solver)
         self.telemetry.on_live(len(self.router.members.live()))
         self.telemetry.on_orphans_rerouted(orphans)
         return orphans
@@ -282,6 +341,8 @@ class FleetFacade:
     def rejoin_cluster(self, cluster: int) -> None:
         with self._lock:
             self.router.members.rejoin(cluster)
+        if self.dispatch is not None:
+            self.dispatch.set_expected(len(self.router.members.live()))
         self.telemetry.on_live(len(self.router.members.live()))
 
     # -- serving -------------------------------------------------------------
@@ -335,6 +396,11 @@ class FleetFacade:
                 "spilled": self.spillover.spilled,
                 "denied": self.spillover.denied,
             },
+            "stacking": (
+                self.dispatch.describe()
+                if self.dispatch is not None
+                else {"enabled": False}
+            ),
             "forwarded": self.forwarded,
             "unavailable_denials": self.unavailable_denials,
             "clusters": [
@@ -349,6 +415,10 @@ class FleetFacade:
         }
 
     def stop(self) -> None:
+        if self.dispatch is not None:
+            # Release any gather still parked on a worker thread before
+            # the per-stack shutdown joins those workers.
+            self.dispatch.drain()
         for s in self.stacks:
             s.stop()
 

@@ -619,6 +619,15 @@ FLEET_SPILLOVERS = "foundry.spark.scheduler.fleet.spillovers"
 FLEET_SPILLOVER_DENIED = "foundry.spark.scheduler.fleet.spillover.denied"
 FLEET_ORPHANS_REROUTED = "foundry.spark.scheduler.fleet.orphans.rerouted"
 FLEET_AGG_EVENTS = "foundry.spark.scheduler.fleet.aggregate.events.applied"
+# Fused fleet dispatch (fleet/dispatch.py, ISSUE 20): stacked launches,
+# windows-per-launch, fallback singles, and how long a deferred window
+# waited in the gather before its flush.
+FLEET_DISPATCH_STACKED = "foundry.spark.scheduler.fleet.dispatch.stacked"
+FLEET_DISPATCH_ARMS = "foundry.spark.scheduler.fleet.dispatch.arms"
+FLEET_DISPATCH_FALLBACKS = "foundry.spark.scheduler.fleet.dispatch.fallbacks"
+FLEET_DISPATCH_GATHER_WAIT_MS = (
+    "foundry.spark.scheduler.fleet.dispatch.gather.wait.ms"
+)
 
 
 class FleetTelemetry:
@@ -658,4 +667,18 @@ class FleetTelemetry:
     def on_aggregate_events(self, cluster: int, applied: int) -> None:
         self.registry.gauge(FLEET_AGG_EVENTS, cluster=str(cluster)).set(
             int(applied)
+        )
+
+    # -- fused fleet dispatch (fleet/dispatch.py) ----------------------------
+
+    def on_stacked_dispatch(self, arms: int) -> None:
+        self.registry.counter(FLEET_DISPATCH_STACKED).inc()
+        self.registry.counter(FLEET_DISPATCH_ARMS).inc(arms)
+
+    def on_stack_fallback(self, reason: str) -> None:
+        self.registry.counter(FLEET_DISPATCH_FALLBACKS, reason=reason).inc()
+
+    def on_gather_wait(self, wait_ms: float) -> None:
+        self.registry.histogram(FLEET_DISPATCH_GATHER_WAIT_MS).update(
+            round(wait_ms, 3)
         )

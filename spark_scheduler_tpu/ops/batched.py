@@ -540,6 +540,49 @@ def fuse_app_batches(batches, *, pad_to: int | None = None) -> AppBatch:
     )
 
 
+def pad_app_batch(apps: AppBatch, pad_to: int) -> AppBatch:
+    """Re-pad a host-side batch to a LARGER row bucket (fleet stacking:
+    windows grouped into one dispatch must share the app axis, so every
+    member grows to the group max). New rows are pure padding
+    (app_valid=False, all-zero/False) — identical to what make_app_batch
+    would have emitted at the bigger bucket, so decisions cannot shift
+    (pad-invariance pinned by tests/test_replay_sweep.py)."""
+    import numpy as np
+
+    b = np.asarray(apps.driver_req).shape[0]
+    if pad_to <= b:
+        return apps
+    grow = pad_to - b
+
+    def _rows(a):
+        if a is None:
+            return None
+        a = np.asarray(a)
+        return np.pad(a, [(0, grow)] + [(0, 0)] * (a.ndim - 1))
+
+    return AppBatch(*(_rows(getattr(apps, f)) for f in AppBatch._fields))
+
+
+def stack_app_batches(batches) -> AppBatch:
+    """Stack M same-shape batches along a new leading arm axis ([M, B, ...])
+    for `bucket_stacked_fifo_pack`. Optional masks must be uniformly set or
+    uniformly absent across the group — the fleet coordinator groups serving
+    windows, which always carry all fields, so a mix means a caller bug."""
+    import numpy as np
+
+    def _stack(field):
+        vals = [getattr(b, field) for b in batches]
+        if all(v is None for v in vals):
+            return None
+        if any(v is None for v in vals):
+            raise ValueError(
+                f"cannot stack batches with mixed None-ness in {field!r}"
+            )
+        return np.stack([np.asarray(v) for v in vals])
+
+    return AppBatch(*(_stack(f) for f in AppBatch._fields))
+
+
 @partial(
     jax.jit,
     static_argnames=("fills", "emax", "num_zones"),
@@ -615,6 +658,102 @@ def arm_stacked_fifo_pack(
             )
         else:
             blob, avail = solve_one(avail_stack[i], fill=fills[i])
+            blob, avail = blob[None], avail[None]
+        blobs.append(blob)
+        avails.append(avail)
+        i = j
+    if len(blobs) == 1:
+        return blobs[0], avails[0]
+    return jnp.concatenate(blobs), jnp.concatenate(avails)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("fills", "emax", "num_zones"),
+    donate_argnums=(0,),
+)
+def bucket_stacked_fifo_pack(
+    avail_stack,  # [M, N, 3] i32 — per-cluster availability, DONATED
+    statics_stack: tuple,  # cluster_statics stacked per field: each [M, N]
+    apps_stack: AppBatch,  # fields stacked [M, B, ...]
+    *,
+    fills: tuple,  # per-member fill strategy, EQUAL FILLS ADJACENT
+    emax: int,
+    num_zones: int,
+):
+    """M *different clusters'* windows solved in ONE device dispatch — the
+    fleet-serving generalization of `arm_stacked_fifo_pack` (ISSUE 20).
+    The sweep stacked M availability carries against ONE shared cluster and
+    app batch (arms differ only in config); fleet clusters differ in
+    everything, so here statics AND apps stack too and the vmap maps over
+    all three. Members need only agree on the padded shapes — `(bucket_n,
+    emax, num_zones)` plus the app row bucket, which the coordinator
+    equalizes via `pad_app_batch` — not on content: each lane sees its own
+    cluster's statics, masks, and availability, so per-member decisions are
+    bit-identical to that cluster's standalone `batched_fifo_pack` solve
+    (the same vmap-identity PR 18 pinned, extended over the new mapped
+    axes).
+
+    `fills` is per member with equal fills adjacent; as in the arm kernel,
+    strategy stays a static property of each sub-stack's scan body — never
+    `lax.switch`, which select-izes every branch under vmap (30x pathology,
+    see arm_stacked_fifo_pack).
+
+    The jitted name carries the `stacked_fifo_pack` donation marker
+    (server/config.py JAX_CACHE_DONATION_MARKERS): donated executables
+    must not be served from the persistent compile cache.
+
+    Returns `(blob, avail_after)`: `blob` `[M, B, 3+emax]` in the
+    `_window_blob` column layout, `avail_after` `[M, N, 3]`.
+    """
+    from spark_scheduler_tpu.models.cluster import cluster_from_statics
+
+    if len(fills) != avail_stack.shape[0]:
+        raise ValueError(
+            f"fills ({len(fills)}) must match the member axis "
+            f"({avail_stack.shape[0]})"
+        )
+
+    def solve_one(avail, statics, apps, *, fill):
+        out = batched_fifo_pack(
+            cluster_from_statics(avail, statics), apps,
+            fill=fill, emax=emax, num_zones=num_zones, unroll=1,
+        )
+        blob = jnp.concatenate(
+            [
+                out.driver_node[:, None],
+                out.admitted[:, None].astype(jnp.int32),
+                out.packed[:, None].astype(jnp.int32),
+                out.executor_nodes,
+            ],
+            axis=1,
+        )
+        return blob, out.available_after
+
+    _slice = lambda tree, i, j: jax.tree_util.tree_map(
+        lambda x: x[i:j], tree
+    )
+    _pick = lambda tree, i: jax.tree_util.tree_map(lambda x: x[i], tree)
+
+    blobs, avails = [], []
+    i = 0
+    while i < len(fills):
+        j = i
+        while j < len(fills) and fills[j] == fills[i]:
+            j += 1
+        if j > i + 1:
+            blob, avail = jax.vmap(partial(solve_one, fill=fills[i]))(
+                avail_stack[i:j],
+                _slice(statics_stack, i, j),
+                _slice(apps_stack, i, j),
+            )
+        else:
+            blob, avail = solve_one(
+                avail_stack[i],
+                _pick(statics_stack, i),
+                _pick(apps_stack, i),
+                fill=fills[i],
+            )
             blob, avail = blob[None], avail[None]
         blobs.append(blob)
         avails.append(avail)

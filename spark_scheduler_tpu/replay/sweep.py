@@ -23,7 +23,7 @@ decisions subtracted — differs. `run_sweep` exploits exactly that split:
     (`solver._sweep_shared`), so lane 2..S never re-walk the name->row
     map lane 1 already walked.
   * **Stacked window solves.** The predicate step is two-phase: every
-    lane DISPATCHES its window (deferred — the solver's `_sweep_lane`
+    lane DISPATCHES its window (deferred — the solver's `_dispatch_lane`
     hook parks the built app batch + availability with this
     coordinator), then the coordinator flushes: payloads whose app
     batches and statics digest-match are stacked `[M, N, 3]` and solved
@@ -52,7 +52,9 @@ decisions subtracted — differs. `run_sweep` exploits exactly that split:
 Correctness bar (pinned by tests/test_replay_sweep.py): every arm's
 verdicts/placements are bit-identical to its own sequential
 `replay_trace()` under the same config. The serving path never sees any
-of this — `_sweep_lane`/`_sweep_shared` are None outside this driver.
+of this — `_dispatch_lane`/`_sweep_shared` are None outside this driver
+(the fleet dispatch coordinator, fleet/dispatch.py, installs its own
+lane on fleet serving solvers when stacking is enabled).
 
 CLI: `python -m spark_scheduler_tpu.replay sweep TRACE
 --grid binpack-algo=tightly-pack,distribute-evenly --set ... [--markdown]`.
@@ -262,13 +264,22 @@ class _Payload:
 
 
 class SweepCoordinator:
-    """The solver-side hook object (`solver._sweep_lane`): collects every
-    lane's deferred window between lockstep barriers, then flushes them as
-    stacked cross-arm dispatches."""
+    """The solver-side hook object (`solver._dispatch_lane`): collects
+    every lane's deferred window between lockstep barriers, then flushes
+    them as stacked cross-arm dispatches."""
+
+    # Dispatch-lane protocol (core/solver.py): the sweep drops the
+    # solvers' own quantum to 8 at lane setup, so no per-lane override.
+    row_bucket_quantum = None
 
     def __init__(self, telemetry: dict):
         self.tel = telemetry
         self.pending: list[_Payload] = []
+
+    def accepts(self, solver) -> bool:
+        """Every pipelined XLA window defers — replay lanes run in
+        lockstep, so a stacking partner is always coming."""
+        return True
 
     # Called from PlacementSolver.pack_window_dispatch (replay-only).
     def defer_window(
@@ -543,7 +554,7 @@ def run_sweep(
             has_result_events=has_results,
             candidate_memo=shared_masks,
         )
-        lane.app.solver._sweep_lane = coordinator
+        lane.app.solver._dispatch_lane = coordinator
         lane.app.solver._row_bucket_quantum = SWEEP_ROW_BUCKET
         lanes.append(lane)
 

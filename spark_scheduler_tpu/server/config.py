@@ -249,10 +249,17 @@ class InstallConfig:
     # Fleet federation (fleet/): the server boots F independent
     # per-cluster solver stacks behind one FleetFacade instead of a
     # single-cluster app. YAML block:
-    #   fleet: {enabled, clusters, max-spillover-hops}
+    #   fleet: {enabled, clusters, max-spillover-hops, stack-window-ms}
+    # `stack-window-ms` > 0 turns on fused fleet dispatch (ISSUE 20): a
+    # cluster's staged window waits up to that long for windows from the
+    # other live clusters, and same-shape-bucket windows flush as ONE
+    # stacked device launch (fleet/dispatch.py). 0 (default) = off; every
+    # serving blob and decision is then byte-identical to the unstacked
+    # fleet.
     fleet_enabled: bool = False
     fleet_clusters: int = 2
     fleet_max_spillover_hops: int = 1
+    fleet_stack_window_ms: float = 0.0
     # Request-gap resync threshold (`extender.resync-gap-seconds`,
     # resource.go:191-202): a gap longer than this resyncs durable state
     # from observed pods. Skipped entirely while the HA lease is held.
@@ -314,8 +321,12 @@ class InstallConfig:
     # key, so donated entry points carry it in their function names
     # (core/solver._window_blob_split_donated explains the convention);
     # batched_fifo_pack_carry is the ops-level donated entry the bench
-    # drives directly.
-    JAX_CACHE_DONATION_MARKERS = ("donated", "batched_fifo_pack_carry")
+    # drives directly; stacked_fifo_pack covers the arm/bucket stacking
+    # kernels (replay sweeps + the fleet dispatch coordinator), which
+    # donate their [M, N, 3] availability stacks.
+    JAX_CACHE_DONATION_MARKERS = (
+        "donated", "batched_fifo_pack_carry", "stacked_fifo_pack",
+    )
 
     @staticmethod
     def serialize_jax_cache_io() -> bool:
@@ -585,6 +596,9 @@ class InstallConfig:
             fleet_clusters=int(block_key(fleet_block, "clusters", 2)),
             fleet_max_spillover_hops=int(
                 block_key(fleet_block, "max-spillover-hops", 1)
+            ),
+            fleet_stack_window_ms=float(
+                block_key(fleet_block, "stack-window-ms", 0.0)
             ),
             resync_gap_seconds=_parse_duration(
                 block_key(

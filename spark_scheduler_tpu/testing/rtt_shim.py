@@ -25,6 +25,15 @@ unfused window costs one full round trip; a fused K-window dispatch costs
 one round trip for all K. Event counts are recorded per kind, so tests
 assert the amortization structurally (fused serving of K windows fires
 ONE h2d and ONE d2h) rather than by wall clock.
+
+`tunnel_serialized=True` models a SHARED device link: every boundary's
+sleep holds one tunnel lock, so concurrent transfers from different
+threads queue behind each other instead of overlapping. That is the
+regime where the fleet's per-cluster round trips pile up (F windows = F
+serialized RTTs) and the fused fleet dispatch's single launch pays once
+— the stacked-vs-unstacked fleet bench runs BOTH arms under this mode so
+the A/B measures launch fusion, not sleep overlap. Default False keeps
+PR 19's overlapping-transfer semantics (independent per-device RPCs).
 """
 
 from __future__ import annotations
@@ -45,14 +54,17 @@ class SimulatedRTT:
         h2d_ms: float | None = None,
         dispatch_ms: float = 0.0,
         d2h_ms: float | None = None,
+        tunnel_serialized: bool = False,
     ):
         half = rtt_ms / 2.0
         self.rtt_ms = rtt_ms
         self.h2d_ms = half if h2d_ms is None else h2d_ms
         self.dispatch_ms = dispatch_ms
         self.d2h_ms = half if d2h_ms is None else d2h_ms
+        self.tunnel_serialized = tunnel_serialized
         self.counts = {"h2d": 0, "dispatch": 0, "d2h": 0}
         self._lock = threading.Lock()
+        self._tunnel = threading.Lock()
         self._prior = None
         self._installed = False
 
@@ -66,7 +78,13 @@ class SimulatedRTT:
             "d2h": self.d2h_ms,
         }.get(kind, 0.0)
         if ms > 0:
-            time.sleep(ms / 1e3)
+            if self.tunnel_serialized:
+                # One shared link: this transfer occupies the tunnel for
+                # its full duration, queueing concurrent boundaries.
+                with self._tunnel:
+                    time.sleep(ms / 1e3)
+            else:
+                time.sleep(ms / 1e3)
 
     def reset_counts(self) -> None:
         with self._lock:

@@ -24,6 +24,7 @@ engine. Anchor: extendertest harness pattern
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -1313,6 +1314,15 @@ class FleetSoak:
       * per-cluster decisions byte-identical to a standalone replay of
         the cluster's op stream (checked once at the end — the oplog
         covers the entire soak).
+
+    STACKING MODE (`stack_window_ms` > 0, ISSUE 20): the facade runs the
+    FleetDispatchCoordinator, and each step's fresh gangs are submitted
+    CONCURRENTLY — one per group from its own thread — so per-cluster
+    windows actually meet inside the gather and flush as stacked
+    launches. The kill lands while a concurrent burst is in flight
+    (kill-mid-gather: the victim's parked window must resolve via the
+    forced fallback and the survivors' stack must flush clean), and
+    every invariant above — byte-identity included — holds unchanged.
     """
 
     def __init__(
@@ -1321,6 +1331,7 @@ class FleetSoak:
         nodes_per_cluster: int = 2,
         seed: int = 0,
         max_spillover_hops: int = 1,
+        stack_window_ms: float = 0.0,
     ):
         from spark_scheduler_tpu.fleet import FleetFacade
         from spark_scheduler_tpu.server.config import InstallConfig
@@ -1330,6 +1341,8 @@ class FleetSoak:
 
         self.rng = np.random.default_rng(seed)
         self.F = n_clusters
+        self.stack_window_ms = stack_window_ms
+        self._traffic_lock = threading.Lock()
         cfg = InstallConfig(
             fifo=True,
             sync_writes=True,
@@ -1340,6 +1353,7 @@ class FleetSoak:
             cfg,
             record_ops=True,
             max_spillover_hops=max_spillover_hops,
+            stack_window_ms=stack_window_ms,
         )
         # Group g is hosted by clusters g and (g+1) % F — multi-homed.
         self.groups = [f"ig-{g}" for g in range(n_clusters)]
@@ -1370,20 +1384,49 @@ class FleetSoak:
         self._try_place(app_id, group, pods)
 
     def _try_place(self, app_id: str, group: str, pods) -> None:
+        # schedule() runs OUTSIDE the traffic lock so concurrent burst
+        # threads (stacking mode) can meet inside the gather window;
+        # only the soak's own bookkeeping is lock-guarded.
         d = self.facade.schedule(pods[0])
         if d.unavailable:
-            self.unavailable_denials += 1
-            self.pending[app_id] = {"pods": pods, "group": group}
+            with self._traffic_lock:
+                self.unavailable_denials += 1
+                self.pending[app_id] = {"pods": pods, "group": group}
             return
         if not d.ok:
-            self.pending[app_id] = {"pods": pods, "group": group}
+            with self._traffic_lock:
+                self.pending[app_id] = {"pods": pods, "group": group}
             return
         for p in pods[1:]:
             self.facade.schedule(p)
-        self.pending.pop(app_id, None)
-        self.placed[app_id] = {"pods": pods, "cluster": d.cluster}
-        if app_id in self.orphans_at_kill:
-            self.orphans_rerouted += 1
+        with self._traffic_lock:
+            self.pending.pop(app_id, None)
+            self.placed[app_id] = {"pods": pods, "cluster": d.cluster}
+            if app_id in self.orphans_at_kill:
+                self.orphans_rerouted += 1
+
+    def _start_burst(self) -> list[threading.Thread]:
+        """Stacking mode: one fresh gang per group, each submitted from
+        its own thread so per-cluster windows can stack. Pods and RNG
+        draws happen on the caller's thread to keep the soak
+        deterministic; only the facade calls run concurrently."""
+        jobs = []
+        for group in self.groups:
+            self.seq += 1
+            app_id = f"fleet-soak-{self.seq}"
+            pods = static_allocation_spark_pods(
+                app_id, int(self.rng.integers(1, 4)), instance_group=group
+            )
+            jobs.append((app_id, group, pods))
+        threads = [
+            threading.Thread(
+                target=self._try_place, args=job, name=f"soak-burst-{job[0]}"
+            )
+            for job in jobs
+        ]
+        for t in threads:
+            t.start()
+        return threads
 
     def _teardown(self, app_id: str) -> None:
         info = self.placed.pop(app_id)
@@ -1428,26 +1471,31 @@ class FleetSoak:
         rejoin_at: int = 30,
         check_every: int = 5,
     ) -> "FleetSoak":
+        stacking = self.stack_window_ms > 0
         for step in range(steps):
             self.steps_run = step
-            if step == kill_at and self.dead is None:
-                victim = int(self.rng.integers(0, self.F))
-                # Pending gangs routed to the victim are the orphans the
-                # re-route invariant tracks.
-                self.orphans_at_kill = {
-                    a
-                    for a in self.pending
-                    if self.facade.router.affinity_of(a) == victim
-                }
-                self.facade.kill_cluster(victim)
-                self.dead = victim
+            kill_now = step == kill_at and self.dead is None
+            if kill_now and not stacking:
+                self._kill()
             if step == rejoin_at and self.dead is not None:
                 self.facade.rejoin_cluster(self.dead)
                 self.dead = None
-            # Fresh gang.
-            self.seq += 1
-            group = self.groups[int(self.rng.integers(0, len(self.groups)))]
-            self._submit(f"fleet-soak-{self.seq}", group)
+            # Fresh gang(s). Stacking mode submits one per group
+            # concurrently so the coordinator actually gathers; the kill
+            # then lands while the burst is in flight (kill-mid-gather).
+            if stacking:
+                burst = self._start_burst()
+                if kill_now:
+                    time.sleep(min(self.stack_window_ms, 50.0) / 2e3)
+                    self._kill()
+                for t in burst:
+                    t.join()
+            else:
+                self.seq += 1
+                group = self.groups[
+                    int(self.rng.integers(0, len(self.groups)))
+                ]
+                self._submit(f"fleet-soak-{self.seq}", group)
             # Retry up to two pending gangs (oldest first).
             for app_id in list(self.pending)[:2]:
                 info = self.pending.pop(app_id)
@@ -1460,6 +1508,19 @@ class FleetSoak:
                 self._check()
         self._check()
         return self
+
+    def _kill(self) -> None:
+        victim = int(self.rng.integers(0, self.F))
+        # Pending gangs routed to the victim are the orphans the
+        # re-route invariant tracks.
+        with self._traffic_lock:
+            self.orphans_at_kill = {
+                a
+                for a in self.pending
+                if self.facade.router.affinity_of(a) == victim
+            }
+        self.facade.kill_cluster(victim)
+        self.dead = victim
 
     def verdict(self) -> dict:
         from spark_scheduler_tpu.fleet import verify_cluster_equivalence
@@ -1486,6 +1547,7 @@ class FleetSoak:
             "placed": len(self.placed),
             "pending": len(self.pending),
             "spillovers": st["spillover"]["spilled"],
+            "stacking": st.get("stacking", {"enabled": False}),
             "equivalence": equivalence,
         }
 

@@ -1,0 +1,450 @@
+"""Fleet-fused device dispatch (ISSUE 20): the FleetDispatchCoordinator
+stacks CONCURRENT per-cluster serving windows into one device launch.
+
+The bar is byte-identity: stacking is a transport optimization, never a
+semantic one. Every test here drives real fleet traffic with the
+coordinator on and holds `verify_cluster_equivalence` — each cluster's
+decision stream and durable reservation state must replay byte-identical
+on a standalone (unstacked) stack — plus the structural facts: windows
+actually stack when clusters are concurrent, stragglers fall back per
+cluster without blocking, mixed shapes split into different pad buckets,
+and a cluster killed mid-gather resolves via the forced fallback while
+the survivors' stack flushes clean.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_scheduler_tpu.fleet import (
+    FleetDispatchCoordinator,
+    FleetFacade,
+    verify_cluster_equivalence,
+)
+from spark_scheduler_tpu.server.config import InstallConfig
+from spark_scheduler_tpu.testing.harness import (
+    INSTANCE_GROUP_LABEL,
+    new_node,
+    static_allocation_spark_pods,
+)
+
+
+def _config(**kw):
+    return InstallConfig(
+        fifo=True,
+        sync_writes=True,
+        instance_group_label=INSTANCE_GROUP_LABEL,
+        **kw,
+    )
+
+
+def _fleet(n, stack_ms, nodes_per_cluster=2, **cfg_kw):
+    f = FleetFacade(
+        n, _config(**cfg_kw), record_ops=True, stack_window_ms=stack_ms
+    )
+    for c in range(n):
+        for i in range(nodes_per_cluster):
+            f.add_node(c, new_node(f"c{c}-n{i}", instance_group=f"ig-{c}"))
+    return f
+
+
+def _concurrent_churn(f, n, rounds=3, seed=7):
+    """Per-cluster worker threads so windows from different clusters are
+    in flight together and meet inside the gather window. Each thread
+    owns its RNG (seeded per cluster) — the traffic is deterministic per
+    cluster even though the interleaving is not, and the equivalence
+    oracle replays each cluster's own oplog, which is order-exact."""
+
+    def worker(c):
+        rng = np.random.default_rng(seed + c)
+        live = []
+        for k in range(rounds):
+            app = f"stk-c{c}-{k}"
+            pods = static_allocation_spark_pods(
+                app, int(rng.integers(1, 3)), instance_group=f"ig-{c}"
+            )
+            d = f.schedule(pods[0])
+            for p in pods[1:]:
+                f.schedule(p)
+            if d.ok:
+                live.append((d.cluster, pods))
+            if live and rng.random() < 0.4:
+                cluster, old = live.pop(0)
+                for p in old:
+                    f.stacks[cluster].delete_pod(p)
+
+    ts = [threading.Thread(target=worker, args=(c,)) for c in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def _sequential_decisions(f, apps, group):
+    """Drive `apps` driver-only gangs at one group, return decision bytes."""
+    out = []
+    for k in range(apps):
+        pods = static_allocation_spark_pods(
+            f"seq-{group}-{k}", 1, instance_group=group
+        )
+        for p in pods:
+            d = f.schedule(p)
+            out.append((d.ok, tuple(d.result.node_names), d.result.outcome))
+    return out
+
+
+DISPATCH_CONFIGS = [
+    pytest.param({}, id="default"),
+    pytest.param({"solver_prune_top_k": 4}, id="pruned"),
+    pytest.param({"solver_device_pool": 2}, id="pooled"),
+]
+
+
+class TestStackedIdentity:
+    @pytest.mark.parametrize("cfg_kw", DISPATCH_CONFIGS)
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_concurrent_churn_replays_byte_identical(self, n, cfg_kw):
+        f = _fleet(n, 150.0, **cfg_kw)
+        try:
+            _concurrent_churn(f, n)
+            st = f.state()["stacking"]
+            if not cfg_kw:
+                # Standard pipelined XLA serving: concurrent windows must
+                # actually stack, and nothing may need a forced resolve.
+                assert st["stacked_dispatches"] > 0, st
+            elif "solver_device_pool" in cfg_kw:
+                # Pooled windows dispatch through the slot pool before
+                # the lane hook — they never defer.
+                assert st["stacked_dispatches"] == 0, st
+                assert st["deferred"] == 0, st
+            # Pruned is a conditional fast path: windows it accepts skip
+            # the lane, fall-through windows defer like standard ones —
+            # either way the byte-identity bar below is the contract.
+            assert st["forced_resolves"] == 0, st
+            report = verify_cluster_equivalence(f)
+            assert set(report) == set(range(n))
+            assert all(r["identical"] for r in report.values())
+            for s in f.stacks:
+                assert s.aggregates.oracle_equals(), f"cluster {s.index}"
+        finally:
+            f.stop()
+
+
+class TestStragglerFallback:
+    def test_lone_cluster_times_out_and_matches_unstacked(self):
+        """Traffic at ONE cluster of three: its windows defer, nobody
+        joins the gather, and each flush falls back to the per-cluster
+        solve — decisions byte-equal to a stack-off facade."""
+        on = _fleet(3, 60.0)
+        off = _fleet(3, 0.0)
+        try:
+            got = _sequential_decisions(on, 3, "ig-0")
+            want = _sequential_decisions(off, 3, "ig-0")
+            assert got == want
+            st = on.state()["stacking"]
+            assert st["stacked_dispatches"] == 0, st
+            assert st["fallbacks"] >= 3, st
+            assert st["forced_resolves"] == 0, st
+            assert all(
+                r["identical"]
+                for r in verify_cluster_equivalence(on).values()
+            )
+        finally:
+            on.stop()
+            off.stop()
+
+
+class TestMixedShapeGrouping:
+    def test_different_node_buckets_never_share_a_stack(self):
+        """Clusters at 2 vs 12 nodes pad to different node buckets (8 vs
+        16): their concurrent windows gather together but group apart,
+        each solved as a singleton fallback — and stay byte-identical."""
+        f = FleetFacade(2, _config(), record_ops=True, stack_window_ms=300.0)
+        try:
+            for i in range(2):
+                f.add_node(0, new_node(f"c0-n{i}", instance_group="ig-0"))
+            for i in range(12):
+                f.add_node(1, new_node(f"c1-n{i}", instance_group="ig-1"))
+
+            def pump(c):
+                pods = static_allocation_spark_pods(
+                    f"mix-{c}", 1, instance_group=f"ig-{c}"
+                )
+                for p in pods:
+                    f.schedule(p)
+
+            ts = [
+                threading.Thread(target=pump, args=(c,)) for c in range(2)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            st = f.state()["stacking"]
+            assert st["deferred"] >= 2, st
+            assert st["stacked_dispatches"] == 0, st
+            assert st["fallbacks"] >= 2, st
+            assert all(
+                r["identical"]
+                for r in verify_cluster_equivalence(f).values()
+            )
+        finally:
+            f.stop()
+
+
+class TestKillMidGather:
+    def test_victim_forced_and_survivors_stack(self):
+        f = _fleet(3, 3000.0)
+        try:
+            done = threading.Event()
+
+            def victim_pump():
+                pod = static_allocation_spark_pods(
+                    "kill-victim", 1, instance_group="ig-0"
+                )[0]
+                f.schedule(pod)
+                done.set()
+
+            t = threading.Thread(target=victim_pump)
+            t.start()
+            # Wait until the victim's window is parked in the gather.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if f.dispatch.describe()["pending"] >= 1:
+                    break
+                time.sleep(0.005)
+            else:
+                pytest.fail("victim window never deferred")
+            f.kill_cluster(0)
+            assert done.wait(5.0), "victim window never resolved after kill"
+            t.join()
+            st = f.state()["stacking"]
+            assert st["forced_resolves"] == 1, st
+            assert st["expected"] == 2, st
+
+            # Survivors still stack with each other.
+            def pump(c):
+                pods = static_allocation_spark_pods(
+                    f"surv-{c}", 1, instance_group=f"ig-{c}"
+                )
+                for p in pods:
+                    f.schedule(p)
+
+            ts = [
+                threading.Thread(target=pump, args=(c,)) for c in (1, 2)
+            ]
+            for s in ts:
+                s.start()
+            for s in ts:
+                s.join()
+            st = f.state()["stacking"]
+            assert st["stacked_dispatches"] >= 1, st
+            assert all(
+                r["identical"]
+                for r in verify_cluster_equivalence(f).values()
+            )
+        finally:
+            f.stop()
+
+
+class TestRowBucketPolicy:
+    def test_deferred_windows_use_fleet_quantum_serving_stays_32(self):
+        """The row-bucket split (ISSUE 20 satellite): deferred fleet
+        windows pad app rows at the lane quantum (8); every non-deferred
+        serving window keeps the solver's 32 — with the window OPEN but
+        stacking not triggering, blobs are byte-unchanged from stack-off."""
+        assert FleetDispatchCoordinator.row_bucket_quantum == 8
+        on = _fleet(2, 200.0)
+        off = _fleet(2, 0.0)
+        try:
+            for g in (on, off):
+                for s in g.stacks:
+                    assert s.app.solver._row_bucket_quantum == 32
+
+            def pump(g, c):
+                pods = static_allocation_spark_pods(
+                    f"rbq-{c}", 1, instance_group=f"ig-{c}"
+                )
+                for p in pods:
+                    g.schedule(p)
+
+            ts = [
+                threading.Thread(target=pump, args=(on, c))
+                for c in range(2)
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert on.state()["stacking"]["stacked_dispatches"] > 0
+            for c in range(2):
+                pump(off, c)
+            # Deferred windows padded at the lane quantum; the unstacked
+            # facade's identical windows padded at the serving quantum.
+            for g, want in ((on, 8), (off, 32)):
+                for s in g.stacks:
+                    info = s.app.solver.last_solve_info
+                    assert info["row_bucket"] == want, (s.index, info)
+
+            # Window open but stacking no longer triggering (one live
+            # cluster => accepts() is False): serving windows run the
+            # normal path at quantum 32 and decisions byte-match
+            # stack-off.
+            on.kill_cluster(1)
+            off.kill_cluster(1)
+            got = _sequential_decisions(on, 2, "ig-0")
+            want = _sequential_decisions(off, 2, "ig-0")
+            assert got == want
+            assert (
+                on.stacks[0].app.solver.last_solve_info["row_bucket"] == 32
+            )
+            deferred_before = on.state()["stacking"]["deferred"]
+            assert deferred_before == 2, on.state()["stacking"]
+            assert all(
+                r["identical"]
+                for r in verify_cluster_equivalence(on).values()
+            )
+        finally:
+            on.stop()
+            off.stop()
+
+
+class TestDefaultOff:
+    def test_stack_window_defaults_off_and_pins_pr19_serving(self):
+        assert InstallConfig().fleet_stack_window_ms == 0.0
+        f = _fleet(3, 0.0)
+        try:
+            assert f.dispatch is None
+            for s in f.stacks:
+                assert s.app.solver._dispatch_lane is None
+            assert f.state()["stacking"] == {"enabled": False}
+            _sequential_decisions(f, 2, "ig-0")
+            assert all(
+                r["identical"]
+                for r in verify_cluster_equivalence(f).values()
+            )
+        finally:
+            f.stop()
+
+    def test_facade_honors_config_default(self):
+        cfg = _config(fleet_stack_window_ms=120.0)
+        f = FleetFacade(2, cfg, record_ops=True)
+        try:
+            assert f.dispatch is not None
+            assert f.dispatch.describe()["window_ms"] == 120.0
+            for s in f.stacks:
+                assert s.app.solver._dispatch_lane is f.dispatch
+        finally:
+            f.stop()
+
+
+class TestBucketStackedKernel:
+    """Direct vmap-identity pin for the stacked kernel, below the fleet
+    plumbing: M windows from DIFFERENT clusters (different statics, apps,
+    row counts, mixed fills) solved in one `bucket_stacked_fifo_pack`
+    dispatch must be bitwise equal to each member's own
+    `batched_fifo_pack` solve at its original (unpadded) row count."""
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_matches_per_member_solves_bitwise(self, seed):
+        import jax.numpy as jnp
+
+        from spark_scheduler_tpu.models.cluster import cluster_statics
+        from spark_scheduler_tpu.ops.batched import (
+            batched_fifo_pack,
+            bucket_stacked_fifo_pack,
+            pad_app_batch,
+            stack_app_batches,
+        )
+        from tests.test_batched import random_apps
+        from tests.test_packing_golden import random_cluster
+
+        emax, zones, n = 16, 4, 24
+        rng = np.random.default_rng(seed)
+        members = []
+        for b, fill in (
+            (5, "tightly-pack"),
+            (3, "distribute-evenly"),
+            (7, "tightly-pack"),
+        ):
+            members.append(
+                (random_cluster(rng, n), random_apps(rng, b), fill)
+            )
+        # The coordinator's stacking protocol: equal fills adjacent, app
+        # rows re-padded to the group max.
+        members.sort(key=lambda m: m[2])
+        rows = max(m[1].driver_req.shape[0] for m in members)
+        fills = tuple(m[2] for m in members)
+        n_statics = len(cluster_statics(members[0][0]))
+        avail_stack = jnp.stack(
+            [jnp.asarray(m[0].available) for m in members]
+        )
+        statics_stack = tuple(
+            jnp.stack(
+                [jnp.asarray(cluster_statics(m[0])[i]) for m in members]
+            )
+            for i in range(n_statics)
+        )
+        apps_stack = stack_app_batches(
+            [pad_app_batch(m[1], rows) for m in members]
+        )
+        blob, avail_after = bucket_stacked_fifo_pack(
+            avail_stack,
+            statics_stack,
+            apps_stack,
+            fills=fills,
+            emax=emax,
+            num_zones=zones,
+        )
+        blob, avail_after = np.asarray(blob), np.asarray(avail_after)
+        for i, (c, apps, fill) in enumerate(members):
+            out = batched_fifo_pack(
+                c, apps, fill=fill, emax=emax, num_zones=zones
+            )
+            want = np.concatenate(
+                [
+                    np.asarray(out.driver_node)[:, None],
+                    np.asarray(out.admitted)[:, None].astype(np.int32),
+                    np.asarray(out.packed)[:, None].astype(np.int32),
+                    np.asarray(out.executor_nodes),
+                ],
+                axis=1,
+            )
+            b = apps.driver_req.shape[0]
+            np.testing.assert_array_equal(
+                blob[i, :b], want, err_msg=f"member {i} blob"
+            )
+            np.testing.assert_array_equal(
+                avail_after[i],
+                np.asarray(out.available_after),
+                err_msg=f"member {i} avail",
+            )
+
+    def test_mismatched_fills_raise(self):
+        import jax.numpy as jnp
+
+        from spark_scheduler_tpu.ops.batched import (
+            bucket_stacked_fifo_pack,
+        )
+
+        with pytest.raises(ValueError, match="fills"):
+            bucket_stacked_fifo_pack(
+                jnp.zeros((2, 8, 3), jnp.int32),
+                (),
+                None,
+                fills=("tightly-pack",),
+                emax=8,
+                num_zones=2,
+            )
+
+    def test_stack_app_batches_rejects_mixed_noneness(self):
+        from spark_scheduler_tpu.ops.batched import stack_app_batches
+        from tests.test_batched import random_apps
+
+        rng = np.random.default_rng(5)
+        a, b = random_apps(rng, 4), random_apps(rng, 4)
+        b = b._replace(driver_cand=np.zeros((4, 8), np.bool_))
+        with pytest.raises(ValueError, match="mixed None-ness"):
+            stack_app_batches([a, b])
